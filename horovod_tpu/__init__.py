@@ -1,0 +1,97 @@
+"""horovod_tpu — TPU-native distributed training framework.
+
+A ground-up, TPU-first implementation of the capability surface of the
+reference data-parallel framework (Horovod v0.18.1, surveyed in SURVEY.md):
+wrap your optimizer, and named gradient tensors are averaged across workers
+with bandwidth-optimal collectives — here XLA collectives
+(``psum``/``all_gather``/``ppermute``) over ICI/DCN on a
+``jax.sharding.Mesh``, instead of NCCL/MPI rings over GPUs.
+
+Canonical usage (mirrors reference: examples/*.py):
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    # scale learning rate by number of workers
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01 * hvd.size()))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    ...
+    if hvd.rank() == 0:
+        save_checkpoint(...)
+"""
+
+from horovod_tpu.version import __version__
+
+from horovod_tpu.core.basics import (
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mesh,
+    is_homogeneous,
+    mpi_built,
+    gloo_built,
+    nccl_built,
+    ddl_built,
+    mlsl_built,
+    xla_built,
+    mpi_enabled,
+    mpi_threads_supported,
+)
+from horovod_tpu.core.mesh import CROSS_AXIS, GLOBAL_AXES, LOCAL_AXIS
+from horovod_tpu.ops.collectives import (
+    Average,
+    Sum,
+    Min,
+    Max,
+    Product,
+    Handle,
+    allreduce,
+    allreduce_async,
+    allgather,
+    allgather_async,
+    alltoall,
+    broadcast,
+    broadcast_async,
+    grouped_allreduce,
+    poll,
+    reducescatter,
+    stack_per_worker,
+    synchronize,
+)
+from horovod_tpu.compression import Compression
+from horovod_tpu.parallel.dp import (
+    DistributedOptimizer,
+    DistributedGradientTape,
+    allreduce_gradients,
+    broadcast_parameters,
+    broadcast_optimizer_state,
+    broadcast_object,
+)
+
+__all__ = [
+    "__version__",
+    # lifecycle / topology
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "mesh", "is_homogeneous",
+    "CROSS_AXIS", "LOCAL_AXIS", "GLOBAL_AXES",
+    # capability probes
+    "mpi_built", "gloo_built", "nccl_built", "ddl_built", "mlsl_built",
+    "xla_built", "mpi_enabled", "mpi_threads_supported",
+    # collectives
+    "Average", "Sum", "Min", "Max", "Product",
+    "allreduce", "allreduce_async", "grouped_allreduce",
+    "allgather", "allgather_async", "broadcast", "broadcast_async",
+    "reducescatter", "alltoall", "stack_per_worker",
+    "Handle", "poll", "synchronize",
+    # data-parallel API
+    "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "Compression",
+]

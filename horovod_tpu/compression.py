@@ -1,0 +1,78 @@
+"""Gradient compression algorithms.
+
+Mirrors the reference's ``hvd.Compression`` (reference:
+horovod/torch/compression.py:28-78, horovod/tensorflow/compression.py):
+a compressor is applied to a tensor before it enters the collective and
+undone afterwards. On TPU the natural 16-bit type is **bfloat16** (same
+exponent range as fp32, native MXU type), so ``Compression.fp16`` maps to
+bf16 by default; IEEE fp16 is available as ``Compression.ieee_fp16`` for
+bit-parity experiments.
+
+Inside ``jit`` the cast fuses into the surrounding collective, so
+compression halves ICI/DCN bytes at zero extra HBM traffic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface: ``compress`` returns (compressed_tensor, context) and
+    ``decompress`` undoes it using the context."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: torch/compression.py:35-43)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to bfloat16 for the collective, cast back
+    after (reference: torch/compression.py:45-60, with fp16→bf16 for TPU)."""
+
+    wire_dtype = jnp.bfloat16
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating) and tensor.dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), ctx
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class IEEEFP16Compressor(FP16Compressor):
+    """IEEE float16 wire format (exact reference behavior; narrower exponent
+    than bf16 — prefer ``Compression.fp16`` on TPU)."""
+
+    wire_dtype = jnp.float16
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce
+    (reference: torch/compression.py:63-78)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    ieee_fp16 = IEEEFP16Compressor

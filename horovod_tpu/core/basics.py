@@ -1,0 +1,231 @@
+"""Lifecycle + topology API: init / shutdown / rank / size / ...
+
+TPU-native analogue of the reference's C lifecycle API and ctypes wrapper
+(reference: horovod/common/operations.cc:611-732, horovod/common/basics.py).
+
+Worker model
+------------
+The reference runs one process per accelerator; ``rank``/``size`` are MPI
+ranks. JAX is a single-controller SPMD system: one process typically drives
+many devices, and on a pod each host runs one process. We therefore define
+**worker == device (TPU chip)**:
+
+* ``size()``       — total number of devices in the global mesh.
+* ``local_size()`` — extent of the ``local`` (ICI) mesh axis.
+* ``cross_size()`` — extent of the ``cross`` (DCN) mesh axis.
+* ``rank()``       — flat index of the first device owned by this process
+                     (0 in single-process mode). With one process per chip —
+                     the reference's launch topology — this is exactly the
+                     MPI rank.
+* ``local_rank()`` / ``cross_rank()`` — ``rank`` split along the mesh axes.
+
+User conventions from the reference carry over unchanged: scale the learning
+rate by ``size()``, checkpoint when ``rank() == 0``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Optional, Sequence
+
+import jax
+
+from horovod_tpu.core import mesh as mesh_mod
+from horovod_tpu.core import state as state_mod
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import Config
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self) -> None:
+        # reference error text: horovod/common/operations.cc NOT_INITIALIZED
+        super().__init__(
+            "horovod_tpu has not been initialized; use hvd.init()."
+        )
+
+
+def _ensure_init() -> state_mod.GlobalState:
+    st = state_mod.global_state()
+    if not st.initialized:
+        raise NotInitializedError()
+    return st
+
+
+def init(
+    comm=None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[tuple[int, int]] = None,
+) -> None:
+    """Initialize the framework: build the device mesh, parse config knobs,
+    and start background subsystems.
+
+    Mirrors ``horovod_init`` → ``InitializeHorovodOnce`` (reference:
+    horovod/common/operations.cc:554-600). ``comm`` is accepted for API
+    compatibility and ignored (there is no MPI communicator on TPU; process
+    membership comes from ``jax.distributed``).
+
+    Multi-process (multi-host) initialization: if ``HOROVOD_COORDINATOR_ADDR``
+    is set (by the ``tpurun`` launcher), ``jax.distributed.initialize`` is
+    called first so all processes join one global device mesh.
+    """
+    st = state_mod.global_state()
+    with st.lock:
+        if st.initialized:
+            return
+
+        # NOTE: must not touch any jax API that initializes the local
+        # backend (jax.devices / jax.process_count) before
+        # jax.distributed.initialize — the guard reads env vars only.
+        coordinator = os.environ.get("HOROVOD_COORDINATOR_ADDR")
+        num_processes = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1"))
+        if coordinator and num_processes > 1 and not _jax_dist_initialized():
+            process_id = int(os.environ.get("HOROVOD_PROCESS_ID", "0"))
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+
+        st.config = Config.from_env()
+        st.mesh = mesh_mod.build_mesh(devices=devices, mesh_shape=mesh_shape)
+
+        cross, local = st.mesh.devices.shape
+        st.size = cross * local
+        st.local_size = local
+        st.cross_size = cross
+
+        # rank = flat index of the first device this process owns.
+        flat = list(st.mesh.devices.flatten())
+        proc = jax.process_index()
+        st.rank = next(
+            (i for i, d in enumerate(flat) if d.process_index == proc), 0
+        )
+        st.local_rank = st.rank % local
+        st.cross_rank = st.rank // local
+
+        st.initialized = True
+        st.shut_down = False
+        log.debug(
+            "initialized: size=%d local=%d cross=%d rank=%d",
+            st.size, st.local_size, st.cross_size, st.rank,
+        )
+
+        if st.config.timeline_file:
+            from horovod_tpu.timeline import Timeline
+
+            st.timeline = Timeline(st.config.timeline_file,
+                                   mark_cycles=st.config.timeline_mark_cycles)
+
+
+def _jax_dist_initialized() -> bool:
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def shutdown() -> None:
+    """Tear down background subsystems and reset state.
+
+    Mirrors ``horovod_shutdown`` (reference: horovod/common/operations.cc):
+    in-flight enqueued tensors receive a shut-down error through their
+    callbacks before the state is reset.
+    """
+    st = state_mod.global_state()
+    with st.lock:
+        if not st.initialized:
+            return
+        st.shut_down = True
+        if st.runtime is not None:
+            st.runtime.stop()
+        if st.timeline is not None:
+            st.timeline.close()
+        from horovod_tpu.ops import collectives
+
+        collectives.clear_compiled_cache()
+    state_mod.reset()
+
+
+atexit.register(shutdown)  # reference: horovod/common/basics.py:40
+
+
+def is_initialized() -> bool:
+    return state_mod.global_state().initialized
+
+
+def rank() -> int:
+    return _ensure_init().rank
+
+
+def size() -> int:
+    return _ensure_init().size
+
+
+def local_rank() -> int:
+    return _ensure_init().local_rank
+
+
+def local_size() -> int:
+    return _ensure_init().local_size
+
+
+def cross_rank() -> int:
+    return _ensure_init().cross_rank
+
+
+def cross_size() -> int:
+    return _ensure_init().cross_size
+
+
+def mesh():
+    """The global (cross, local) device mesh."""
+    return _ensure_init().mesh
+
+
+def is_homogeneous() -> bool:
+    """True when every process owns the same number of devices
+    (reference: mpi_controller.cc:25-81 homogeneity check)."""
+    st = _ensure_init()
+    counts: dict[int, int] = {}
+    for d in st.mesh.devices.flatten():
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return len(set(counts.values())) <= 1
+
+
+# Capability probes, mirroring horovod_*_built/enabled
+# (reference: horovod/common/operations.cc:640-732). The TPU build has no
+# MPI/NCCL/Gloo; its transports are XLA collectives over ICI/DCN.
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def mlsl_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    return True
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False

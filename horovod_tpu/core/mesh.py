@@ -1,0 +1,84 @@
+"""Device-mesh construction: the GLOBAL/LOCAL/CROSS communicator triple.
+
+The reference maintains three communicators — GLOBAL (all ranks), LOCAL
+(ranks on one node, fast intra-node transport) and CROSS (one rank per node,
+inter-node transport) (reference: horovod/common/common.h:105-109,
+mpi/mpi_context.h:78-84). The TPU-native equivalent is a 2-D
+``jax.sharding.Mesh`` whose axes map onto the interconnect hierarchy:
+
+* ``local`` axis — devices reached over ICI (intra-slice / intra-host).
+* ``cross`` axis — hosts/slices reached over DCN.
+* GLOBAL — the flattened pair ``('cross', 'local')``.
+
+Collectives over the GLOBAL communicator are ``lax.psum(..., axis_name=
+('cross', 'local'))``; hierarchical two-level algorithms reduce over
+``local`` first (ICI) then ``cross`` (DCN), mirroring the reference's
+NCCL-then-MPI hierarchical allreduce (reference: ops/nccl_operations.cc:150-346).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.utils import env as env_mod
+
+CROSS_AXIS = "cross"
+LOCAL_AXIS = "local"
+GLOBAL_AXES = (CROSS_AXIS, LOCAL_AXIS)
+
+
+def build_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    mesh_shape: tuple[int, int] | None = None,
+) -> Mesh:
+    """Build the (cross, local) mesh over all devices.
+
+    By default ``cross`` spans processes (DCN) and ``local`` spans the
+    devices owned by each process (ICI) — the same split the reference makes
+    with ``MPI_COMM_TYPE_SHARED`` (reference: mpi/mpi_context.cc). The shape
+    can be overridden with ``HOROVOD_MESH_SHAPE=cross,local`` or the
+    ``mesh_shape`` argument so hierarchical paths are testable on a
+    single-host virtual mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+
+    if mesh_shape is None:
+        mesh_shape = env_mod.parse_mesh_shape(
+            os.environ.get(env_mod.HOROVOD_MESH_SHAPE)
+        )
+    if mesh_shape is None:
+        num_processes = jax.process_count()
+        if n % num_processes == 0 and num_processes > 1:
+            mesh_shape = (num_processes, n // num_processes)
+        else:
+            mesh_shape = (1, n)
+
+    cross, local = mesh_shape
+    if cross * local != n:
+        raise ValueError(
+            f"mesh shape {mesh_shape} does not cover {n} devices"
+        )
+    device_array = np.asarray(devices).reshape(cross, local)
+    return Mesh(device_array, GLOBAL_AXES)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def worker_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding that splits axis 0 across all workers (devices).
+
+    This is the single-controller encoding of "one tensor per worker": a
+    stacked array of shape ``(num_workers, *tensor_shape)`` with axis 0 laid
+    out one slice per device.
+    """
+    return NamedSharding(mesh, P(GLOBAL_AXES))

@@ -1,0 +1,57 @@
+"""Process-global framework state.
+
+TPU-native analogue of ``HorovodGlobalState`` (reference:
+horovod/common/global_state.h:42-112): one singleton owning the mesh, the
+parsed config knobs, the background enqueue runtime, the timeline, the
+autotuner and lifecycle flags. Unlike the reference there is no raw POD /
+pointer soup — components attach lazily and are torn down in ``shutdown()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import TYPE_CHECKING, Any, Optional
+
+from horovod_tpu.utils.env import Config
+
+if TYPE_CHECKING:
+    from jax.sharding import Mesh
+
+
+@dataclasses.dataclass
+class GlobalState:
+    initialized: bool = False
+    shut_down: bool = False
+    mesh: Optional["Mesh"] = None
+
+    # Worker topology (worker == device; see core/basics.py docstring).
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+
+    config: Config = dataclasses.field(default_factory=Config)
+
+    # Lazily attached subsystems (enqueue runtime, timeline, autotuner, ...).
+    runtime: Any = None
+    timeline: Any = None
+    parameter_manager: Any = None
+    controller: Any = None
+
+    lock: threading.RLock = dataclasses.field(default_factory=threading.RLock)
+
+
+_global_state = GlobalState()
+
+
+def global_state() -> GlobalState:
+    return _global_state
+
+
+def reset() -> None:
+    """Replace the singleton with a fresh state (used by shutdown/tests)."""
+    global _global_state
+    _global_state = GlobalState()

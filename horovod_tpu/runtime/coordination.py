@@ -1,0 +1,50 @@
+"""Cross-process coordination primitives over the distributed KV store.
+
+The reference ships objects between processes through its rendezvous KV
+store (reference: horovod/run/rendezvous/http_server.py, gloo HTTPStore
+horovod/common/gloo/http_store.cc). The TPU-native equivalent rides the
+coordination service that ``jax.distributed.initialize`` already
+establishes: a key-value store shared by every process in the job.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import jax
+
+_counter = [0]
+
+
+def _kv_client():
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "cross-process coordination requires jax.distributed to be "
+            "initialized (set HOROVOD_COORDINATOR_ADDR or launch with tpurun)"
+        )
+    return client
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
+                     timeout_ms: int = 60_000):
+    """Broadcast a picklable object from the process owning ``root_rank``
+    to every process (analogue of the reference's rendezvous-store KV
+    exchange; used by ``hvd.broadcast_object``)."""
+    client = _kv_client()
+    if name is None:
+        _counter[0] += 1
+        name = f"_hvd_bcast_{_counter[0]}"
+    key = f"horovod_tpu/{name}"
+    from horovod_tpu.core import state as state_mod
+
+    st = state_mod.global_state()
+    # The process owning the root worker publishes; everyone reads.
+    root_process = root_rank // max(st.local_size, 1)
+    if jax.process_index() == root_process:
+        client.key_value_set(key, pickle.dumps(obj).hex())
+    payload = client.blocking_key_value_get(key, timeout_ms)
+    return pickle.loads(bytes.fromhex(payload))

@@ -1,0 +1,164 @@
+"""Chrome-trace timeline: per-tensor negotiation/operation tracing.
+
+TPU-native equivalent of the reference's Horovod Timeline (reference:
+horovod/common/timeline.cc/.h, docs/timeline.rst:6-21): a JSON trace in the
+Chrome ``chrome://tracing`` "JSON Array" format recording, per named tensor,
+the NEGOTIATE phase (when each worker announced readiness), the top-level
+operation, and nested activities (fusion memcpys, the XLA collective, ...).
+
+Mechanics mirror the reference: the hot path never blocks on file I/O —
+events go into a queue drained by a dedicated writer thread (reference:
+timeline.h:66-75 uses a boost lock-free SPSC queue + writer thread; here a
+``queue.SimpleQueue`` + daemon thread). Each tensor follows the state
+machine UNKNOWN → NEGOTIATING → TOP_LEVEL → ACTIVITY (reference:
+timeline.h:77).
+
+Enable with ``HOROVOD_TIMELINE=/path/to/trace.json``; optional per-cycle
+markers with ``HOROVOD_TIMELINE_MARK_CYCLES`` (reference:
+operations.cc:363-375). Merge with device-side traces via
+``jax.profiler.trace`` separately — this file covers the host-side
+coordination plane, the analogue of the reference's CPU-side events.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Optional
+
+# Activity names (reference: horovod/common/common.h:31-58)
+NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
+NEGOTIATE_ALLGATHER = "NEGOTIATE_ALLGATHER"
+NEGOTIATE_BROADCAST = "NEGOTIATE_BROADCAST"
+ALLREDUCE = "ALLREDUCE"
+ALLGATHER = "ALLGATHER"
+BROADCAST = "BROADCAST"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+XLA_COLLECTIVE = "XLA_COLLECTIVE"
+QUEUE = "QUEUE"
+
+
+class _Writer:
+    """Background writer thread draining an event queue to the trace file
+    (reference: TimelineWriter, timeline.cc:28-127)."""
+
+    _CLOSE = object()
+
+    def __init__(self, path: str):
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._path = path
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._healthy = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hvd-timeline-writer")
+        self._thread.start()
+
+    def enqueue(self, event: dict) -> None:
+        if self._healthy:
+            self._q.put(event)
+
+    def close(self) -> None:
+        self._q.put(self._CLOSE)
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._CLOSE:
+                    break
+                self._file.write(json.dumps(item) + ",\n")
+        finally:
+            # Chrome tracing tolerates a trailing comma with no closing
+            # bracket, but we close the array properly.
+            self._file.write("{}]\n")
+            self._file.close()
+            self._healthy = False
+
+
+class Timeline:
+    """Per-tensor tracing state machine (reference: timeline.h:77-131).
+
+    Thread-safe: enqueue-side state is mutex-guarded; file I/O happens on
+    the writer thread only.
+    """
+
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self._writer = _Writer(path)
+        self._mark_cycles = mark_cycles
+        self._lock = threading.Lock()
+        self._tensor_pids: dict[str, int] = {}
+        self._next_pid = 1
+        self._start_ns = time.monotonic_ns()
+        self._cycle = 0
+
+    # -- helpers -----------------------------------------------------------
+    def _ts_us(self) -> float:
+        return (time.monotonic_ns() - self._start_ns) / 1e3
+
+    def _pid(self, tensor_name: str) -> int:
+        pid = self._tensor_pids.get(tensor_name)
+        if pid is None:
+            pid = self._next_pid
+            self._next_pid += 1
+            self._tensor_pids[tensor_name] = pid
+            self._writer.enqueue({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": tensor_name},
+            })
+        return pid
+
+    def _emit(self, tensor_name: str, ph: str, name: Optional[str] = None,
+              **args) -> None:
+        with self._lock:
+            event = {"ph": ph, "pid": self._pid(tensor_name),
+                     "ts": self._ts_us()}
+            if name:
+                event["name"] = name
+            if args:
+                event["args"] = args
+            self._writer.enqueue(event)
+
+    # -- the reference's Timeline API --------------------------------------
+    def negotiate_start(self, tensor_name: str, request_type: str) -> None:
+        """NEGOTIATING: first worker announced the tensor (reference:
+        timeline.cc NegotiateStart, driven from controller
+        IncrementTensorCount, controller.cc:708-721)."""
+        self._emit(tensor_name, "B", f"NEGOTIATE_{request_type}")
+
+    def negotiate_rank_ready(self, tensor_name: str, rank: int) -> None:
+        self._emit(tensor_name, "i", f"RANK_{rank}_READY")
+
+    def negotiate_end(self, tensor_name: str) -> None:
+        self._emit(tensor_name, "E")
+
+    def start(self, tensor_name: str, op_name: str) -> None:
+        """TOP_LEVEL: the collective began executing."""
+        self._emit(tensor_name, "B", op_name)
+
+    def activity_start(self, tensor_name: str, activity: str) -> None:
+        self._emit(tensor_name, "B", activity)
+
+    def activity_end(self, tensor_name: str) -> None:
+        self._emit(tensor_name, "E")
+
+    def end(self, tensor_name: str, op_name: Optional[str] = None) -> None:
+        self._emit(tensor_name, "E")
+
+    def mark_cycle_start(self) -> None:
+        """Optional per-cycle instant markers (reference: timeline.h:98,
+        HOROVOD_TIMELINE_MARK_CYCLES)."""
+        if self._mark_cycles:
+            with self._lock:
+                self._cycle += 1
+                self._writer.enqueue({
+                    "ph": "i", "pid": 0, "ts": self._ts_us(),
+                    "name": f"CYCLE_{self._cycle}", "s": "g",
+                })
+
+    def close(self) -> None:
+        self._writer.close()

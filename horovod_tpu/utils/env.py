@@ -1,0 +1,141 @@
+"""Environment-variable knob catalog and parsing.
+
+The reference converges three config layers onto environment variables read
+at init (reference: horovod/common/common.h:61-85, operations.cc:363-454,
+utils/env_parser.cc). We keep the same knob names so launcher flags, config
+files and user envs translate 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# Knob names (reference: horovod/common/common.h:61-85 plus gloo/logging).
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
+HOROVOD_MESH_SHAPE = "HOROVOD_MESH_SHAPE"
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
+HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379
+DEFAULT_CYCLE_TIME_MS = 5.0  # reference: operations.cc:386
+DEFAULT_CACHE_CAPACITY = 1024  # reference: global_state.h:88
+
+
+def _get_int(name: str, default: int) -> int:
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    try:
+        return int(value)
+    except ValueError:
+        return default
+
+
+def _get_float(name: str, default: float) -> float:
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    try:
+        return float(value)
+    except ValueError:
+        return default
+
+
+def _get_bool(name: str, default: bool = False) -> bool:
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    return value.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime knobs parsed once at ``hvd.init()``.
+
+    Mirrors the env parsing block in the reference background thread init
+    (reference: horovod/common/operations.cc:363-454).
+    """
+
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    timeline_file: str = ""
+    timeline_mark_cycles: bool = False
+    autotune: bool = False
+    autotune_log: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+    stall_check_disable: bool = False
+    stall_check_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        return cls(
+            fusion_threshold_bytes=_get_int(
+                HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES
+            ),
+            cycle_time_ms=_get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=_get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
+            timeline_file=os.environ.get(HOROVOD_TIMELINE, ""),
+            timeline_mark_cycles=_get_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            autotune=_get_bool(HOROVOD_AUTOTUNE),
+            autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG, ""),
+            autotune_warmup_samples=_get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
+            autotune_steps_per_sample=_get_int(HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10),
+            autotune_bayes_opt_max_samples=_get_int(
+                HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20
+            ),
+            autotune_gaussian_process_noise=_get_float(
+                HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8
+            ),
+            stall_check_disable=_get_bool(HOROVOD_STALL_CHECK_DISABLE),
+            stall_check_time_seconds=_get_float(HOROVOD_STALL_CHECK_TIME_SECONDS, 60.0),
+            stall_shutdown_time_seconds=_get_float(
+                HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0
+            ),
+            hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            hierarchical_allgather=_get_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
+        )
+
+
+def parse_mesh_shape(value: str | None) -> tuple[int, int] | None:
+    """Parse ``HOROVOD_MESH_SHAPE`` of the form "cross,local"."""
+    if not value:
+        return None
+    parts = value.split(",")
+    if len(parts) != 2:
+        raise ValueError(
+            f"{HOROVOD_MESH_SHAPE} must be 'cross,local', got {value!r}"
+        )
+    return int(parts[0]), int(parts[1])

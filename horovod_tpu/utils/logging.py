@@ -1,0 +1,84 @@
+"""Leveled, per-rank-prefixed logging.
+
+TPU-native equivalent of the reference's glog-style C++ logger
+(reference: horovod/common/logging.cc:76-93, logging.h). Level and time
+display are controlled by the same environment variables the reference
+uses: ``HOROVOD_LOG_LEVEL`` (trace|debug|info|warning|error|fatal) and
+``HOROVOD_LOG_HIDE_TIME``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+
+TRACE = 5  # below logging.DEBUG, mirrors the reference's LogLevel::TRACE
+logging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+_lock = threading.Lock()
+_logger: logging.Logger | None = None
+
+
+def _parse_level(value: str | None) -> int:
+    # reference: horovod/common/logging.cc:76-85 (LogLevelStrToEnum)
+    if value is None:
+        return logging.WARNING
+    return _LEVELS.get(value.strip().lower(), logging.WARNING)
+
+
+class _RankFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        from horovod_tpu.core import state
+
+        record.hvd_rank = state.global_state().rank if state.global_state().initialized else -1
+        return True
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    with _lock:
+        if _logger is None:
+            logger = logging.getLogger("horovod_tpu")
+            logger.setLevel(_parse_level(os.environ.get("HOROVOD_LOG_LEVEL")))
+            handler = logging.StreamHandler(sys.stderr)
+            if os.environ.get("HOROVOD_LOG_HIDE_TIME"):
+                fmt = "[%(hvd_rank)s]<%(levelname)s> %(message)s"
+            else:
+                fmt = "%(asctime)s [%(hvd_rank)s]<%(levelname)s> %(message)s"
+            handler.setFormatter(logging.Formatter(fmt))
+            handler.addFilter(_RankFilter())
+            logger.addHandler(handler)
+            logger.propagate = False
+            _logger = logger
+        return _logger
+
+
+def trace(msg: str, *args) -> None:
+    get_logger().log(TRACE, msg, *args)
+
+
+def debug(msg: str, *args) -> None:
+    get_logger().debug(msg, *args)
+
+
+def info(msg: str, *args) -> None:
+    get_logger().info(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    get_logger().warning(msg, *args)
+
+
+def error(msg: str, *args) -> None:
+    get_logger().error(msg, *args)

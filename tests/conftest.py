@@ -1,0 +1,49 @@
+"""Test fixtures: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's "distributed without a cluster" strategy (reference:
+test/ run under ``mpirun -np 2 -H localhost:2``, SURVEY.md §4): collective
+semantics, fusion, caching and error propagation are tested on one host by
+faking the device topology — here with
+``--xla_force_host_platform_device_count=8`` CPU devices instead of
+multiple MPI processes.
+
+NOTE: the environment's sitecustomize force-selects the TPU platform via
+``jax.config.update('jax_platforms', ...)``, so setting ``JAX_PLATFORMS``
+alone is not enough — we re-update the config before any backend is used.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def hvd():
+    """Initialized framework on a 2x4 (cross x local) mesh, torn down after
+    the test so each test sees a fresh world."""
+    import horovod_tpu as hvd_mod
+
+    hvd_mod.shutdown()
+    hvd_mod.init(mesh_shape=(2, 4))
+    yield hvd_mod
+    hvd_mod.shutdown()
+
+
+@pytest.fixture
+def hvd_flat():
+    """Initialized framework on a 1x8 mesh (single-host view)."""
+    import horovod_tpu as hvd_mod
+
+    hvd_mod.shutdown()
+    hvd_mod.init(mesh_shape=(1, 8))
+    yield hvd_mod
+    hvd_mod.shutdown()

@@ -1,0 +1,94 @@
+"""Lifecycle/topology tests.
+
+Mirrors the reference's rank/size ground-truth checks (reference:
+test/test_tensorflow.py:92-107 test_horovod_rank/test_horovod_size).
+"""
+
+import pytest
+
+
+def test_init_size_rank(hvd):
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.local_size() == 4
+    assert hvd.cross_size() == 2
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_rank() == 0
+
+
+def test_double_init_is_noop(hvd):
+    mesh_before = hvd.mesh()
+    hvd.init(mesh_shape=(1, 8))  # ignored: already initialized
+    assert hvd.mesh() is mesh_before
+    assert hvd.size() == 8
+
+
+def test_not_initialized_raises():
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    with pytest.raises(RuntimeError, match="init"):
+        hvd.rank()
+    with pytest.raises(RuntimeError, match="init"):
+        hvd.size()
+
+
+def test_shutdown_and_reinit(hvd):
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    hvd.init(mesh_shape=(1, 8))
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+
+
+def test_mesh_axes(hvd):
+    assert hvd.mesh().axis_names == (hvd.CROSS_AXIS, hvd.LOCAL_AXIS)
+    assert hvd.mesh().devices.shape == (2, 4)
+
+
+def test_mesh_shape_env(monkeypatch):
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_MESH_SHAPE", "4,2")
+    hvd.init()
+    assert hvd.cross_size() == 4
+    assert hvd.local_size() == 2
+    hvd.shutdown()
+
+
+def test_bad_mesh_shape(hvd):
+    hvd.shutdown()
+    with pytest.raises(ValueError, match="does not cover"):
+        hvd.init(mesh_shape=(3, 2))
+
+
+def test_is_homogeneous(hvd):
+    assert hvd.is_homogeneous()
+
+
+def test_built_probes(hvd):
+    # reference: horovod_mpi_built etc. (operations.cc:640-732); the TPU
+    # build's transports are XLA, not MPI/NCCL/Gloo.
+    assert hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.gloo_built()
+    assert not hvd.nccl_built()
+    assert not hvd.mpi_enabled()
+
+
+def test_config_from_env(monkeypatch):
+    import horovod_tpu as hvd
+    from horovod_tpu.core import state
+
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1048576")
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2.5")
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "16")
+    hvd.init(mesh_shape=(1, 8))
+    cfg = state.global_state().config
+    assert cfg.fusion_threshold_bytes == 1048576
+    assert cfg.cycle_time_ms == 2.5
+    assert cfg.cache_capacity == 16
+    hvd.shutdown()

@@ -1,0 +1,282 @@
+"""Collective op tests across dtypes, eager and in-jit.
+
+Mirrors the reference's framework op tests (reference:
+test/test_tensorflow.py — test_horovod_allreduce:109-150, allgather
+variable-size :546-649, error paths :314-384; test/test_torch.py).
+Each test computes the collective and asserts numerical equality against a
+locally computed expectation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+DTYPES = [jnp.float32, jnp.bfloat16, jnp.int32]
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_average(self, hvd, dtype):
+        vals = [np.full((4, 3), i, dtype="float32") for i in range(hvd.size())]
+        x = hvd.stack_per_worker([jnp.asarray(v, dtype=dtype) for v in vals])
+        out = hvd.allreduce(x)  # default average=True
+        expected = np.mean(np.stack(vals), axis=0)
+        np.testing.assert_allclose(np.asarray(out, dtype="float32"), expected,
+                                   rtol=1e-2)
+
+    def test_sum(self, hvd):
+        vals = [np.full((5,), i + 1.0, dtype="float32") for i in range(hvd.size())]
+        x = hvd.stack_per_worker(vals)
+        out = hvd.allreduce(x, average=False)
+        np.testing.assert_allclose(np.asarray(out), np.sum(np.stack(vals), 0))
+
+    def test_min_max_product(self, hvd):
+        vals = [np.full((3,), float(i + 1), dtype="float32") for i in range(hvd.size())]
+        x = hvd.stack_per_worker(vals)
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Min)), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, op=hvd.Max)), float(hvd.size()))
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, op=hvd.Product)),
+            float(np.prod(np.arange(1, hvd.size() + 1))))
+
+    def test_replicated_input(self, hvd):
+        # Every worker holds the same tensor: average is identity, sum
+        # multiplies by size.
+        x = jnp.ones((3, 2))
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x)), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, average=False)), float(hvd.size()))
+
+    def test_average_and_op_conflict(self, hvd):
+        with pytest.raises(ValueError, match="average or op"):
+            hvd.allreduce(jnp.ones(2), average=True, op=hvd.Sum)
+
+    def test_result_replicated(self, hvd):
+        x = hvd.stack_per_worker([np.ones((2, 2), "float32")] * hvd.size())
+        out = hvd.allreduce(x)
+        assert out.sharding.is_fully_replicated
+
+    def test_fp16_compression(self, hvd):
+        vals = [np.full((8,), i / 7.0, dtype="float32") for i in range(hvd.size())]
+        x = hvd.stack_per_worker(vals)
+        out = hvd.allreduce(x, compression=hvd.Compression.fp16)
+        assert out.dtype == jnp.float32  # decompressed back
+        np.testing.assert_allclose(
+            np.asarray(out), np.mean(np.stack(vals), 0), rtol=1e-2)
+
+    def test_grouped(self, hvd):
+        tensors = [
+            hvd.stack_per_worker([np.full((2,), i * (k + 1), "float32")
+                                  for i in range(hvd.size())])
+            for k in range(3)
+        ]
+        outs = hvd.grouped_allreduce(tensors, average=False)
+        for k, out in enumerate(outs):
+            expected = sum(i * (k + 1) for i in range(hvd.size()))
+            np.testing.assert_allclose(np.asarray(out), expected)
+
+
+class TestAllgather:
+    def test_uniform(self, hvd):
+        vals = [np.full((2, 3), i, "float32") for i in range(hvd.size())]
+        out = hvd.allgather(hvd.stack_per_worker(vals))
+        np.testing.assert_allclose(np.asarray(out), np.concatenate(vals, 0))
+        assert out.shape == (2 * hvd.size(), 3)
+
+    def test_ragged(self, hvd):
+        # reference: variable-size allgather (test_tensorflow.py:546-649)
+        vals = [np.full((i + 1, 2), i, "float32") for i in range(hvd.size())]
+        out = hvd.allgather(vals)
+        np.testing.assert_allclose(np.asarray(out), np.concatenate(vals, 0))
+
+    def test_ragged_shape_mismatch_raises(self, hvd):
+        # reference: mismatched non-first dims must error
+        # (test_tensorflow.py:314-384)
+        vals = [np.ones((2, 3), "float32") for _ in range(hvd.size())]
+        vals[1] = np.ones((2, 4), "float32")
+        with pytest.raises(ValueError, match="match in all but the first"):
+            hvd.allgather(vals)
+
+    def test_ragged_wrong_count_raises(self, hvd):
+        with pytest.raises(ValueError, match="one tensor per worker"):
+            hvd.allgather([np.ones((1,), "float32")] * (hvd.size() - 1))
+
+
+class TestBroadcast:
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_broadcast(self, hvd, root):
+        vals = [np.full((4,), i, "float32") for i in range(hvd.size())]
+        out = hvd.broadcast(hvd.stack_per_worker(vals), root_rank=root)
+        np.testing.assert_allclose(np.asarray(out), vals[root])
+
+    def test_bad_root(self, hvd):
+        with pytest.raises(ValueError, match="out of range"):
+            hvd.broadcast(jnp.ones(2), root_rank=99)
+
+    def test_replicated_identity(self, hvd):
+        x = jnp.arange(6.0)
+        np.testing.assert_allclose(np.asarray(hvd.broadcast(x, 0)),
+                                   np.arange(6.0))
+
+
+class TestReducescatter:
+    def test_sum(self, hvd):
+        w = hvd.size()
+        vals = [np.arange(w * 2, dtype="float32") + i for i in range(w)]
+        out = hvd.reducescatter(hvd.stack_per_worker(vals), average=False)
+        full = np.sum(np.stack(vals), 0)
+        np.testing.assert_allclose(
+            np.asarray(out), full.reshape(w, 2))
+
+    def test_indivisible_raises(self, hvd):
+        x = hvd.stack_per_worker(
+            [np.ones((3,), "float32")] * hvd.size())
+        with pytest.raises(ValueError, match="divide evenly"):
+            hvd.reducescatter(x)
+
+
+class TestAlltoall:
+    def test_transpose_blocks(self, hvd):
+        w = hvd.size()
+        # worker i sends value i*w+j to worker j
+        vals = [np.arange(i * w, (i + 1) * w, dtype="float32") for i in range(w)]
+        out = hvd.alltoall(hvd.stack_per_worker(vals))
+        result = np.asarray(out)
+        # worker j receives [i*w+j for all i]
+        for j in range(w):
+            np.testing.assert_allclose(result[j], np.arange(w) * w + j)
+
+
+class TestInJit:
+    """In-jit collectives under shard_map — the hot path."""
+
+    def test_psum_allreduce(self, hvd):
+        mesh = hvd.mesh()
+
+        def f(x):
+            return hvd.allreduce(x, average=False)
+
+        x = jnp.arange(8.0)
+        out = jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=P(hvd.GLOBAL_AXES),
+                          out_specs=P())
+        )(x)
+        np.testing.assert_allclose(np.asarray(out), [28.0])
+
+    def test_pmean_allreduce(self, hvd):
+        mesh = hvd.mesh()
+
+        def f(x):
+            return hvd.allreduce(x)
+
+        x = jnp.arange(8.0)
+        out = jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=P(hvd.GLOBAL_AXES),
+                          out_specs=P())
+        )(x)
+        np.testing.assert_allclose(np.asarray(out), [3.5])
+
+    def test_all_gather(self, hvd):
+        mesh = hvd.mesh()
+
+        def f(x):
+            return hvd.allgather(x)
+
+        x = jnp.arange(8.0)
+        out = jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=P(hvd.GLOBAL_AXES),
+                          out_specs=P(hvd.GLOBAL_AXES))
+        )(x)
+        # every worker holds the full concatenation
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(np.arange(8.0), 8))
+
+    def test_broadcast_in_jit(self, hvd):
+        mesh = hvd.mesh()
+
+        def f(x):
+            return hvd.broadcast(x, root_rank=5)
+
+        x = jnp.arange(8.0)
+        out = jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=P(hvd.GLOBAL_AXES),
+                          out_specs=P())
+        )(x)
+        np.testing.assert_allclose(np.asarray(out), [5.0])
+
+
+class TestInJitEdgeCases:
+    def test_product_with_negatives_and_zeros(self, hvd):
+        mesh = hvd.mesh()
+
+        def f(x):
+            return hvd.allreduce(x, op=hvd.Product)
+
+        run = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(hvd.GLOBAL_AXES),
+                                    out_specs=P()))
+        vals = np.array([-2.0, 3.0, 1.0, -1.0, 2.0, 1.0, 1.0, 1.0], "float32")
+        np.testing.assert_allclose(np.asarray(run(jnp.asarray(vals))),
+                                   [np.prod(vals)], rtol=1e-5)
+        vals_zero = np.array([-2.0, 0.0, 3.0, 1.0, 1.0, 1.0, 1.0, 1.0], "float32")
+        np.testing.assert_allclose(np.asarray(run(jnp.asarray(vals_zero))),
+                                   [0.0])
+
+    def test_reducescatter_average_subaxis(self, hvd):
+        # average over the 'local' axis only must divide by local_size (4),
+        # not the global size (8).
+        mesh = hvd.mesh()
+
+        def f(x):
+            return hvd.reducescatter(x, average=True, axis_name=hvd.LOCAL_AXIS)
+
+        x = jnp.ones((32,))  # per-device (4,) after sharding
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh,
+            in_specs=P(hvd.GLOBAL_AXES),
+            out_specs=P(hvd.GLOBAL_AXES)))(x)
+        # sum over 4 local devices = 4.0; averaging must divide by 4 -> 1.0
+        np.testing.assert_allclose(np.asarray(out), np.ones((8,)))
+
+
+class TestRankGuards:
+    def test_allgather_scalar_per_worker_raises(self, hvd):
+        x = hvd.stack_per_worker(np.arange(8, dtype="float32"))
+        with pytest.raises(ValueError, match="rank >= 1"):
+            hvd.allgather(x)
+
+    def test_alltoall_scalar_per_worker_raises(self, hvd):
+        x = hvd.stack_per_worker(np.arange(8, dtype="float32"))
+        with pytest.raises(ValueError, match="rank >= 2"):
+            hvd.alltoall(x)
+
+    def test_reducescatter_scalar_per_worker_raises(self, hvd):
+        x = hvd.stack_per_worker(np.arange(8, dtype="float32"))
+        with pytest.raises(ValueError, match="rank >= 2"):
+            hvd.reducescatter(x)
+
+
+class TestAsyncHandles:
+    """reference: horovod/torch/mpi_ops.py poll/synchronize (:93-124)."""
+
+    def test_allreduce_async(self, hvd):
+        vals = [np.full((4,), i, "float32") for i in range(hvd.size())]
+        handle = hvd.allreduce_async(hvd.stack_per_worker(vals), average=False)
+        out = hvd.synchronize(handle)
+        np.testing.assert_allclose(np.asarray(out), np.sum(np.stack(vals), 0))
+        assert hvd.poll(handle)
+
+    def test_multiple_in_flight(self, hvd):
+        handles = [
+            hvd.allreduce_async(
+                hvd.stack_per_worker(
+                    [np.full((2,), i * k, "float32") for i in range(hvd.size())]),
+                average=False)
+            for k in range(5)
+        ]
+        for k, h in enumerate(handles):
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(h)),
+                sum(i * k for i in range(hvd.size())))

@@ -1,0 +1,55 @@
+"""Timeline tests (reference: test/test_timeline.py — runs with
+HOROVOD_TIMELINE set and asserts valid JSON with negotiate/op phases)."""
+
+import json
+
+from horovod_tpu.timeline import Timeline
+
+
+def test_timeline_writes_valid_json(tmp_path):
+    path = str(tmp_path / "trace.json")
+    tl = Timeline(path)
+    tl.negotiate_start("grad_0", "ALLREDUCE")
+    tl.negotiate_rank_ready("grad_0", 0)
+    tl.negotiate_rank_ready("grad_0", 1)
+    tl.negotiate_end("grad_0")
+    tl.start("grad_0", "ALLREDUCE")
+    tl.activity_start("grad_0", "XLA_COLLECTIVE")
+    tl.activity_end("grad_0")
+    tl.end("grad_0")
+    tl.close()
+
+    events = json.load(open(path))
+    names = [e.get("name") for e in events]
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "ALLREDUCE" in names
+    assert "XLA_COLLECTIVE" in names
+    assert "RANK_0_READY" in names
+    # metadata event naming the tensor's pseudo-process
+    meta = [e for e in events if e.get("ph") == "M"]
+    assert meta and meta[0]["args"]["name"] == "grad_0"
+
+
+def test_timeline_cycle_markers(tmp_path):
+    path = str(tmp_path / "trace.json")
+    tl = Timeline(path, mark_cycles=True)
+    tl.mark_cycle_start()
+    tl.mark_cycle_start()
+    tl.close()
+    events = json.load(open(path))
+    cycles = [e for e in events if str(e.get("name", "")).startswith("CYCLE_")]
+    assert len(cycles) == 2
+
+
+def test_timeline_via_init_env(tmp_path, monkeypatch):
+    import horovod_tpu as hvd
+    from horovod_tpu.core import state
+
+    hvd.shutdown()
+    path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", path)
+    hvd.init(mesh_shape=(1, 8))
+    assert state.global_state().timeline is not None
+    hvd.shutdown()
+    events = json.load(open(path))
+    assert isinstance(events, list)
