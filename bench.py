@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 training benchmark — the headline perf harness.
+
+TPU-native port of the reference's measurement harness (reference:
+examples/pytorch_synthetic_benchmark.py:37-110,
+examples/tensorflow2_synthetic_benchmark.py:72-132): ResNet-50 forward +
+backward + optimizer update on synthetic ImageNet-shaped data; 10 warmup
+batches, then 10 timed iterations of 10 batches each; reports images/sec.
+
+Baseline for ``vs_baseline``: the reference's only published absolute
+number — 1656.82 images/sec on 16 GPUs (ResNet-101, batch 64, 4xP100
+servers; reference: docs/benchmarks.rst:32-43) = 103.55 images/sec/GPU.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import horovod_tpu as hvd
+from horovod_tpu.models.resnet import ResNet50
+from horovod_tpu import training
+
+REFERENCE_IMAGES_PER_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:32-43
+
+BATCH_PER_CHIP = int(os.environ.get("BENCH_BATCH", "128"))
+IMAGE_SIZE = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+WARMUP_ITERS = int(os.environ.get("BENCH_WARMUP", "10"))
+TIMED_ROUNDS = int(os.environ.get("BENCH_ROUNDS", "10"))
+BATCHES_PER_ROUND = int(os.environ.get("BENCH_BATCHES_PER_ROUND", "10"))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    hvd.init()
+    n_chips = hvd.size()
+    global_batch = BATCH_PER_CHIP * n_chips
+    log(f"devices: {jax.devices()}  global_batch={global_batch}")
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    optimizer = hvd.DistributedOptimizer(
+        optax.sgd(0.01 * n_chips, momentum=0.9))
+
+    state = training.create_train_state(
+        model, optimizer, (1, IMAGE_SIZE, IMAGE_SIZE, 3))
+    step, batch_sharding = training.make_train_step(model, optimizer)
+
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        rng.uniform(-1, 1, (global_batch, IMAGE_SIZE, IMAGE_SIZE, 3)).astype(np.float32),
+        batch_sharding)
+    labels = jax.device_put(
+        rng.randint(0, 1000, (global_batch,)).astype(np.int32),
+        batch_sharding)
+
+    params, stats, opt_state = state.params, state.batch_stats, state.opt_state
+
+    log("compiling + warmup...")
+    t0 = time.perf_counter()
+    for _ in range(WARMUP_ITERS):
+        loss, params, stats, opt_state = step(params, stats, opt_state,
+                                              images, labels)
+    jax.block_until_ready(loss)
+    log(f"warmup done in {time.perf_counter() - t0:.1f}s "
+        f"(loss={float(loss):.3f})")
+
+    rates = []
+    for r in range(TIMED_ROUNDS):
+        t0 = time.perf_counter()
+        for _ in range(BATCHES_PER_ROUND):
+            loss, params, stats, opt_state = step(params, stats, opt_state,
+                                                  images, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rates.append(global_batch * BATCHES_PER_ROUND / dt)
+        log(f"round {r}: {rates[-1]:.1f} img/s")
+
+    imgs_per_sec = float(np.mean(rates))
+    per_chip = imgs_per_sec / n_chips
+    result = {
+        "metric": "images/sec/chip (ResNet-50 synthetic, bf16, "
+                  f"batch {BATCH_PER_CHIP}/chip)",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

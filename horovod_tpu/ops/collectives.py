@@ -99,12 +99,16 @@ def stack_per_worker(values) -> jax.Array:
 
 def _is_worker_stacked(x) -> bool:
     """True if ``x`` is a jax array whose axis 0 is sharded across workers
-    (the ``stack_per_worker`` layout)."""
+    (the ``stack_per_worker`` layout).
+
+    Detection is purely by sharding spec — including on a 1-device mesh,
+    where ``stack_per_worker`` still attaches the worker PartitionSpec, so a
+    user array that merely happens to have leading dim == size is never
+    silently squeezed.
+    """
     st = state_mod.global_state()
     if not isinstance(x, jax.Array) or x.ndim < 1 or x.shape[0] != st.size:
         return False
-    if st.size == 1:
-        return True
     sharding = x.sharding
     spec = getattr(sharding, "spec", None)
     if spec is None or len(spec) == 0:
@@ -390,7 +394,20 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None, axis_name=None
     x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
     if _is_worker_stacked(x):
         return _bcast_stacked_fn(st.mesh, root_rank)(x)
-    return x  # replicated: already everywhere
+    if jax.process_count() > 1 and not (
+            isinstance(x, jax.Array) and x.sharding.is_fully_replicated
+            and len(x.sharding.device_set) == st.size):
+        # Multi-process with process-local data: a real collective so the
+        # root's value becomes authoritative everywhere (the reference's
+        # MPI_Bcast role in checkpoint restore, torch/__init__.py:255-403).
+        local = np.broadcast_to(
+            np.asarray(x)[None], (st.local_size,) + np.shape(x)).copy()
+        stacked = jax.make_array_from_process_local_data(
+            mesh_mod.worker_sharding(st.mesh), local)
+        return _bcast_stacked_fn(st.mesh, root_rank)(stacked)
+    # Single-controller: values are already globally consistent; force the
+    # replicated layout over the mesh so downstream steps see it.
+    return jax.device_put(x, _replicated(st.mesh))
 
 
 def reducescatter(tensor, average: Optional[bool] = None, op: Optional[int] = None,

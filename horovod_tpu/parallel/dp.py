@@ -126,32 +126,42 @@ def DistributedGradientTape(
     compression=Compression.none,
     average: bool = True,
     axis_name=None,
+    returns: str = "grads",
 ) -> Callable[..., Any]:
     """Wrap a gradient-producing function so its gradients are allreduced.
 
     JAX has no tape; the analogue of wrapping ``tf.GradientTape``
     (reference: horovod/tensorflow/__init__.py:323-376) is wrapping the
-    function returned by ``jax.grad``/``jax.value_and_grad``:
+    function returned by ``jax.grad``/``jax.value_and_grad``. Because a
+    2-tuple output is ambiguous (grads-over-tuple-params vs (value, grads)
+    vs (grads, aux)), the convention is stated explicitly:
 
-        grads_fn = hvd.DistributedGradientTape(jax.grad(loss_fn))
-        grads = grads_fn(params, batch)
-
-    Works with ``jax.value_and_grad`` too: ``(aux, grads)`` outputs have
-    only the gradient pytree reduced.
+    * ``returns="grads"`` (default) — the whole output is the gradient
+      pytree (``jax.grad(f)``, including tuple params).
+    * ``returns="value_and_grads"`` — output is ``(value, grads)``
+      (``jax.value_and_grad(f)``; value may itself be ``(loss, aux)``).
+    * ``returns="grads_and_aux"`` — output is ``(grads, aux)``
+      (``jax.grad(f, has_aux=True)``).
     """
+    if returns not in ("grads", "value_and_grads", "grads_and_aux"):
+        raise ValueError(
+            "returns must be 'grads', 'value_and_grads' or 'grads_and_aux', "
+            f"got {returns!r}")
+
+    def reduce(grads):
+        return allreduce_gradients(
+            grads, average=average, compression=compression,
+            axis_name=axis_name)
 
     def wrapped(*args, **kwargs):
         out = grad_fn(*args, **kwargs)
-        if isinstance(out, tuple) and len(out) == 2:
-            aux, grads = out
-            return aux, allreduce_gradients(
-                grads, average=average, compression=compression,
-                axis_name=axis_name,
-            )
-        return allreduce_gradients(
-            out, average=average, compression=compression,
-            axis_name=axis_name,
-        )
+        if returns == "value_and_grads":
+            value, grads = out
+            return value, reduce(grads)
+        if returns == "grads_and_aux":
+            grads, aux = out
+            return reduce(grads), aux
+        return reduce(out)
 
     return wrapped
 

@@ -1,0 +1,99 @@
+"""Reusable training-step construction for the example/benchmark workloads.
+
+The reference's examples all follow one pattern (reference: SURVEY.md §2.8,
+examples/pytorch_synthetic_benchmark.py:37-100): init → scale LR by size →
+wrap optimizer → broadcast initial state → step loop. This module packages
+that pattern for flax models so the benchmark harness, the graft entry
+point, and the examples share one implementation.
+
+Two SPMD styles are supported, matching ``DistributedOptimizer``:
+
+* ``global-batch`` (default): the step is ``jit``-compiled over the global
+  mesh with the batch sharded along ``(cross, local)``; XLA inserts the
+  gradient all-reduce from the shardings. This is the TPU-idiomatic hot
+  path.
+* ``shard_map``: explicit per-device microbatches with the wrapper's
+  ``lax.pmean`` — semantically identical, useful when per-device code is
+  needed (e.g. sequence parallelism).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.core import basics, mesh as mesh_mod
+from horovod_tpu.parallel import dp
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: int = 0
+
+
+def create_train_state(model, optimizer, input_shape,
+                       rng: Optional[jax.Array] = None,
+                       broadcast: bool = True) -> TrainState:
+    """Initialize model + optimizer state and broadcast from rank 0
+    (the reference's init convention, reference: examples/*.py)."""
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros(input_shape), train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    if broadcast:
+        params = dp.broadcast_parameters(params)
+        batch_stats = dp.broadcast_parameters(batch_stats)
+    opt_state = optimizer.init(params)
+    return TrainState(params=params, batch_stats=batch_stats,
+                      opt_state=opt_state)
+
+
+def make_train_step(model, optimizer,
+                    loss_fn: Optional[Callable] = None,
+                    donate: bool = True):
+    """Build a jitted global-batch DP train step.
+
+    The returned function has signature
+    ``step(params, batch_stats, opt_state, images, labels) ->
+    (loss, params, batch_stats, opt_state)`` and is compiled over the
+    global mesh with inputs batch-sharded; gradient averaging across
+    workers falls out of the shardings (see ``parallel/dp.py``).
+    """
+    st = basics._ensure_init()
+    mesh = st.mesh
+    batch_sharding = NamedSharding(mesh, P(mesh_mod.GLOBAL_AXES))
+    repl = NamedSharding(mesh, P())
+
+    if loss_fn is None:
+        def loss_fn(logits, labels):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+    def step(params, batch_stats, opt_state, images, labels):
+        def compute(params):
+            outputs, updates = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            return loss_fn(outputs, labels), updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            compute, has_aux=True)(params)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return loss, new_params, new_stats, new_opt_state
+
+    return jax.jit(
+        step,
+        in_shardings=(repl, repl, repl, batch_sharding, batch_sharding),
+        out_shardings=(repl, repl, repl, repl),
+        donate_argnums=(0, 1, 2) if donate else (),
+    ), batch_sharding

@@ -258,6 +258,38 @@ class TestRankGuards:
             hvd.reducescatter(x)
 
 
+class TestSingleWorkerSemantics:
+    """A 1-device world must not squeeze user arrays whose leading dim
+    happens to equal size (regression for the size==1 stacked ambiguity)."""
+
+    def test_leading_dim_one_preserved(self):
+        import jax as _jax
+        import horovod_tpu as hvd
+
+        hvd.shutdown()
+        hvd.init(devices=_jax.devices()[:1], mesh_shape=(1, 1))
+        assert hvd.size() == 1
+        x = jnp.ones((1, 5))
+        out = hvd.allreduce(x)
+        assert out.shape == (1, 5)
+        out_b = hvd.broadcast(jnp.ones((1, 4)), root_rank=0)
+        assert out_b.shape == (1, 4)
+        # explicit stacked encoding still reduces away the worker axis
+        stacked = hvd.stack_per_worker(jnp.ones((1, 3)))
+        assert hvd.allreduce(stacked).shape == (3,)
+        hvd.shutdown()
+
+
+class TestBroadcastReplication:
+    def test_broadcast_forces_replicated_layout(self, hvd):
+        # non-stacked input gets the replicated mesh sharding, honoring the
+        # broadcast_parameters contract
+        x = jnp.ones((4, 2))
+        out = hvd.broadcast(x, root_rank=0)
+        assert out.sharding.is_fully_replicated
+        assert len(out.sharding.device_set) == 8
+
+
 class TestAsyncHandles:
     """reference: horovod/torch/mpi_ops.py poll/synchronize (:93-124)."""
 
