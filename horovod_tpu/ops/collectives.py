@@ -506,15 +506,45 @@ class Handle:
 
 def allreduce_async(tensor, average=None, name=None, op=None,
                     compression=Compression.none):
+    """Async allreduce. With a ``name``, the tensor enters the dynamic
+    enqueue runtime — per-tensor negotiation, response cache and tensor
+    fusion, the reference's core execution model (reference:
+    operations.cc:736-768 EnqueueTensorAllreduce). Unnamed tensors dispatch
+    immediately (XLA's async dispatch already overlaps)."""
+    if name is not None:
+        red_op = _resolve_op(average, op)
+        if red_op not in (Average, Sum):
+            raise ValueError("named (runtime) allreduce supports "
+                             "sum/average only")
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        x, ctx = compression.compress(
+            tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor))
+        handle = get_runtime().enqueue_allreduce(
+            name, x, average=(red_op == Average))
+        handle._decompress = (compression, ctx)  # applied in synchronize()
+        return handle
     return Handle(allreduce(tensor, average=average, op=op,
                             compression=compression))
 
 
 def allgather_async(tensor, name=None):
+    if name is not None:
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        return get_runtime().enqueue_allgather(
+            name, tensor if isinstance(tensor, jax.Array)
+            else jnp.asarray(tensor))
     return Handle(allgather(tensor))
 
 
 def broadcast_async(tensor, root_rank, name=None):
+    if name is not None:
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        return get_runtime().enqueue_broadcast(
+            name, tensor if isinstance(tensor, jax.Array)
+            else jnp.asarray(tensor), root_rank)
     return Handle(broadcast(tensor, root_rank))
 
 
@@ -524,7 +554,13 @@ def poll(handle: Handle) -> bool:
     return handle.poll()
 
 
-def synchronize(handle: Handle):
+def synchronize(handle):
     """Block until the collective completes and return its result
-    (reference: horovod/torch/mpi_ops.py:107-124)."""
-    return handle.wait()
+    (reference: horovod/torch/mpi_ops.py:107-124). Accepts both immediate
+    handles and runtime handles."""
+    out = handle.wait()
+    decompress = getattr(handle, "_decompress", None)
+    if decompress is not None and out is not None:
+        compression, ctx = decompress
+        out = compression.decompress(out, ctx)
+    return out

@@ -1,0 +1,307 @@
+"""Controller: the coordination plane for dynamic per-tensor negotiation.
+
+TPU-native analogue of the reference's rank-0-coordinator protocol
+(reference: horovod/common/controller.cc/.h — the protocol is documented at
+controller.h:62-96): every cycle, each worker announces which named tensors
+it has enqueued; the coordinator determines which tensors are ready on ALL
+workers, validates their metadata matches, fuses them into batched
+responses, and broadcasts the final ordered response list that every worker
+then executes identically. This is what lets callers enqueue tensors in
+different orders on different workers and still execute collectives in one
+agreed order.
+
+Transport verbs are abstract (reference: controller.h:34-124 ``Bcast``,
+``RecvReadyTensors``, ``CrossRankBitwiseAnd/Or``):
+
+* ``LocalController`` — single-process (all workers are local devices):
+  negotiation is trivially satisfied; the cache/fusion machinery still runs
+  so that steady-state behavior (fast path, bin-packing) is identical.
+* ``SocketController`` (runtime/socket_controller.py) — one process per
+  host over TCP, the analogue of the reference's Gloo controller.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.runtime import fusion
+from horovod_tpu.runtime import message as msg
+from horovod_tpu.runtime import types
+from horovod_tpu.runtime.response_cache import (CacheCoordinator, CacheState,
+                                                ResponseCache)
+from horovod_tpu.utils import logging as log
+
+
+class MessageTable:
+    """name -> requests received so far (reference: MessageTable +
+    IncrementTensorCount, controller.cc:700-723)."""
+
+    def __init__(self):
+        self._table: Dict[str, List[msg.Request]] = {}
+
+    def increment(self, request: msg.Request, world: int) -> bool:
+        """Record one worker's announcement; True when all workers have
+        announced this tensor."""
+        reqs = self._table.setdefault(request.tensor_name, [])
+        reqs.append(request)
+        return len(reqs) == world
+
+    def pop(self, name: str) -> List[msg.Request]:
+        return self._table.pop(name, [])
+
+    def pending(self) -> Dict[str, List[msg.Request]]:
+        return self._table
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+
+def construct_response(requests: List[msg.Request]) -> msg.Response:
+    """Validate that every worker announced compatible metadata and build
+    the verdict (reference: ConstructResponse, controller.cc:320-522 —
+    mismatched dtype/shape/root across ranks becomes an ERROR response that
+    surfaces as an exception on every worker)."""
+    first = requests[0]
+    name = first.tensor_name
+
+    for r in requests[1:]:
+        if r.request_type != first.request_type:
+            return msg.Response(
+                types.ERROR, [name],
+                f"Mismatched collective operations: one worker requested "
+                f"{first.request_type.lower()}, another requested "
+                f"{r.request_type.lower()}.")
+        if r.dtype != first.dtype:
+            return msg.Response(
+                types.ERROR, [name],
+                f"Mismatched data types: one worker sent {first.dtype}, "
+                f"another sent {r.dtype}.")
+
+    if first.request_type == types.ALLREDUCE:
+        for r in requests[1:]:
+            if r.shape != first.shape:
+                return msg.Response(
+                    types.ERROR, [name],
+                    f"Mismatched allreduce tensor shapes: {first.shape} vs "
+                    f"{r.shape}.")
+            if r.average != first.average:
+                return msg.Response(
+                    types.ERROR, [name],
+                    "Mismatched allreduce reduction ops across workers.")
+        return msg.Response(types.ALLREDUCE, [name])
+
+    if first.request_type == types.ALLGATHER:
+        for r in requests[1:]:
+            if len(r.shape) != len(first.shape) or r.shape[1:] != first.shape[1:]:
+                return msg.Response(
+                    types.ERROR, [name],
+                    f"Mismatched allgather tensor shapes: all dimensions "
+                    f"except the first must match ({first.shape} vs "
+                    f"{r.shape}).")
+        # per-rank first-dim sizes, in rank order (reference:
+        # controller.cc allgather recvcounts)
+        by_rank = sorted(requests, key=lambda r: r.rank)
+        sizes = [r.shape[0] if r.shape else 1 for r in by_rank]
+        return msg.Response(types.ALLGATHER, [name], tensor_sizes=sizes)
+
+    if first.request_type == types.BROADCAST:
+        for r in requests[1:]:
+            if r.root_rank != first.root_rank:
+                return msg.Response(
+                    types.ERROR, [name],
+                    f"Mismatched broadcast root ranks: {first.root_rank} vs "
+                    f"{r.root_rank}.")
+            if r.shape != first.shape:
+                return msg.Response(
+                    types.ERROR, [name],
+                    f"Mismatched broadcast tensor shapes: {first.shape} vs "
+                    f"{r.shape}.")
+        return msg.Response(types.BROADCAST, [name])
+
+    return msg.Response(types.ERROR, [name],
+                        f"Unknown request type {first.request_type}.")
+
+
+class Controller:
+    """Base negotiation engine over abstract transport verbs."""
+
+    def __init__(self, rank: int, world: int, cache_capacity: int = 1024):
+        self.rank = rank
+        self.world = world
+        self.cache = ResponseCache(cache_capacity)
+        self.message_table = MessageTable()  # coordinator only
+        self._should_shut_down = False
+        # requests seen this cycle, for fusion byte accounting + cache put
+        self._cycle_requests: Dict[str, msg.Request] = {}
+
+    # -- transport verbs (reference: controller.h:98-124) ------------------
+    def sync_bitvectors(self, bits: int) -> Tuple[int, int]:
+        """Return (AND-reduced, OR-reduced) bitvectors across workers
+        (reference: CrossRankBitwiseAnd/Or)."""
+        raise NotImplementedError
+
+    def send_ready_tensors(self, requests: List[msg.Request]
+                           ) -> Optional[List[List[msg.Request]]]:
+        """Workers send their ready lists; on the coordinator this returns
+        every worker's list (reference: RecvReadyTensors / SendReadyTensors)."""
+        raise NotImplementedError
+
+    def bcast_responses(self, responses: Optional[List[msg.Response]]
+                        ) -> List[msg.Response]:
+        """Coordinator broadcasts the final list; workers receive it
+        (reference: SendFinalTensors / RecvFinalTensors)."""
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.rank == 0
+
+    def request_shutdown(self) -> None:
+        self._should_shut_down = True
+
+    # -- the cycle (reference: ComputeResponseList, controller.cc:54-298) --
+    def compute_response_list(
+        self, requests: List[msg.Request], fusion_threshold: int,
+        timeline=None, stall_inspector=None,
+    ) -> Tuple[List[msg.Response], bool]:
+        """Returns (responses_to_execute, should_shut_down)."""
+        coordinator = CacheCoordinator()
+        hit_bits: List[int] = []
+        uncached: List[msg.Request] = []
+
+        for r in requests:
+            self._cycle_requests[r.tensor_name] = r
+            state = self.cache.cached(r)
+            if state == CacheState.HIT:
+                bit = self.cache.bit_for_name(r.tensor_name)
+                coordinator.record_hit(bit)
+                hit_bits.append(bit)
+            else:
+                if state == CacheState.INVALID:
+                    self.cache.invalidate(r.tensor_name)
+                    coordinator.set_invalid_in_queue()
+                coordinator.set_uncached_in_queue()
+                uncached.append(r)
+
+        if self._should_shut_down:
+            coordinator.set_should_shut_down()
+
+        anded, ored = self.sync_bitvectors(coordinator.bitvector)
+        shut_down, any_uncached, _ = CacheCoordinator.flags(ored)
+
+        responses: List[msg.Response] = []
+
+        common_bits = set(CacheCoordinator.common_hits(anded))
+        # Hits not common to all workers stay queued for later cycles:
+        # their requests were already recorded; re-enqueue them next cycle.
+        deferred = [b for b in hit_bits if b not in common_bits]
+
+        if not any_uncached:
+            # FAST PATH (reference: controller.cc:151-179): everything
+            # queued everywhere is cached — responses straight from cache.
+            for bit in sorted(common_bits):
+                resp = self.cache.get_by_bit(bit)
+                if resp is not None:
+                    responses.append(resp)
+            fused = fusion.fuse_responses(responses, self._cycle_requests,
+                                          fusion_threshold)
+            self._gc_cycle_requests(fused, deferred)
+            return fused, shut_down
+
+        # SLOW PATH: full negotiation for uncached tensors; common cache
+        # hits still execute this cycle from the cache.
+        for bit in sorted(common_bits):
+            resp = self.cache.get_by_bit(bit)
+            if resp is not None:
+                responses.append(resp)
+
+        gathered = self.send_ready_tensors(uncached)
+        final: Optional[List[msg.Response]] = None
+        if self.is_coordinator:
+            assert gathered is not None
+            ready_names: List[str] = []
+            for worker_requests in gathered:
+                for r in worker_requests:
+                    if timeline is not None:
+                        if r.tensor_name not in self.message_table.pending():
+                            timeline.negotiate_start(r.tensor_name,
+                                                     r.request_type)
+                        timeline.negotiate_rank_ready(r.tensor_name, r.rank)
+                    if self.message_table.increment(r, self.world):
+                        ready_names.append(r.tensor_name)
+            if stall_inspector is not None:
+                shut_down = stall_inspector.check(
+                    self.message_table, self.cache,
+                    world=self.world) or shut_down
+            negotiated: List[msg.Response] = []
+            for name in ready_names:
+                reqs = self.message_table.pop(name)
+                if timeline is not None:
+                    timeline.negotiate_end(name)
+                negotiated.append(construct_response(reqs))
+            final = responses + negotiated
+
+        agreed = self.bcast_responses(final)
+        # cache puts for newly negotiated single-tensor responses
+        for resp in agreed:
+            if resp.response_type == types.ERROR:
+                continue
+            for name in resp.tensor_names:
+                req = self._cycle_requests.get(name)
+                if req is not None and self.cache.cached(req) != CacheState.HIT:
+                    self.cache.put(
+                        msg.Response(resp.response_type, [name],
+                                     tensor_sizes=resp.tensor_sizes), req)
+
+        fused = fusion.fuse_responses(agreed, self._cycle_requests,
+                                      fusion_threshold)
+        self._gc_cycle_requests(fused, deferred)
+        return fused, shut_down
+
+    def _gc_cycle_requests(self, executed: List[msg.Response],
+                           deferred_bits: List[int]) -> None:
+        keep = set()
+        for bit in deferred_bits:
+            resp = self.cache.get_by_bit(bit)
+            if resp is not None:
+                keep.update(resp.tensor_names)
+        executed_names = {n for r in executed for n in r.tensor_names}
+        self._cycle_requests = {
+            k: v for k, v in self._cycle_requests.items()
+            if k in keep and k not in executed_names
+        }
+
+    def take_deferred(self) -> List[msg.Request]:
+        """Drain tensors announced but not yet agreed (cache hits not yet
+        common to all workers) so the cycle loop RE-ANNOUNCES them with the
+        new cycle's requests — without this they would hang forever on
+        workers that announced early."""
+        out = list(self._cycle_requests.values())
+        self._cycle_requests = {}
+        return out
+
+    def has_deferred(self) -> bool:
+        return bool(self._cycle_requests)
+
+
+class LocalController(Controller):
+    """Single-process controller: every enqueued tensor is trivially ready
+    on all workers (they share the process); negotiation verbs are
+    identities. The cache/fusion path is identical to the distributed
+    controllers so tests of fast-path/fusion semantics transfer."""
+
+    def sync_bitvectors(self, bits: int) -> Tuple[int, int]:
+        return bits, bits
+
+    def send_ready_tensors(self, requests):
+        return [requests]
+
+    def bcast_responses(self, responses):
+        assert responses is not None
+        return responses
+
+    def barrier(self) -> None:
+        pass

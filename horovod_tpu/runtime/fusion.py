@@ -1,0 +1,87 @@
+"""Tensor fusion: bin-packing responses under the fusion threshold.
+
+TPU-native analogue of the reference's ``FuseResponses`` (reference:
+horovod/common/controller.cc:551-672) and the fusion-buffer design
+(reference: fusion_buffer_manager.cc, docs/tensor-fusion.rst:9-17): many
+small tensors become one collective over a single fused buffer, trading a
+little packing work for far fewer collective launches.
+
+On TPU the "buffer" is not a persistent allocation we memcpy into — the
+fused pack/reduce/unpack is one XLA program (concat → psum → split) that
+XLA lays out in HBM itself; what survives from the reference is the
+*batching decision*: which responses fuse, bounded by
+``HOROVOD_FUSION_THRESHOLD`` bytes, with look-ahead past dtype mismatches
+(reference: controller.cc:595-650).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from horovod_tpu.runtime import message as msg
+from horovod_tpu.runtime import types
+
+
+def _dtype_size(dtype: str) -> int:
+    return np.dtype(dtype if dtype != "bfloat16" else "uint16").itemsize
+
+
+def response_bytes(response: msg.Response,
+                   request_by_name: Dict[str, msg.Request]) -> int:
+    total = 0
+    for name in response.tensor_names:
+        req = request_by_name[name]
+        total += int(np.prod(req.shape, dtype=np.int64)) * _dtype_size(req.dtype)
+    return total
+
+
+def _fusable(a: msg.Response, b: msg.Response,
+             request_by_name: Dict[str, msg.Request]) -> bool:
+    """Same response type + same dtype + same reduction params
+    (reference: controller.cc:560-585 join conditions)."""
+    if a.response_type != b.response_type:
+        return False
+    if a.response_type not in (types.ALLREDUCE,):
+        # allgather fusion requires offset bookkeeping the eager TPU path
+        # does not benefit from (one XLA program per gather already);
+        # broadcast responses never fuse in the reference either.
+        return False
+    ra = request_by_name[a.tensor_names[0]]
+    rb = request_by_name[b.tensor_names[0]]
+    return (ra.dtype == rb.dtype and ra.average == rb.average)
+
+
+def fuse_responses(responses: List[msg.Response],
+                   request_by_name: Dict[str, msg.Request],
+                   threshold_bytes: int) -> List[msg.Response]:
+    """Greedy bin-packing with look-ahead (reference: controller.cc:551-672).
+
+    Walk the response list; accumulate joinable responses into the current
+    fused response while the byte total stays under ``threshold_bytes``.
+    Non-joinable responses are *skipped over* (look-ahead) rather than
+    flushing the bin, so a stray fp32 tensor between bf16 gradients does
+    not break the bf16 bin — then form later bins from the skipped ones.
+    """
+    remaining = list(responses)
+    fused: List[msg.Response] = []
+    while remaining:
+        head = remaining.pop(0)
+        if head.response_type != types.ALLREDUCE:
+            fused.append(head)
+            continue
+        acc_names = list(head.tensor_names)
+        acc_bytes = response_bytes(head, request_by_name)
+        skipped: List[msg.Response] = []
+        for cand in remaining:
+            if _fusable(head, cand, request_by_name):
+                nbytes = response_bytes(cand, request_by_name)
+                if acc_bytes + nbytes <= threshold_bytes:
+                    acc_names.extend(cand.tensor_names)
+                    acc_bytes += nbytes
+                    continue
+            skipped.append(cand)
+        remaining = skipped
+        fused.append(msg.Response(types.ALLREDUCE, acc_names))
+    return fused

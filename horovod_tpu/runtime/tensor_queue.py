@@ -1,0 +1,81 @@
+"""Thread-safe named-tensor queue.
+
+TPU-native analogue of the reference's ``TensorQueue`` (reference:
+horovod/common/tensor_queue.cc/.h): framework threads add
+``TensorTableEntry``s + negotiation ``Request``s; the background cycle pops
+pending requests and retrieves entries when responses arrive. Duplicate
+in-flight names are rejected (reference: tensor_queue.cc:26-29) — the
+API-misuse race the reference detects and raises.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from horovod_tpu.runtime import message as msg
+from horovod_tpu.runtime import types
+
+
+class DuplicateNameError(ValueError):
+    pass
+
+
+class TensorQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Dict[str, types.TensorTableEntry] = {}
+        self._pending: List[msg.Request] = []
+
+    def add(self, entry: types.TensorTableEntry, request: msg.Request) -> None:
+        """reference: TensorQueue::AddToTensorQueue (tensor_queue.cc:18-36)."""
+        with self._lock:
+            if entry.name in self._table:
+                raise DuplicateNameError(
+                    types.DUPLICATE_NAME_ERROR_FMT.format(
+                        op=entry.request_type.lower()))
+            self._table[entry.name] = entry
+            self._pending.append(request)
+
+    def pop_requests(self) -> List[msg.Request]:
+        """Drain pending negotiation messages for this cycle (reference:
+        PopMessagesFromQueue, controller.cc:68)."""
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out
+
+    def get_entries(self, names: List[str]) -> List[types.TensorTableEntry]:
+        """Remove and return entries for a (fused) response (reference:
+        GetTensorEntriesFromResponse, tensor_queue.cc:71). Missing names
+        are skipped — a partial failure must not strand the entries that
+        WERE popped with their callbacks unfired."""
+        with self._lock:
+            out = []
+            for n in names:
+                e = self._table.pop(n, None)
+                if e is not None:
+                    out.append(e)
+            return out
+
+    def peek(self, name: str):
+        with self._lock:
+            return self._table.get(name)
+
+    def pending_names(self) -> List[str]:
+        with self._lock:
+            return list(self._table.keys())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+    def finalize(self, status: types.Status) -> None:
+        """Flush every in-flight entry with an error callback on shutdown
+        (reference: FinalizeTensorQueue — SHUT_DOWN_ERROR to all pending)."""
+        with self._lock:
+            entries = list(self._table.values())
+            self._table.clear()
+            self._pending.clear()
+        for e in entries:
+            if e.callback is not None:
+                e.callback(status, None)

@@ -1,0 +1,98 @@
+"""Core runtime types: Status, TensorTableEntry, request/response kinds.
+
+TPU-native analogue of the reference's core type layer (reference:
+horovod/common/common.h:118-242 — ``Status``, ``StatusType``, ``Tensor``/
+``OpContext`` interfaces, ``TensorTableEntry``). Arrays are ``jax.Array``s
+(no framework adapter classes needed), so what remains is the status
+plumbing and the table entry that flows from enqueue to completion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Optional
+
+# reference: horovod/common/message.h RequestType / ResponseType
+ALLREDUCE = "ALLREDUCE"
+ALLGATHER = "ALLGATHER"
+BROADCAST = "BROADCAST"
+ERROR = "ERROR"
+
+
+class StatusType(enum.Enum):
+    # reference: common/common.h:124-131
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class Status:
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    def ok(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status()
+
+    @staticmethod
+    def Aborted(reason: str) -> "Status":
+        return Status(StatusType.ABORTED, reason)
+
+    @staticmethod
+    def InvalidArgument(reason: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, reason)
+
+    @staticmethod
+    def PreconditionError(reason: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, reason)
+
+    @staticmethod
+    def UnknownError(reason: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, reason)
+
+
+# reference error texts (common.h:141-158), kept recognizable for users
+# migrating from the reference.
+DUPLICATE_NAME_ERROR_FMT = (
+    "Requested to {op} a tensor with the same name as another tensor that is "
+    "currently being processed. If you want to request another tensor, use a "
+    "different tensor name."
+)
+SHUT_DOWN_ERROR = (
+    "Framework has been shut down. This was caused by an exception on one of "
+    "the workers or an attempt to run a collective after shutdown."
+)
+
+StatusCallback = Callable[[Status, Optional[Any]], None]
+
+
+@dataclasses.dataclass
+class TensorTableEntry:
+    """One enqueued named tensor (reference: common/common.h:225-242).
+
+    ``tensor`` is the input (stacked per-worker or replicated ``jax.Array``);
+    ``output`` is filled by the runtime before the callback fires.
+    """
+
+    name: str
+    tensor: Any
+    request_type: str = ALLREDUCE
+    root_rank: int = 0
+    average: bool = True
+    callback: Optional[StatusCallback] = None
+    output: Any = None
+    # set at enqueue time for negotiation/validation
+    dtype: Any = None
+    shape: tuple = ()
+    enqueue_time: float = 0.0

@@ -1,0 +1,88 @@
+"""Stall inspector: detect workers that stopped submitting tensors.
+
+TPU-native analogue of the reference's ``StallInspector`` (reference:
+horovod/common/stall_inspector.cc/.h): on the coordinator, periodically
+scan the negotiation table for tensors announced by some-but-not-all
+workers; log a WARNING naming the ready and missing ranks
+(stall_inspector.cc:26-110); if a tensor stays stalled longer than the
+shutdown threshold, trigger a global shutdown so the job fails fast instead
+of hanging (wired into the controller cycle as in controller.cc:98-107).
+
+Knobs (reference: common.h:78-80): ``HOROVOD_STALL_CHECK_DISABLE``,
+``HOROVOD_STALL_CHECK_TIME_SECONDS`` (default 60),
+``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS`` (default 0 = never shut down).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from horovod_tpu.utils import logging as log
+
+
+class StallInspector:
+    def __init__(self, warning_time_seconds: float = 60.0,
+                 shutdown_time_seconds: float = 0.0,
+                 enabled: bool = True):
+        self.warning_time = warning_time_seconds
+        self.shutdown_time = shutdown_time_seconds
+        self.enabled = enabled
+        self._last_check = time.monotonic()
+        # tensor name -> first time observed incomplete
+        self._first_seen: Dict[str, float] = {}
+
+    def check(self, message_table, cache=None, world: Optional[int] = None
+              ) -> bool:
+        """Scan for stalled tensors; returns True if a stall exceeded the
+        shutdown threshold (reference: CheckForStalledTensors,
+        stall_inspector.cc:26-110)."""
+        if not self.enabled:
+            return False
+        now = time.monotonic()
+        if now - self._last_check < self.warning_time:
+            return False
+        self._last_check = now
+
+        pending = message_table.pending()
+        stalled_msgs = []
+        shutdown = False
+        seen_names = set()
+        for name, requests in pending.items():
+            seen_names.add(name)
+            first = self._first_seen.setdefault(name, now)
+            age = now - first
+            if age < self.warning_time:
+                continue
+            ready = sorted(r.rank for r in requests)
+            missing = ([] if world is None else
+                       sorted(set(range(world)) - set(ready)))
+            stalled_msgs.append(
+                f"{name} [ready ranks: {ready}"
+                + (f", missing ranks: {missing}]" if missing else "]"))
+            if cache is not None:
+                # stalled cached tensors must re-enter full negotiation
+                # (reference: InvalidateStalledCachedTensors,
+                # stall_inspector.cc:112+)
+                cache.invalidate(name)
+            if self.shutdown_time > 0 and age > self.shutdown_time:
+                shutdown = True
+
+        # forget tensors that completed since last scan
+        self._first_seen = {k: v for k, v in self._first_seen.items()
+                            if k in seen_names}
+
+        if stalled_msgs:
+            log.warning(
+                "One or more tensors were submitted to be reduced, gathered "
+                "or broadcasted by subset of ranks and are waiting for "
+                "remainder of ranks for more than %.0f seconds. This may "
+                "indicate that different ranks are trying to submit "
+                "different tensors or that only subset of ranks is "
+                "submitting tensors. Stalled ops: %s",
+                self.warning_time, "; ".join(stalled_msgs))
+        if shutdown:
+            log.error(
+                "Stalled tensors exceeded HOROVOD_STALL_SHUTDOWN_TIME_"
+                "SECONDS (%.0fs); shutting down.", self.shutdown_time)
+        return shutdown
