@@ -1,0 +1,493 @@
+// Native TCP coordination + host-collective transport.
+//
+// TPU-native analogue of the reference's Gloo layer (reference:
+// horovod/common/gloo/gloo_controller.cc, gloo_context.cc and the vendored
+// third_party/gloo): provides the controller verbs the negotiation protocol
+// needs (gather-to-coordinator, broadcast-from-coordinator, barrier,
+// cross-rank bitwise AND/OR) and host-memory data collectives (ring
+// allreduce, allgatherv, broadcast) for CPU-resident tensors. On TPU the
+// *device* data plane is XLA over ICI/DCN; this library is the host-side
+// control/data plane for multi-process mode and tests, loaded via ctypes
+// (no pybind11 in the image).
+//
+// Topology: rank 0 listens; every worker opens one persistent socket to
+// rank 0 (star, used for control verbs), and each rank additionally
+// connects to its ring successor (rank+1)%world for the bandwidth-optimal
+// ring allreduce. Rendezvous: workers register their ring-listen port with
+// the coordinator, which broadcasts the address book.
+//
+// Build: `make -C horovod_tpu/cpp` -> libhvdtpu_net.so.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// socket helpers
+// ---------------------------------------------------------------------------
+
+int send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return 0;
+}
+
+int recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return -1;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return 0;
+}
+
+int send_frame(int fd, const void* buf, uint64_t n) {
+  if (send_all(fd, &n, sizeof(n)) != 0) return -1;
+  return send_all(fd, buf, n);
+}
+
+// receives into a resizable vector; returns length or -1
+int64_t recv_frame(int fd, std::vector<char>& out) {
+  uint64_t n = 0;
+  if (recv_all(fd, &n, sizeof(n)) != 0) return -1;
+  out.resize(n);
+  if (n > 0 && recv_all(fd, out.data(), n) != 0) return -1;
+  return static_cast<int64_t>(n);
+}
+
+int tcp_listen(int* port_inout) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(*port_inout));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  *port_inout = ntohs(addr.sin_port);
+  if (::listen(fd, 128) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int tcp_connect_retry(const char* host, int port, int timeout_ms) {
+  for (int elapsed = 0;; elapsed += 50) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return -1;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    if (elapsed >= timeout_ms) return -1;
+    ::usleep(50 * 1000);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// communicator
+// ---------------------------------------------------------------------------
+
+struct Comm {
+  int rank = 0;
+  int world = 1;
+  // star: coordinator holds star[r] per worker r (star[0] unused);
+  // workers hold star[0] = socket to coordinator.
+  std::vector<int> star;
+  // ring: socket to successor and predecessor
+  int ring_next = -1;
+  int ring_prev = -1;
+  std::string error;
+};
+
+// handshake tags
+constexpr uint32_t KHELLO = 0x68766431;  // "hvd1"
+
+int comm_init(Comm* c, int rank, int world, const char* coord_host,
+              int coord_port, int timeout_ms) {
+  c->rank = rank;
+  c->world = world;
+  c->star.assign(world < 1 ? 1 : world, -1);
+  if (world == 1) return 0;
+
+  // --- star setup + rendezvous of ring listen ports ---
+  int ring_listen_port = 0;
+  int ring_listen_fd = tcp_listen(&ring_listen_port);
+  if (ring_listen_fd < 0) {
+    c->error = "ring listen failed";
+    return -1;
+  }
+
+  if (rank == 0) {
+    int port = coord_port;
+    int lfd = tcp_listen(&port);
+    if (lfd < 0 || port != coord_port) {
+      c->error = "coordinator listen failed on port " +
+                 std::to_string(coord_port);
+      return -1;
+    }
+    std::vector<int> ring_ports(world, 0);
+    ring_ports[0] = ring_listen_port;
+    for (int i = 1; i < world; ++i) {
+      int fd = ::accept(lfd, nullptr, nullptr);
+      if (fd < 0) {
+        c->error = "accept failed";
+        return -1;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      uint32_t magic = 0;
+      int32_t peer_rank = -1, peer_ring_port = 0;
+      if (recv_all(fd, &magic, sizeof(magic)) != 0 || magic != KHELLO ||
+          recv_all(fd, &peer_rank, sizeof(peer_rank)) != 0 ||
+          recv_all(fd, &peer_ring_port, sizeof(peer_ring_port)) != 0 ||
+          peer_rank <= 0 || peer_rank >= world) {
+        c->error = "bad hello";
+        ::close(fd);
+        return -1;
+      }
+      c->star[peer_rank] = fd;
+      ring_ports[peer_rank] = peer_ring_port;
+    }
+    ::close(lfd);
+    // broadcast the ring address book
+    for (int r = 1; r < world; ++r) {
+      if (send_all(c->star[r], ring_ports.data(),
+                   sizeof(int) * world) != 0) {
+        c->error = "address book send failed";
+        return -1;
+      }
+    }
+    // ring connects: rank r dials (r+1)%world; everyone accepts from
+    // predecessor. All ring traffic is on localhost for multi-process
+    // single-host; multi-host uses the coordinator host for all ranks.
+    c->ring_next = tcp_connect_retry(coord_host, ring_ports[1 % world],
+                                     timeout_ms);
+    c->ring_prev = ::accept(ring_listen_fd, nullptr, nullptr);
+  } else {
+    int fd = tcp_connect_retry(coord_host, coord_port, timeout_ms);
+    if (fd < 0) {
+      c->error = "connect to coordinator failed";
+      return -1;
+    }
+    c->star[0] = fd;
+    uint32_t magic = KHELLO;
+    int32_t r32 = rank, rp = ring_listen_port;
+    if (send_all(fd, &magic, sizeof(magic)) != 0 ||
+        send_all(fd, &r32, sizeof(r32)) != 0 ||
+        send_all(fd, &rp, sizeof(rp)) != 0) {
+      c->error = "hello send failed";
+      return -1;
+    }
+    std::vector<int> ring_ports(world, 0);
+    if (recv_all(fd, ring_ports.data(), sizeof(int) * world) != 0) {
+      c->error = "address book recv failed";
+      return -1;
+    }
+    c->ring_next = tcp_connect_retry(coord_host,
+                                     ring_ports[(rank + 1) % world],
+                                     timeout_ms);
+    c->ring_prev = ::accept(ring_listen_fd, nullptr, nullptr);
+  }
+  ::close(ring_listen_fd);
+  if (c->ring_next < 0 || c->ring_prev < 0) {
+    c->error = "ring setup failed";
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(c->ring_prev, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return 0;
+}
+
+void comm_close(Comm* c) {
+  for (int fd : c->star)
+    if (fd >= 0) ::close(fd);
+  if (c->ring_next >= 0) ::close(c->ring_next);
+  if (c->ring_prev >= 0) ::close(c->ring_prev);
+  c->star.clear();
+  c->ring_next = c->ring_prev = -1;
+}
+
+// ---------------------------------------------------------------------------
+// control verbs (star) — reference: gloo_controller.cc verbs
+// ---------------------------------------------------------------------------
+
+// Workers send a frame to rank 0; rank 0 receives one frame per worker.
+// out_lens/out buffers are coordinator-only.
+int gatherv(Comm* c, const void* in, uint64_t in_len,
+            std::vector<std::vector<char>>* out) {
+  if (c->world == 1) {
+    out->assign(1, std::vector<char>(static_cast<const char*>(in),
+                                     static_cast<const char*>(in) + in_len));
+    return 0;
+  }
+  if (c->rank == 0) {
+    out->assign(c->world, {});
+    (*out)[0].assign(static_cast<const char*>(in),
+                     static_cast<const char*>(in) + in_len);
+    for (int r = 1; r < c->world; ++r) {
+      if (recv_frame(c->star[r], (*out)[r]) < 0) return -1;
+    }
+    return 0;
+  }
+  return send_frame(c->star[0], in, in_len);
+}
+
+// Rank 0 sends one frame to every worker; workers receive it.
+int bcast(Comm* c, std::vector<char>* data) {
+  if (c->world == 1) return 0;
+  if (c->rank == 0) {
+    for (int r = 1; r < c->world; ++r) {
+      if (send_frame(c->star[r], data->data(), data->size()) != 0) return -1;
+    }
+    return 0;
+  }
+  return recv_frame(c->star[0], *data) < 0 ? -1 : 0;
+}
+
+// Bitwise AND + OR over fixed-width word arrays (reference:
+// CrossRankBitwiseAnd/Or, mpi_controller.cc:87-105). One round trip:
+// gather words to rank 0, reduce, broadcast both results.
+int bit_and_or(Comm* c, uint64_t* words, uint64_t nwords, uint64_t* out_and,
+               uint64_t* out_or) {
+  std::memcpy(out_and, words, nwords * 8);
+  std::memcpy(out_or, words, nwords * 8);
+  if (c->world == 1) return 0;
+  if (c->rank == 0) {
+    std::vector<uint64_t> buf(nwords);
+    for (int r = 1; r < c->world; ++r) {
+      if (recv_all(c->star[r], buf.data(), nwords * 8) != 0) return -1;
+      for (uint64_t i = 0; i < nwords; ++i) {
+        out_and[i] &= buf[i];
+        out_or[i] |= buf[i];
+      }
+    }
+    for (int r = 1; r < c->world; ++r) {
+      if (send_all(c->star[r], out_and, nwords * 8) != 0 ||
+          send_all(c->star[r], out_or, nwords * 8) != 0)
+        return -1;
+    }
+    return 0;
+  }
+  if (send_all(c->star[0], words, nwords * 8) != 0) return -1;
+  if (recv_all(c->star[0], out_and, nwords * 8) != 0) return -1;
+  return recv_all(c->star[0], out_or, nwords * 8);
+}
+
+int barrier(Comm* c) {
+  uint64_t token = 0x626172;  // "bar"
+  std::vector<std::vector<char>> tmp;
+  if (gatherv(c, &token, sizeof(token), &tmp) != 0) return -1;
+  std::vector<char> b(sizeof(token));
+  std::memcpy(b.data(), &token, sizeof(token));
+  return bcast(c, &b);
+}
+
+// ---------------------------------------------------------------------------
+// host data collectives — reference: the Gloo op layer
+// (gloo_operations.cc); ring allreduce is the classic
+// reduce-scatter + allgather ring the reference's transports implement.
+// ---------------------------------------------------------------------------
+
+template <typename T>
+int ring_allreduce_t(Comm* c, T* data, uint64_t count) {
+  if (c->world == 1 || count == 0) return 0;
+  const int w = c->world;
+  // chunk boundaries
+  std::vector<uint64_t> begin(w + 1);
+  for (int i = 0; i <= w; ++i) begin[i] = count * i / w;
+  uint64_t max_chunk = 0;
+  for (int i = 0; i < w; ++i)
+    max_chunk = std::max(max_chunk, begin[i + 1] - begin[i]);
+  std::vector<T> recv_buf(max_chunk);
+
+  // reduce-scatter: after w-1 steps, rank r owns the full sum of chunk
+  // (r+1) % w
+  for (int step = 0; step < w - 1; ++step) {
+    int send_chunk = (c->rank - step + w) % w;
+    int recv_chunk = (c->rank - step - 1 + w) % w;
+    uint64_t send_n = begin[send_chunk + 1] - begin[send_chunk];
+    uint64_t recv_n = begin[recv_chunk + 1] - begin[recv_chunk];
+    if (send_all(c->ring_next, data + begin[send_chunk], send_n * sizeof(T)) != 0)
+      return -1;
+    if (recv_all(c->ring_prev, recv_buf.data(), recv_n * sizeof(T)) != 0)
+      return -1;
+    T* dst = data + begin[recv_chunk];
+    for (uint64_t i = 0; i < recv_n; ++i) dst[i] += recv_buf[i];
+  }
+  // allgather ring: circulate the owned (fully reduced) chunks
+  for (int step = 0; step < w - 1; ++step) {
+    int send_chunk = (c->rank + 1 - step + w) % w;
+    int recv_chunk = (c->rank - step + w) % w;
+    uint64_t send_n = begin[send_chunk + 1] - begin[send_chunk];
+    uint64_t recv_n = begin[recv_chunk + 1] - begin[recv_chunk];
+    if (send_all(c->ring_next, data + begin[send_chunk], send_n * sizeof(T)) != 0)
+      return -1;
+    if (recv_all(c->ring_prev, data + begin[recv_chunk], recv_n * sizeof(T)) != 0)
+      return -1;
+    (void)recv_n;
+  }
+  return 0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* hvdnet_init(int rank, int world, const char* coord_host, int coord_port,
+                  int timeout_ms) {
+  Comm* c = new Comm();
+  if (comm_init(c, rank, world, coord_host, coord_port, timeout_ms) != 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void hvdnet_finalize(void* h) {
+  Comm* c = static_cast<Comm*>(h);
+  if (!c) return;
+  comm_close(c);
+  delete c;
+}
+
+int hvdnet_rank(void* h) { return static_cast<Comm*>(h)->rank; }
+int hvdnet_world(void* h) { return static_cast<Comm*>(h)->world; }
+
+int hvdnet_barrier(void* h) { return barrier(static_cast<Comm*>(h)); }
+
+int hvdnet_bit_and_or(void* h, uint64_t* words, uint64_t nwords,
+                      uint64_t* out_and, uint64_t* out_or) {
+  return bit_and_or(static_cast<Comm*>(h), words, nwords, out_and, out_or);
+}
+
+// Gather variable-length byte blobs to rank 0. On rank 0, out_lens must
+// hold `world` entries and out must have capacity out_cap; returns total
+// bytes written or -1. Workers return 0.
+int64_t hvdnet_gatherv(void* h, const void* in, uint64_t in_len,
+                       void* out, uint64_t out_cap, uint64_t* out_lens) {
+  Comm* c = static_cast<Comm*>(h);
+  std::vector<std::vector<char>> blobs;
+  if (gatherv(c, in, in_len, &blobs) != 0) return -1;
+  if (c->rank != 0) return 0;
+  uint64_t off = 0;
+  for (int r = 0; r < c->world; ++r) {
+    out_lens[r] = blobs[r].size();
+    if (off + blobs[r].size() > out_cap) return -1;
+    std::memcpy(static_cast<char*>(out) + off, blobs[r].data(),
+                blobs[r].size());
+    off += blobs[r].size();
+  }
+  return static_cast<int64_t>(off);
+}
+
+// Broadcast a byte blob from rank 0. Workers pass a capacity buffer;
+// returns the blob length or -1.
+int64_t hvdnet_bcast(void* h, void* buf, uint64_t len_or_cap) {
+  Comm* c = static_cast<Comm*>(h);
+  if (c->rank == 0) {
+    std::vector<char> data(static_cast<char*>(buf),
+                           static_cast<char*>(buf) + len_or_cap);
+    if (bcast(c, &data) != 0) return -1;
+    return static_cast<int64_t>(len_or_cap);
+  }
+  std::vector<char> data;
+  if (c->world > 1) {
+    if (recv_frame(c->star[0], data) < 0) return -1;
+    if (data.size() > len_or_cap) return -1;
+    std::memcpy(buf, data.data(), data.size());
+  }
+  return static_cast<int64_t>(data.size());
+}
+
+int hvdnet_allreduce_f32(void* h, float* data, uint64_t count) {
+  return ring_allreduce_t<float>(static_cast<Comm*>(h), data, count);
+}
+
+int hvdnet_allreduce_f64(void* h, double* data, uint64_t count) {
+  return ring_allreduce_t<double>(static_cast<Comm*>(h), data, count);
+}
+
+int hvdnet_allreduce_i32(void* h, int32_t* data, uint64_t count) {
+  return ring_allreduce_t<int32_t>(static_cast<Comm*>(h), data, count);
+}
+
+int hvdnet_allreduce_i64(void* h, int64_t* data, uint64_t count) {
+  return ring_allreduce_t<int64_t>(static_cast<Comm*>(h), data, count);
+}
+
+// Allgatherv over the star: gather blobs to rank 0, then broadcast the
+// concatenation (lens first). Every rank ends with all blobs in rank order.
+// out must have capacity out_cap; out_lens has world entries; returns total.
+int64_t hvdnet_allgatherv(void* h, const void* in, uint64_t in_len,
+                          void* out, uint64_t out_cap, uint64_t* out_lens) {
+  Comm* c = static_cast<Comm*>(h);
+  std::vector<std::vector<char>> blobs;
+  if (gatherv(c, in, in_len, &blobs) != 0) return -1;
+  std::vector<char> packed;
+  if (c->rank == 0) {
+    uint64_t w = c->world;
+    packed.resize(8 * w);
+    for (uint64_t r = 0; r < w; ++r) {
+      uint64_t n = blobs[r].size();
+      std::memcpy(packed.data() + 8 * r, &n, 8);
+    }
+    for (auto& b : blobs) packed.insert(packed.end(), b.begin(), b.end());
+  }
+  if (bcast(c, &packed) != 0) return -1;
+  uint64_t w = c->world;
+  uint64_t off = 8 * w, total = 0;
+  for (uint64_t r = 0; r < w; ++r) {
+    std::memcpy(&out_lens[r], packed.data() + 8 * r, 8);
+    total += out_lens[r];
+  }
+  if (total > out_cap || packed.size() != off + total) return -1;
+  std::memcpy(out, packed.data() + off, total);
+  return static_cast<int64_t>(total);
+}
+
+}  // extern "C"

@@ -125,14 +125,29 @@ def construct_response(requests: List[msg.Request]) -> msg.Response:
 class Controller:
     """Base negotiation engine over abstract transport verbs."""
 
+    # deferred cache hits older than this are invalidated and renegotiated
+    # (reference: stalled cached tensors re-enter negotiation,
+    # stall_inspector.cc:112+)
+    STALE_HIT_SECONDS = 60.0
+
     def __init__(self, rank: int, world: int, cache_capacity: int = 1024):
         self.rank = rank
         self.world = world
         self.cache = ResponseCache(cache_capacity)
         self.message_table = MessageTable()  # coordinator only
         self._should_shut_down = False
-        # requests seen this cycle, for fusion byte accounting + cache put
-        self._cycle_requests: Dict[str, msg.Request] = {}
+        # name -> Request for every announcement not yet resolved on this
+        # worker (needed for fusion byte accounting + cache puts when the
+        # agreement arrives in a LATER cycle than the announcement)
+        self._pending: Dict[str, msg.Request] = {}
+        # uncached names already delivered to the coordinator — must not be
+        # re-sent (IncrementTensorCount would double-count this rank)
+        self._awaiting: set = set()
+        # cache hits not yet common to all workers: re-announced every
+        # cycle until the agreement lands; name -> first-announce time
+        self._deferred_first_seen: Dict[str, float] = {}
+        # synchronized invalidation notices queued for the next slow path
+        self._invalidate_queue: List[str] = []
 
     # -- transport verbs (reference: controller.h:98-124) ------------------
     def sync_bitvectors(self, bits: int) -> Tuple[int, int]:
@@ -167,124 +182,153 @@ class Controller:
         self, requests: List[msg.Request], fusion_threshold: int,
         timeline=None, stall_inspector=None,
     ) -> Tuple[List[msg.Response], bool]:
-        """Returns (responses_to_execute, should_shut_down)."""
+        """Returns (responses_to_execute, should_shut_down).
+
+        Cache mutations (puts AND invalidations) happen only through the
+        agreed broadcast list, in list order — every worker applies the
+        identical sequence, so cache-bit numbering stays aligned across
+        workers (the invariant the bitvector fast path depends on;
+        reference: response_cache.cc:232+ bit redistribution)."""
+        import time as _time
+
+        now = _time.monotonic()
         coordinator = CacheCoordinator()
-        hit_bits: List[int] = []
-        uncached: List[msg.Request] = []
+        uncached_to_send: List[msg.Request] = []
 
         for r in requests:
-            self._cycle_requests[r.tensor_name] = r
+            name = r.tensor_name
+            self._pending[name] = r
+            if name in self._awaiting:
+                continue  # already at the coordinator; do not re-send
             state = self.cache.cached(r)
-            if state == CacheState.HIT:
-                bit = self.cache.bit_for_name(r.tensor_name)
-                coordinator.record_hit(bit)
-                hit_bits.append(bit)
+            stale = (state == CacheState.HIT and
+                     now - self._deferred_first_seen.get(name, now)
+                     > self.STALE_HIT_SECONDS)
+            if state == CacheState.HIT and not stale:
+                coordinator.record_hit(self.cache.bit_for_name(name))
+                self._deferred_first_seen.setdefault(name, now)
             else:
-                if state == CacheState.INVALID:
-                    self.cache.invalidate(r.tensor_name)
+                if state in (CacheState.INVALID, CacheState.HIT):
+                    # params changed, or the hit went stale waiting for the
+                    # other workers: synchronized invalidation + renegotiate
+                    self._invalidate_queue.append(name)
                     coordinator.set_invalid_in_queue()
+                    self._deferred_first_seen.pop(name, None)
                 coordinator.set_uncached_in_queue()
-                uncached.append(r)
+                uncached_to_send.append(r)
 
         if self._should_shut_down:
             coordinator.set_should_shut_down()
 
         anded, ored = self.sync_bitvectors(coordinator.bitvector)
-        shut_down, any_uncached, _ = CacheCoordinator.flags(ored)
+        shut_down, any_uncached, any_invalid = CacheCoordinator.flags(ored)
 
-        responses: List[msg.Response] = []
+        # Stall scan runs on the coordinator EVERY cycle — a stalled tensor
+        # sits in the message table while later cycles take the fast path,
+        # so a slow-path-only check would never fire (reference: the stall
+        # check is part of every ComputeResponseList, controller.cc:98-107).
+        if self.is_coordinator and stall_inspector is not None \
+                and len(self.message_table):
+            if stall_inspector.check(self.message_table, world=self.world):
+                self.request_shutdown()
 
-        common_bits = set(CacheCoordinator.common_hits(anded))
-        # Hits not common to all workers stay queued for later cycles:
-        # their requests were already recorded; re-enqueue them next cycle.
-        deferred = [b for b in hit_bits if b not in common_bits]
-
-        if not any_uncached:
-            # FAST PATH (reference: controller.cc:151-179): everything
-            # queued everywhere is cached — responses straight from cache.
-            for bit in sorted(common_bits):
-                resp = self.cache.get_by_bit(bit)
-                if resp is not None:
-                    responses.append(resp)
-            fused = fusion.fuse_responses(responses, self._cycle_requests,
-                                          fusion_threshold)
-            self._gc_cycle_requests(fused, deferred)
-            return fused, shut_down
-
-        # SLOW PATH: full negotiation for uncached tensors; common cache
-        # hits still execute this cycle from the cache.
-        for bit in sorted(common_bits):
+        common_bits = sorted(CacheCoordinator.common_hits(anded))
+        cached_responses: List[msg.Response] = []
+        for bit in common_bits:
             resp = self.cache.get_by_bit(bit)
             if resp is not None:
-                responses.append(resp)
+                cached_responses.append(resp)
 
-        gathered = self.send_ready_tensors(uncached)
-        final: Optional[List[msg.Response]] = None
-        if self.is_coordinator:
-            assert gathered is not None
-            ready_names: List[str] = []
-            for worker_requests in gathered:
-                for r in worker_requests:
+        if not any_uncached and not any_invalid:
+            # FAST PATH (reference: controller.cc:151-179): everything
+            # queued everywhere is cached — responses straight from cache,
+            # no gather/bcast round trip.
+            agreed = cached_responses
+        else:
+            # SLOW PATH: ship invalidation notices + uncached requests to
+            # the coordinator; receive the agreed ordered list.
+            notices = [
+                msg.Request(self.rank, types.INVALIDATE, n, "", ())
+                for n in dict.fromkeys(self._invalidate_queue)
+            ]
+            gathered = self.send_ready_tensors(notices + uncached_to_send)
+            self._awaiting.update(r.tensor_name for r in uncached_to_send)
+            self._invalidate_queue.clear()
+
+            final: Optional[List[msg.Response]] = None
+            if self.is_coordinator:
+                assert gathered is not None
+                invalidate_names: List[str] = []
+                ready_names: List[str] = []
+                for worker_requests in gathered:
+                    for r in worker_requests:
+                        if r.request_type == types.INVALIDATE:
+                            if r.tensor_name not in invalidate_names:
+                                invalidate_names.append(r.tensor_name)
+                            continue
+                        if timeline is not None:
+                            if r.tensor_name not in self.message_table.pending():
+                                timeline.negotiate_start(r.tensor_name,
+                                                         r.request_type)
+                            timeline.negotiate_rank_ready(r.tensor_name,
+                                                          r.rank)
+                        if self.message_table.increment(r, self.world):
+                            ready_names.append(r.tensor_name)
+                negotiated: List[msg.Response] = []
+                for name in ready_names:
+                    reqs = self.message_table.pop(name)
                     if timeline is not None:
-                        if r.tensor_name not in self.message_table.pending():
-                            timeline.negotiate_start(r.tensor_name,
-                                                     r.request_type)
-                        timeline.negotiate_rank_ready(r.tensor_name, r.rank)
-                    if self.message_table.increment(r, self.world):
-                        ready_names.append(r.tensor_name)
-            if stall_inspector is not None:
-                shut_down = stall_inspector.check(
-                    self.message_table, self.cache,
-                    world=self.world) or shut_down
-            negotiated: List[msg.Response] = []
-            for name in ready_names:
-                reqs = self.message_table.pop(name)
-                if timeline is not None:
-                    timeline.negotiate_end(name)
-                negotiated.append(construct_response(reqs))
-            final = responses + negotiated
+                        timeline.negotiate_end(name)
+                    negotiated.append(construct_response(reqs))
+                final = []
+                if invalidate_names:
+                    final.append(msg.Response(types.INVALIDATE,
+                                              invalidate_names))
+                final += cached_responses + negotiated
 
-        agreed = self.bcast_responses(final)
-        # cache puts for newly negotiated single-tensor responses
+            agreed = self.bcast_responses(final)
+
+        # Apply the agreed list: invalidations first (identical order on
+        # every worker keeps free-bit pools aligned), then cache puts for
+        # newly negotiated responses.
+        executable: List[msg.Response] = []
         for resp in agreed:
-            if resp.response_type == types.ERROR:
+            if resp.response_type == types.INVALIDATE:
+                for name in resp.tensor_names:
+                    self.cache.invalidate(name)
                 continue
-            for name in resp.tensor_names:
-                req = self._cycle_requests.get(name)
-                if req is not None and self.cache.cached(req) != CacheState.HIT:
-                    self.cache.put(
-                        msg.Response(resp.response_type, [name],
-                                     tensor_sizes=resp.tensor_sizes), req)
+            if resp.response_type != types.ERROR:
+                for name in resp.tensor_names:
+                    req = self._pending.get(name)
+                    if req is not None \
+                            and self.cache.cached(req) != CacheState.HIT:
+                        self.cache.put(
+                            msg.Response(resp.response_type, [name],
+                                         tensor_sizes=resp.tensor_sizes),
+                            req)
+            executable.append(resp)
 
-        fused = fusion.fuse_responses(agreed, self._cycle_requests,
+        fused = fusion.fuse_responses(executable, self._pending,
                                       fusion_threshold)
-        self._gc_cycle_requests(fused, deferred)
+
+        # Resolve bookkeeping for everything that will now execute.
+        for resp in fused:
+            for name in resp.tensor_names:
+                self._pending.pop(name, None)
+                self._awaiting.discard(name)
+                self._deferred_first_seen.pop(name, None)
         return fused, shut_down
 
-    def _gc_cycle_requests(self, executed: List[msg.Response],
-                           deferred_bits: List[int]) -> None:
-        keep = set()
-        for bit in deferred_bits:
-            resp = self.cache.get_by_bit(bit)
-            if resp is not None:
-                keep.update(resp.tensor_names)
-        executed_names = {n for r in executed for n in r.tensor_names}
-        self._cycle_requests = {
-            k: v for k, v in self._cycle_requests.items()
-            if k in keep and k not in executed_names
-        }
-
     def take_deferred(self) -> List[msg.Request]:
-        """Drain tensors announced but not yet agreed (cache hits not yet
-        common to all workers) so the cycle loop RE-ANNOUNCES them with the
-        new cycle's requests — without this they would hang forever on
-        workers that announced early."""
-        out = list(self._cycle_requests.values())
-        self._cycle_requests = {}
-        return out
+        """Requests still unresolved on this worker that must be
+        RE-ANNOUNCED this cycle: cache hits waiting for the other workers.
+        (Uncached announcements already at the coordinator are excluded —
+        re-sending would double-count this rank in IncrementTensorCount.)"""
+        return [self._pending[n] for n in self._deferred_first_seen
+                if n in self._pending]
 
     def has_deferred(self) -> bool:
-        return bool(self._cycle_requests)
+        return bool(self._deferred_first_seen)
 
 
 class LocalController(Controller):

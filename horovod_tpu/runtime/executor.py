@@ -33,10 +33,19 @@ from horovod_tpu.runtime import types
 
 class Executor:
     """First-match dispatch per response type (reference:
-    operation_manager.cc:32-80; here the chain is XLA-only)."""
+    operation_manager.cc:32-80). Two data planes:
 
-    def __init__(self, mesh):
+    * XLA programs over the device mesh (default — single-controller, or
+      multi-process sharing a global mesh via jax.distributed);
+    * the native host ring (``net``) for multi-process mode without a
+      shared mesh — each process contributes its local tensor, the TCP
+      ring reduces, the analogue of the reference's Gloo CPU ops
+      (gloo_operations.cc).
+    """
+
+    def __init__(self, mesh, net=None):
         self.mesh = mesh
+        self.net = net
         self._programs: Dict[tuple, Any] = {}
         self._lock = threading.Lock()
 
@@ -95,13 +104,22 @@ class Executor:
                 return
 
             if response.response_type == types.ALLREDUCE:
-                self._execute_allreduce(response, entries, timeline)
+                if self.net is not None:
+                    self._execute_allreduce_host(entries, timeline)
+                else:
+                    self._execute_allreduce(response, entries, timeline)
             elif response.response_type == types.ALLGATHER:
-                for e in entries:
-                    e.output = collectives.allgather(e.tensor)
+                if self.net is not None:
+                    self._execute_allgather_host(response, entries)
+                else:
+                    for e in entries:
+                        e.output = collectives.allgather(e.tensor)
             elif response.response_type == types.BROADCAST:
-                for e in entries:
-                    e.output = collectives.broadcast(e.tensor, e.root_rank)
+                if self.net is not None:
+                    self._execute_broadcast_host(entries)
+                else:
+                    for e in entries:
+                        e.output = collectives.broadcast(e.tensor, e.root_rank)
             else:
                 raise ValueError(
                     f"unknown response type {response.response_type}")
@@ -118,6 +136,76 @@ class Executor:
         finally:
             if timeline is not None:
                 timeline.end(name0)
+
+    # -- host (multi-process) data plane -----------------------------------
+    def _execute_allreduce_host(self, entries, timeline=None) -> None:
+        """Fused host ring allreduce: pack all entries into one flat buffer
+        (the literal fusion-buffer memcpy of the reference,
+        collective_operations.cc:37-81), one ring pass, unpack."""
+        import numpy as np
+
+        world = self.net.world
+        arrays = [np.asarray(e.tensor) for e in entries]
+        # narrow types have no native host-ring kernels; widen for the wire
+        # (fp32 accumulation for 16-bit floats matches the reference's fp16
+        # MPI op behavior, half.cc:43-75)
+        def widen(a):
+            if a.dtype in (np.float32, np.float64, np.int32, np.int64):
+                return a
+            if a.dtype.kind in ("f", "V"):  # f16 / bfloat16(ml_dtypes)
+                return a.astype(np.float32)
+            if a.dtype == np.uint32:
+                return a.astype(np.int64)  # exact, no wrap
+            if a.dtype.kind in ("i", "b") or a.dtype in (np.uint8, np.uint16):
+                return a.astype(np.int32)
+            raise TypeError(f"unsupported host allreduce dtype {a.dtype} "
+                            "(uint64 cannot be widened losslessly)")
+
+        wire = [widen(a) for a in arrays]
+        if timeline is not None:
+            timeline.activity_start(entries[0].name,
+                                    timeline_mod.MEMCPY_IN_FUSION_BUFFER)
+        buf = np.concatenate([a.ravel() for a in wire])
+        if timeline is not None:
+            timeline.activity_end(entries[0].name)
+            timeline.activity_start(entries[0].name, "NET_RING_ALLREDUCE")
+        self.net.allreduce_sum(buf)
+        if timeline is not None:
+            timeline.activity_end(entries[0].name)
+        if entries[0].average:
+            buf = buf / world
+        off = 0
+        for e, orig, w in zip(entries, arrays, wire):
+            n = w.size
+            out = buf[off:off + n].reshape(orig.shape).astype(orig.dtype)
+            e.output = out
+            off += n
+
+    def _execute_allgather_host(self, response, entries) -> None:
+        import numpy as np
+
+        for e in entries:
+            local = np.ascontiguousarray(np.asarray(e.tensor))
+            blobs = self.net.allgatherv(local.tobytes())
+            parts = []
+            trailing = local.shape[1:]
+            for r, blob in enumerate(blobs):
+                a = np.frombuffer(blob, dtype=local.dtype)
+                first = (response.tensor_sizes[r] if response.tensor_sizes
+                         else a.size // max(int(np.prod(trailing)) or 1, 1))
+                parts.append(a.reshape((first,) + trailing))
+            e.output = np.concatenate(parts, axis=0)
+
+    def _execute_broadcast_host(self, entries) -> None:
+        import numpy as np
+
+        for e in entries:
+            local = np.ascontiguousarray(np.asarray(e.tensor))
+            blob = self.net.bcast_from(
+                local.tobytes() if self.net.rank == e.root_rank else None,
+                e.root_rank)
+            e.output = np.frombuffer(
+                blob, dtype=local.dtype).reshape(local.shape)
 
     def _execute_allreduce(self, response, entries, timeline=None) -> None:
         stacked = [e for e in entries if collectives._is_worker_stacked(e.tensor)]

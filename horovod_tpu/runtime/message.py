@@ -22,10 +22,11 @@ from horovod_tpu.runtime import types
 _MAGIC = 0x48  # 'H'
 _VERSION = 1
 
-_REQUEST_TYPES = {types.ALLREDUCE: 0, types.ALLGATHER: 1, types.BROADCAST: 2}
+_REQUEST_TYPES = {types.ALLREDUCE: 0, types.ALLGATHER: 1, types.BROADCAST: 2,
+                  types.INVALIDATE: 4}
 _REQUEST_TYPES_INV = {v: k for k, v in _REQUEST_TYPES.items()}
 _RESPONSE_TYPES = {types.ALLREDUCE: 0, types.ALLGATHER: 1,
-                   types.BROADCAST: 2, types.ERROR: 3}
+                   types.BROADCAST: 2, types.ERROR: 3, types.INVALIDATE: 4}
 _RESPONSE_TYPES_INV = {v: k for k, v in _RESPONSE_TYPES.items()}
 
 

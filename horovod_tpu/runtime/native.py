@@ -1,0 +1,267 @@
+"""ctypes bindings for the native transport library.
+
+Loads ``libhvdtpu_net.so`` (built from ``horovod_tpu/cpp/net.cc`` — the
+Gloo-layer analogue, see that file's header) and exposes the controller
+verbs + host collectives as a ``NetComm`` object. The library is built on
+demand with ``make`` if missing (the reference similarly builds vendored
+gloo during setup, reference: setup.py:49); binding is ctypes because the
+image has no pybind11 (reference used pybind11, torch/mpi_ops_v2.cc).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "libhvdtpu_net.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class NativeUnavailableError(RuntimeError):
+    pass
+
+
+def load_library(build_if_missing: bool = True):
+    """Load (building if needed) the native library; raises
+    NativeUnavailableError if no toolchain is available."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and build_if_missing:
+            try:
+                subprocess.run(["make", "-C", _CPP_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception as exc:
+                raise NativeUnavailableError(
+                    f"could not build native transport: {exc}") from exc
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as exc:
+            raise NativeUnavailableError(str(exc)) from exc
+
+        lib.hvdnet_init.restype = ctypes.c_void_p
+        lib.hvdnet_init.argtypes = [ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int]
+        lib.hvdnet_finalize.argtypes = [ctypes.c_void_p]
+        lib.hvdnet_rank.argtypes = [ctypes.c_void_p]
+        lib.hvdnet_world.argtypes = [ctypes.c_void_p]
+        lib.hvdnet_barrier.argtypes = [ctypes.c_void_p]
+        lib.hvdnet_bit_and_or.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.hvdnet_gatherv.restype = ctypes.c_int64
+        lib.hvdnet_gatherv.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.hvdnet_bcast.restype = ctypes.c_int64
+        lib.hvdnet_bcast.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64]
+        for name in ("hvdnet_allreduce_f32", "hvdnet_allreduce_f64",
+                     "hvdnet_allreduce_i32", "hvdnet_allreduce_i64"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.hvdnet_allgatherv.restype = ctypes.c_int64
+        lib.hvdnet_allgatherv.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        _lib = lib
+        return _lib
+
+
+def native_built() -> bool:
+    """Capability probe for the native transport (analogue of
+    ``horovod_gloo_built``)."""
+    try:
+        load_library(build_if_missing=True)
+        return True
+    except NativeUnavailableError:
+        return False
+
+
+_ALLREDUCE_FN = {
+    np.dtype(np.float32): "hvdnet_allreduce_f32",
+    np.dtype(np.float64): "hvdnet_allreduce_f64",
+    np.dtype(np.int32): "hvdnet_allreduce_i32",
+    np.dtype(np.int64): "hvdnet_allreduce_i64",
+}
+
+
+
+class NetComm:
+    """One process's membership in the TCP communicator (star + ring).
+
+    ``bit_words``: fixed uint64-word width of the coordination bitvector.
+    The width is statically bounded by the response-cache capacity plus the
+    status bits, so it is agreed once at construction instead of per cycle
+    (the per-cycle sync is the steady-state fast path's only collective —
+    reference: response_cache.cc:308 syncs fixed-width chunks the same way).
+    """
+
+    def __init__(self, rank: int, world: int, coord_host: str = "127.0.0.1",
+                 coord_port: int = 29500, timeout_ms: int = 30_000,
+                 bit_words: int = 17):
+        self._lib = load_library()
+        self._h = self._lib.hvdnet_init(
+            rank, world, coord_host.encode(), coord_port, timeout_ms)
+        if not self._h:
+            raise RuntimeError(
+                f"native transport init failed (rank {rank}/{world} via "
+                f"{coord_host}:{coord_port})")
+        self.rank = rank
+        self.world = world
+        self.bit_words = bit_words
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._h:
+                self._lib.hvdnet_finalize(self._h)
+                self._h = None
+
+    def barrier(self) -> None:
+        with self._lock:
+            if self._lib.hvdnet_barrier(self._h) != 0:
+                raise RuntimeError("barrier failed")
+
+    def bit_and_or(self, bits: int) -> Tuple[int, int]:
+        """Cross-worker bitwise AND/OR of the coordination bitvector
+        (fixed ``bit_words`` uint64 words — one round trip, no width
+        agreement)."""
+        nwords = self.bit_words
+        if bits.bit_length() > nwords * 64:
+            raise ValueError(
+                f"bitvector needs {bits.bit_length()} bits but transport "
+                f"width is {nwords * 64} (raise bit_words / cache capacity "
+                "mismatch)")
+        words = np.frombuffer(
+            bits.to_bytes(nwords * 8, "little"), dtype=np.uint64).copy()
+        out_and = np.zeros(nwords, dtype=np.uint64)
+        out_or = np.zeros(nwords, dtype=np.uint64)
+        with self._lock:
+            rc = self._lib.hvdnet_bit_and_or(
+                self._h,
+                words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                nwords,
+                out_and.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                out_or.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        if rc != 0:
+            raise RuntimeError("bit_and_or failed")
+        return (int.from_bytes(out_and.tobytes(), "little"),
+                int.from_bytes(out_or.tobytes(), "little"))
+
+    def _gatherv_raw(self, blob: bytes, cap: int) -> Optional[List[bytes]]:
+        lens = (ctypes.c_uint64 * self.world)()
+        out = ctypes.create_string_buffer(cap) if self.rank == 0 else None
+        with self._lock:
+            total = self._lib.hvdnet_gatherv(
+                self._h, blob, len(blob), out,
+                cap if self.rank == 0 else 0, lens)
+        if total < 0:
+            raise RuntimeError("gatherv failed")
+        if self.rank != 0:
+            return None
+        blobs, off = [], 0
+        raw = out.raw
+        for r in range(self.world):
+            n = int(lens[r])
+            blobs.append(raw[off:off + n])
+            off += n
+        return blobs
+
+    def gatherv(self, blob: bytes) -> Optional[List[bytes]]:
+        """Workers send to rank 0; rank 0 returns all blobs (rank order),
+        workers return None. Two-phase (sizes first) — no payload cap."""
+        sizes = self._gatherv_raw(
+            np.uint64(len(blob)).tobytes(), 16 * self.world)
+        cap = 0
+        if self.rank == 0:
+            cap = int(sum(np.frombuffer(b, dtype=np.uint64)[0]
+                          for b in sizes)) or 1
+        return self._gatherv_raw(blob, cap)
+
+    def _bcast_raw(self, blob: Optional[bytes], cap: int) -> bytes:
+        if self.rank == 0:
+            assert blob is not None
+            buf = ctypes.create_string_buffer(blob, len(blob))
+            with self._lock:
+                rc = self._lib.hvdnet_bcast(self._h, buf, len(blob))
+            if rc < 0:
+                raise RuntimeError("bcast failed")
+            return blob
+        buf = ctypes.create_string_buffer(max(cap, 1))
+        with self._lock:
+            n = self._lib.hvdnet_bcast(self._h, buf, cap)
+        if n < 0:
+            raise RuntimeError("bcast failed")
+        return buf.raw[:n]
+
+    def bcast(self, blob: Optional[bytes]) -> bytes:
+        """Rank 0 passes the blob; workers pass None and receive it.
+        Two-phase (size first) — no payload cap."""
+        size_blob = self._bcast_raw(
+            np.uint64(len(blob)).tobytes() if self.rank == 0 else None, 8)
+        size = int(np.frombuffer(size_blob, dtype=np.uint64)[0])
+        return self._bcast_raw(blob, size)
+
+    def bcast_from(self, blob: Optional[bytes], root: int) -> bytes:
+        """Broadcast from an arbitrary root: root relays through rank 0,
+        then the star bcast fans out (payload moves once per link, unlike
+        an allgather)."""
+        if root == 0:
+            return self.bcast(blob if self.rank == 0 else None)
+        relayed = self.gatherv(blob if self.rank == root else b"")
+        if self.rank == 0:
+            return self.bcast(relayed[root])
+        return self.bcast(None)
+
+    def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
+        """In-place ring allreduce (sum) on a contiguous host array."""
+        if arr.dtype not in _ALLREDUCE_FN:
+            raise TypeError(f"unsupported dtype {arr.dtype} for host "
+                            "allreduce (use float32/float64/int32/int64)")
+        arr = np.ascontiguousarray(arr)
+        fn = getattr(self._lib, _ALLREDUCE_FN[arr.dtype])
+        with self._lock:
+            rc = fn(self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.size)
+        if rc != 0:
+            raise RuntimeError("ring allreduce failed")
+        return arr
+
+    def _allgatherv_raw(self, blob: bytes, cap: int) -> List[bytes]:
+        lens = (ctypes.c_uint64 * self.world)()
+        out = ctypes.create_string_buffer(max(cap, 1))
+        with self._lock:
+            total = self._lib.hvdnet_allgatherv(
+                self._h, blob, len(blob), out, cap, lens)
+        if total < 0:
+            raise RuntimeError("allgatherv failed")
+        blobs, off = [], 0
+        raw = out.raw
+        for r in range(self.world):
+            n = int(lens[r])
+            blobs.append(raw[off:off + n])
+            off += n
+        return blobs
+
+    def allgatherv(self, blob: bytes) -> List[bytes]:
+        """Every rank contributes a blob; every rank receives all blobs in
+        rank order. Two-phase (sizes first) — no payload cap."""
+        size_blobs = self._allgatherv_raw(
+            np.uint64(len(blob)).tobytes(), 16 * self.world)
+        total = int(sum(np.frombuffer(b, dtype=np.uint64)[0]
+                        for b in size_blobs))
+        return self._allgatherv_raw(blob, total)

@@ -69,9 +69,11 @@ class Runtime:
         st = state_mod.global_state()
         self._st = st
         self.queue = TensorQueue()
-        self.controller = controller or LocalController(
-            rank=0, world=1, cache_capacity=st.config.cache_capacity)
-        self.executor = Executor(st.mesh)
+        if controller is None:
+            controller = self._controller_from_env(st)
+        self.controller = controller
+        net = getattr(controller, "net", None)
+        self.executor = Executor(st.mesh, net=net)
         self.timeline = st.timeline
         from horovod_tpu.stall import StallInspector
 
@@ -79,12 +81,33 @@ class Runtime:
             warning_time_seconds=st.config.stall_check_time_seconds,
             shutdown_time_seconds=st.config.stall_shutdown_time_seconds,
             enabled=not st.config.stall_check_disable)
+        # stale deferred hits renegotiate on the same clock as stall warnings
+        self.controller.STALE_HIT_SECONDS = st.config.stall_check_time_seconds
         self._cycle_time_s = st.config.cycle_time_ms / 1000.0
         self._stop = threading.Event()
         self._woken = threading.Event()
         self._thread = threading.Thread(
             target=self._run_loop, daemon=True, name="hvd-background-loop")
         self._thread.start()
+
+    @staticmethod
+    def _controller_from_env(st) -> Controller:
+        """Select the controller like the reference selects its LibType
+        (reference: utils/env_parser.cc:50-90 ParseControllerOpsFromEnv):
+        ``HOROVOD_CONTROLLER=socket`` (or a multi-process launcher env with
+        HOROVOD_SIZE>1) picks the TCP coordinator; default is local."""
+        import os
+
+        kind = os.environ.get("HOROVOD_CONTROLLER", "").lower()
+        env_world = int(os.environ.get("HOROVOD_SIZE", "1"))
+        if kind == "socket" or (kind == "" and env_world > 1
+                                and "HOROVOD_RANK" in os.environ):
+            from horovod_tpu.runtime.socket_controller import SocketController
+
+            return SocketController.from_env(
+                cache_capacity=st.config.cache_capacity)
+        return LocalController(rank=0, world=1,
+                               cache_capacity=st.config.cache_capacity)
 
     # -- enqueue APIs (reference: operations.cc:736-843) -------------------
     def _enqueue(self, request_type: str, name: str, tensor,
@@ -138,7 +161,10 @@ class Runtime:
                 keep_going = self.run_cycle()
             except Exception:
                 log.get_logger().exception("background cycle failed")
-                keep_going = True
+                # In multi-process mode a transport failure means a peer
+                # died or shut down — treat as global shutdown (reference:
+                # any rank failure aborts the job, gloo_run.py:256-262).
+                keep_going = getattr(self.controller, "net", None) is None
             if not keep_going:
                 break
         self._finalize()
@@ -151,7 +177,12 @@ class Runtime:
         # cycles (cache hits awaiting the other workers) — re-announced
         # ahead of the new requests so their bits re-enter the sync.
         requests = self.controller.take_deferred() + self.queue.pop_requests()
-        if not requests:
+        # Multi-process controllers sync EVERY cycle even with nothing
+        # queued — the coordination collectives are globally lock-stepped
+        # (reference: RunLoopOnce runs ComputeResponseList unconditionally,
+        # operations.cc:500-550); skipping only safe single-process.
+        if not requests and getattr(self.controller, "net", None) is None \
+                and not self.controller._should_shut_down:
             return True
         responses, shut_down = self.controller.compute_response_list(
             requests, self._st.config.fusion_threshold_bytes,
@@ -171,7 +202,15 @@ class Runtime:
 
     def stop(self) -> None:
         """reference: horovod_shutdown — pending entries get
-        SHUT_DOWN_ERROR callbacks (operations.cc:480-486)."""
+        SHUT_DOWN_ERROR callbacks (operations.cc:480-486). In multi-process
+        mode, shutdown is announced through the SHOULD_SHUT_DOWN status bit
+        so every worker exits its cycle loop together (reference:
+        response_cache.h:128-132 + controller shutdown propagation)."""
+        if getattr(self.controller, "net", None) is not None \
+                and self._thread.is_alive():
+            self.controller.request_shutdown()
+            self._woken.set()
+            self._thread.join(timeout=10.0)  # exits via bit propagation
         self._stop.set()
         self._woken.set()
         self._thread.join(timeout=10.0)

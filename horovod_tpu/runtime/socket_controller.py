@@ -1,0 +1,69 @@
+"""Multi-process controller over the native TCP transport.
+
+TPU-native analogue of the reference's ``GlooController`` (reference:
+horovod/common/gloo/gloo_controller.cc): the negotiation verbs —
+bitvector AND/OR, gather-ready-tensors, broadcast-final-responses,
+barrier — run over ``NetComm`` (horovod_tpu/cpp/net.cc), with rank 0 as
+coordinator. Process membership comes from the launcher's environment
+contract (reference: gloo_context.cc:128-133 reads HOROVOD_RANK/SIZE/...;
+rendezvous address knobs gloo_context.cc:37-40).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from horovod_tpu.runtime import message as msg
+from horovod_tpu.runtime.controller import Controller
+from horovod_tpu.runtime.native import NetComm
+
+
+class SocketController(Controller):
+    def __init__(self, rank: int, world: int, coord_host: str,
+                 coord_port: int, cache_capacity: int = 1024,
+                 timeout_ms: int = 30_000):
+        super().__init__(rank, world, cache_capacity)
+        # bitvector width: capacity cache bits + 3 status bits, fixed for
+        # the life of the communicator (single round trip per cycle)
+        bit_words = (cache_capacity + 3 + 63) // 64
+        self.net = NetComm(rank, world, coord_host, coord_port, timeout_ms,
+                           bit_words=bit_words)
+
+    @classmethod
+    def from_env(cls, cache_capacity: int = 1024) -> "SocketController":
+        """Build from the launcher's env contract (reference:
+        gloo_context.cc:128-133)."""
+        rank = int(os.environ["HOROVOD_RANK"])
+        world = int(os.environ["HOROVOD_SIZE"])
+        host = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
+        port = int(os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT", "29500"))
+        timeout_s = float(os.environ.get("HOROVOD_GLOO_TIMEOUT_SECONDS", "30"))
+        return cls(rank, world, host, port, cache_capacity,
+                   timeout_ms=int(timeout_s * 1000))
+
+    # -- verbs -------------------------------------------------------------
+    def sync_bitvectors(self, bits: int) -> Tuple[int, int]:
+        return self.net.bit_and_or(bits)
+
+    def send_ready_tensors(self, requests: List[msg.Request]
+                           ) -> Optional[List[List[msg.Request]]]:
+        blobs = self.net.gatherv(msg.pack_request_list(requests))
+        if blobs is None:
+            return None
+        return [msg.unpack_request_list(b) for b in blobs]
+
+    def bcast_responses(self, responses: Optional[List[msg.Response]]
+                        ) -> List[msg.Response]:
+        if self.rank == 0:
+            assert responses is not None
+            blob = self.net.bcast(msg.pack_response_list(responses))
+        else:
+            blob = self.net.bcast(None)
+        return msg.unpack_response_list(blob)
+
+    def barrier(self) -> None:
+        self.net.barrier()
+
+    def close(self) -> None:
+        self.net.close()

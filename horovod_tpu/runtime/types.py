@@ -18,6 +18,11 @@ ALLREDUCE = "ALLREDUCE"
 ALLGATHER = "ALLGATHER"
 BROADCAST = "BROADCAST"
 ERROR = "ERROR"
+# Synchronized cache-invalidation notice (no reference analogue as a wire
+# type; the reference syncs invalidated cache bits inside its
+# CacheCoordinator protocol, response_cache.cc:308-430 — this is our
+# explicit-message equivalent keeping every worker's cache bit-aligned).
+INVALIDATE = "INVALIDATE"
 
 
 class StatusType(enum.Enum):

@@ -60,11 +60,10 @@ class StallInspector:
             stalled_msgs.append(
                 f"{name} [ready ranks: {ready}"
                 + (f", missing ranks: {missing}]" if missing else "]"))
-            if cache is not None:
-                # stalled cached tensors must re-enter full negotiation
-                # (reference: InvalidateStalledCachedTensors,
-                # stall_inspector.cc:112+)
-                cache.invalidate(name)
+            # NOTE: stalled *cached* tensors re-enter negotiation through
+            # the controller's synchronized STALE_HIT invalidation protocol
+            # (controller.py) — invalidating the coordinator's cache here
+            # directly would desynchronize cache bits across workers.
             if self.shutdown_time > 0 and age > self.shutdown_time:
                 shutdown = True
 

@@ -1,0 +1,140 @@
+"""Worker script for multi-process runtime tests (launched by
+test_multiprocess.py with the launcher env contract set).
+
+Plays the role of one rank in the reference's mpirun-launched op tests
+(reference: test/test_tensorflow.py run under ``mpirun -np 2``): computes
+collectives through the public named-async API and asserts against locally
+computed expectations.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    scenario = sys.argv[1]
+    rank = int(os.environ["HOROVOD_RANK"])
+    world = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+
+    if scenario == "collectives":
+        # named allreduce: mean over ranks
+        for step in range(3):  # steady state -> cache fast path
+            h = hvd.allreduce_async(
+                np.full((5,), float(rank), np.float32), name="grad/w")
+            out = hvd.synchronize(h)
+            np.testing.assert_allclose(
+                np.asarray(out), np.mean(np.arange(world, dtype=np.float32)))
+        # sum + int dtype
+        h = hvd.allreduce_async(np.full((3,), rank + 1, np.int32),
+                                name="grad/int", average=False)
+        np.testing.assert_array_equal(
+            np.asarray(hvd.synchronize(h)), sum(range(1, world + 1)))
+        # ragged allgather: rank r contributes (r+1, 2)
+        h = hvd.allgather_async(
+            np.full((rank + 1, 2), rank, np.float32), name="ag/x")
+        out = np.asarray(hvd.synchronize(h))
+        expected = np.concatenate(
+            [np.full((r + 1, 2), r, np.float32) for r in range(world)])
+        np.testing.assert_allclose(out, expected)
+        # broadcast root=1
+        h = hvd.broadcast_async(
+            np.full((4,), float(rank), np.float32), root_rank=1, name="bc/x")
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)), 1.0)
+        # cache populated
+        from horovod_tpu.core import state
+        rt = state.global_state().runtime
+        assert len(rt.controller.cache) >= 3, len(rt.controller.cache)
+
+    elif scenario == "skewed_arrival":
+        # The negotiation protocol's reason to exist: workers announce the
+        # same named tensor in DIFFERENT cycles. Rank r delays by r*0.4s —
+        # far more than the 5ms cycle — so early announcers must wait
+        # (uncached path), then repeat with the tensor cached (deferred-hit
+        # path), then repeat with a changed shape (synchronized
+        # invalidation path).
+        import time
+
+        for round_no, shape in [(0, (4,)), (1, (4,)), (2, (4,)), (3, (8,))]:
+            time.sleep(0.4 * rank)
+            h = hvd.allreduce_async(
+                np.full(shape, float(rank), np.float32), name="skew/x")
+            out = hvd.synchronize(h)
+            np.testing.assert_allclose(
+                np.asarray(out), np.mean(np.arange(world, dtype=np.float32)))
+        # caches must still be bit-aligned: a fresh steady-state round on a
+        # second tensor plus the first must take the fast path correctly
+        for _ in range(2):
+            h1 = hvd.allreduce_async(np.full((8,), float(rank), np.float32),
+                                     name="skew/x")
+            h2 = hvd.allreduce_async(np.full((2,), float(rank) * 2, np.float32),
+                                     name="skew/y")
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(h1)),
+                np.mean(np.arange(world, dtype=np.float32)))
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(h2)),
+                2 * np.mean(np.arange(world, dtype=np.float32)))
+
+    elif scenario == "shape_mismatch":
+        # reference: error paths (test_tensorflow.py:314-384) — mismatched
+        # shapes across ranks must error on every rank
+        shape = (4,) if rank == 0 else (5,)
+        h = hvd.allreduce_async(np.ones(shape, np.float32), name="bad/x")
+        try:
+            hvd.synchronize(h)
+        except RuntimeError as e:
+            assert "shape" in str(e).lower(), str(e)
+        else:
+            raise AssertionError("expected shape mismatch error")
+        # the world must still be usable afterwards
+        h = hvd.allreduce_async(np.ones((2,), np.float32), name="good/x",
+                                average=False)
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   float(world))
+
+    elif scenario == "stall_shutdown":
+        # reference: test/test_stall.py — one rank never submits; stall
+        # inspector triggers global shutdown
+        if rank == 0:
+            h = hvd.allreduce_async(np.ones((2,), np.float32), name="stall/x")
+            try:
+                hvd.synchronize(h)
+                raise AssertionError("expected shutdown error")
+            except RuntimeError as e:
+                assert "shut down" in str(e).lower() or "fail" in str(e).lower(), str(e)
+        else:
+            # never submit; wait for the coordinator-triggered shutdown to
+            # propagate through the status bits
+            import time
+
+            deadline = time.time() + 30
+            from horovod_tpu.core import state
+            rt = state.global_state().runtime
+            # rank!=0 needs the runtime started to participate in cycles
+            from horovod_tpu.runtime.runtime import get_runtime
+            rt = get_runtime()
+            while time.time() < deadline and rt._thread.is_alive():
+                time.sleep(0.1)
+            assert not rt._thread.is_alive(), "shutdown did not propagate"
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+
+    hvd.shutdown()
+    print(f"OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
