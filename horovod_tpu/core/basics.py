@@ -105,6 +105,25 @@ def init(
         st.local_rank = st.rank % local
         st.cross_rank = st.rank // local
 
+        # Socket (host data plane) mode: the launcher's env contract defines
+        # the world — worker == process, exactly the reference's MPI-rank
+        # semantics (reference: gloo_context.cc:128-133 reads
+        # HOROVOD_RANK/SIZE/... set by gloo_run). Without this, rank()/size()
+        # would report only the process-local mesh.
+        env_size = int(os.environ.get("HOROVOD_SIZE", "1"))
+        if env_size > 1 and jax.process_count() == 1:
+            st.size = env_size
+            st.rank = int(os.environ.get("HOROVOD_RANK", "0"))
+            st.local_size = int(
+                os.environ.get("HOROVOD_LOCAL_SIZE", str(env_size)))
+            st.local_rank = int(
+                os.environ.get("HOROVOD_LOCAL_RANK", str(st.rank)))
+            st.cross_size = int(os.environ.get(
+                "HOROVOD_CROSS_SIZE",
+                str(max(1, env_size // max(st.local_size, 1)))))
+            st.cross_rank = int(os.environ.get(
+                "HOROVOD_CROSS_RANK", str(st.rank // max(st.local_size, 1))))
+
         st.initialized = True
         st.shut_down = False
         log.debug(

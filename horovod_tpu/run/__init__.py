@@ -1,0 +1,12 @@
+"""Launcher package (``tpurun``) — reference: horovod/run/ (SURVEY.md §2.6)."""
+
+from horovod_tpu.run.hosts import HostInfo, SlotInfo, allocate, parse_hosts
+from horovod_tpu.run.launcher import launch_job
+from horovod_tpu.run.rendezvous import KVStoreClient, RendezvousServer
+from horovod_tpu.run.run import main, run_commandline
+
+__all__ = [
+    "HostInfo", "SlotInfo", "allocate", "parse_hosts",
+    "launch_job", "RendezvousServer", "KVStoreClient",
+    "run_commandline", "main",
+]

@@ -1,0 +1,6 @@
+"""``python -m horovod_tpu.run`` — entry point for the tpurun launcher."""
+
+from horovod_tpu.run.run import main
+
+if __name__ == "__main__":
+    main()

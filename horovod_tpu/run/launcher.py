@@ -1,0 +1,196 @@
+"""Process fan-out: run one worker per slot, locally or over ssh.
+
+TPU-native port of the reference's gloo launcher (reference:
+horovod/run/gloo_run.py:211-301): for every allocated slot, build the
+worker env (slot contract + rendezvous + knobs), spawn the command —
+``exec`` locally, ``ssh`` for remote hosts — stream tag-prefixed output
+(optionally also captured to ``<output_dir>/rank.N/``), and terminate the
+whole job when any worker exits non-zero (gloo_run.py:256-262) or the
+launcher receives SIGINT/SIGTERM.
+
+On top of the reference contract the launcher also wires up
+``jax.distributed`` (HOROVOD_COORDINATOR_ADDR / NUM_PROCESSES /
+PROCESS_ID) so every process joins one global TPU mesh — the TPU-native
+equivalent of NCCL communicator bootstrap.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from horovod_tpu.run import util
+from horovod_tpu.run.hosts import SlotInfo
+from horovod_tpu.run.rendezvous import RendezvousServer
+
+LOCAL_HOSTNAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def is_local_host(hostname: str) -> bool:
+    if hostname in LOCAL_HOSTNAMES:
+        return True
+    try:
+        return hostname in (socket.gethostname(), socket.getfqdn())
+    except OSError:
+        return False
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("0.0.0.0", 0))
+        return s.getsockname()[1]
+
+
+def get_driver_ip(slots: List[SlotInfo]) -> str:
+    """Address remote workers use to reach the launcher host."""
+    if all(is_local_host(s.hostname) for s in slots):
+        return "127.0.0.1"
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return socket.gethostname()
+
+
+def build_worker_env(slot: SlotInfo, base_env: Dict[str, str],
+                     driver_ip: str, socket_port: int, http_port: int,
+                     coordinator_port: int, num_processes: int,
+                     use_jax_distributed: bool = True) -> Dict[str, str]:
+    """Full worker environment: launcher contract (reference:
+    gloo_run.py:211-240) + jax.distributed bootstrap.
+
+    Two rendezvous channels: the native socket controller's coordinator
+    (rank 0 binds ``socket_port``; others dial it — the analogue of the
+    gloo TCP context) and the launcher's HTTP KV store on ``http_port``
+    (the analogue of the reference's rendezvous server)."""
+    env = dict(base_env)
+    env.update(slot.to_env())
+    env.update({
+        "HOROVOD_CONTROLLER": env.get("HOROVOD_CONTROLLER", "socket"),
+        "HOROVOD_CPU_OPERATIONS": env.get("HOROVOD_CPU_OPERATIONS", "socket"),
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": driver_ip,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(socket_port),
+        "HOROVOD_RENDEZVOUS_HTTP_ADDR": driver_ip,
+        "HOROVOD_RENDEZVOUS_HTTP_PORT": str(http_port),
+    })
+    if use_jax_distributed:
+        env.update({
+            "HOROVOD_COORDINATOR_ADDR": f"{driver_ip}:{coordinator_port}",
+            "HOROVOD_NUM_PROCESSES": str(num_processes),
+            "HOROVOD_PROCESS_ID": str(slot.rank),
+        })
+    return env
+
+
+def _ssh_command(slot: SlotInfo, command: str, env: Dict[str, str],
+                 ssh_port: Optional[int]) -> str:
+    """Wrap the command for ssh execution, exporting the worker env
+    explicitly (ssh does not forward the environment)."""
+    exports = " ".join(
+        f"export {k}={shlex.quote(v)};" for k, v in sorted(env.items())
+        if k.startswith(("HOROVOD_", "JAX_", "XLA_", "PATH", "PYTHONPATH",
+                         "LD_LIBRARY_PATH", "TPU_")))
+    port_arg = f"-p {ssh_port} " if ssh_port else ""
+    remote = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1; {exports} {command}"
+    return (f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no "
+            f"{port_arg}{slot.hostname} {shlex.quote(remote)}")
+
+
+def launch_job(command: str, slots: List[SlotInfo],
+               env: Optional[Dict[str, str]] = None,
+               ssh_port: Optional[int] = None,
+               output_dir: Optional[str] = None,
+               use_jax_distributed: bool = True,
+               prefix_output: bool = True,
+               start_timeout: float = 300.0) -> int:
+    """Run ``command`` on every slot; returns the job exit code (first
+    non-zero worker code, else 0). Starts the rendezvous KV server for the
+    job's lifetime."""
+    base_env = dict(os.environ if env is None else env)
+    driver_ip = get_driver_ip(slots)
+
+    rendezvous = RendezvousServer()
+    http_port = rendezvous.start()
+    socket_port = _free_port()
+    coordinator_port = _free_port()
+
+    exit_codes: List[Optional[int]] = [None] * len(slots)
+    failure = threading.Event()
+    first_failure: List[Optional[int]] = [None]
+    failure_lock = threading.Lock()
+
+    def run_slot(i: int, slot: SlotInfo) -> None:
+        worker_env = build_worker_env(
+            slot, base_env, driver_ip, socket_port, http_port,
+            coordinator_port,
+            num_processes=len(slots),
+            use_jax_distributed=use_jax_distributed)
+        if is_local_host(slot.hostname):
+            cmd = command
+        else:
+            cmd = _ssh_command(slot, command, worker_env, ssh_port)
+
+        stdout = stderr = None
+        files = []
+        try:
+            if output_dir:
+                rank_dir = os.path.join(output_dir, f"rank.{slot.rank}")
+                os.makedirs(rank_dir, exist_ok=True)
+                stdout = open(os.path.join(rank_dir, "stdout"), "w")
+                stderr = open(os.path.join(rank_dir, "stderr"), "w")
+                files = [stdout, stderr]
+            code = util.execute(
+                cmd, env=worker_env,
+                stdout=stdout or sys.stdout, stderr=stderr or sys.stderr,
+                index=slot.rank, events=[failure],
+                prefix_output=prefix_output)
+            exit_codes[i] = code
+            if code not in (0, None):
+                # report the code of the worker that failed first, not of
+                # workers we subsequently tore down (gloo_run.py:256-262)
+                with failure_lock:
+                    if not failure.is_set():
+                        first_failure[0] = code
+                    failure.set()
+        finally:
+            for f in files:
+                f.close()
+
+    threads = [threading.Thread(target=run_slot, args=(i, s), daemon=True)
+               for i, s in enumerate(slots)]
+
+    prev_handlers = {}
+
+    def on_signal(signum, frame):
+        failure.set()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            prev_handlers[sig] = signal.signal(sig, on_signal)
+        except ValueError:  # not main thread (tests)
+            pass
+
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
+        rendezvous.stop()
+
+    if first_failure[0] is not None:
+        return first_failure[0]
+    for code in exit_codes:
+        if code not in (0, None):
+            return code
+    if any(code is None for code in exit_codes):
+        return 1
+    return 0

@@ -1,0 +1,155 @@
+"""Rendezvous HTTP key-value store.
+
+TPU-native port of the reference's launcher rendezvous service (reference:
+horovod/run/rendezvous/http_server.py:140-204): a threaded HTTP server
+holding scoped KV maps — ``global``, ``local_<cross_rank>``,
+``cross_<local_rank>`` — that worker processes use to find each other
+before any collective channel exists. PUT stores a value, GET returns 404
+until the key appears (clients long-poll), DELETE marks a rank finished so
+the launcher can reap the scope.
+
+The socket data plane only needs the coordinator address (rank 0), which
+the launcher passes directly in env; this store exists for everything else
+— worker liveness, result collection, object exchange before init, and the
+driver/task services (service.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _split(self):
+        parts = self.path.strip("/").split("/", 1)
+        if len(parts) != 2 or not parts[0] or not parts[1]:
+            self.send_error(400, "path must be /scope/key")
+            return None
+        return parts[0], parts[1]
+
+    def do_PUT(self):
+        sk = self._split()
+        if sk is None:
+            return
+        scope, key = sk
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.lock:
+            self.server.store.setdefault(scope, {})[key] = value
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        sk = self._split()
+        if sk is None:
+            return
+        scope, key = sk
+        with self.server.lock:
+            value = self.server.store.get(scope, {}).get(key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):
+        # a rank declaring itself finished with the scope
+        # (reference: http_server.py scope_size bookkeeping)
+        sk = self._split()
+        if sk is None:
+            return
+        scope, key = sk
+        with self.server.lock:
+            self.server.store.get(scope, {}).pop(key, None)
+            self.server.finished.setdefault(scope, set()).add(key)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class RendezvousServer:
+    """Launcher-side store. ``start()`` returns the bound port."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.store = {}  # type: ignore[attr-defined]
+        self._httpd.finished = {}  # type: ignore[attr-defined]
+        self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # launcher-side introspection
+    def finished_keys(self, scope: str) -> set:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return set(self._httpd.finished.get(scope, set()))  # type: ignore
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return self._httpd.store.get(scope, {}).get(key)  # type: ignore
+
+
+class KVStoreClient:
+    """Worker-side client (reference: the gloo HTTPStore,
+    common/gloo/http_store.cc — set/get/wait against the launcher server)."""
+
+    def __init__(self, addr: str, port: int, scope: str = "global",
+                 timeout: float = 60.0):
+        self._base = f"http://{addr}:{port}"
+        self._scope = scope
+        self._timeout = timeout
+
+    def _url(self, key: str, scope: Optional[str] = None) -> str:
+        return f"{self._base}/{scope or self._scope}/{key}"
+
+    def set(self, key: str, value: bytes, scope: Optional[str] = None) -> None:
+        req = Request(self._url(key, scope), data=value, method="PUT")
+        urlopen(req, timeout=10).read()
+
+    def get(self, key: str, scope: Optional[str] = None,
+            wait: bool = True) -> bytes:
+        deadline = time.monotonic() + self._timeout
+        while True:
+            try:
+                return urlopen(self._url(key, scope), timeout=10).read()
+            except HTTPError as e:
+                if e.code != 404 or not wait:
+                    raise KeyError(key) from e
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"rendezvous key {key!r} not published within "
+                    f"{self._timeout}s")
+            time.sleep(0.05)
+
+    def finish(self, key: str, scope: Optional[str] = None) -> None:
+        req = Request(self._url(key, scope), method="DELETE")
+        urlopen(req, timeout=10).read()

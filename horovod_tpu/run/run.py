@@ -1,0 +1,315 @@
+"""``tpurun`` — the launcher CLI (≡ ``horovodrun``).
+
+TPU-native port of the reference CLI (reference: horovod/run/run.py:374-732
+and bin/horovodrun): parse flags / YAML config into the HOROVOD_* env
+contract, check host reachability, allocate slots, and fan the training
+command out across hosts.
+
+    tpurun -np 4 -H host1:2,host2:2 python train.py
+    tpurun -np 8 python train.py           # 8 local workers
+    tpurun --check-build
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import textwrap
+import time
+from typing import List, Optional
+
+from horovod_tpu.run import config_parser, hosts as hosts_mod, launcher
+from horovod_tpu.run import util
+from horovod_tpu.version import __version__
+
+SSH_CHECK_TIMEOUT_S = 30
+# reference caches ssh reachability results for 60 minutes in ~/.horovod
+# (run/run.py:49-60)
+CACHE_TTL_S = 60 * 60
+CACHE_DIR = os.path.expanduser("~/.horovod_tpu")
+
+
+class _RecordAction(argparse.Action):
+    """Records explicitly-passed flags so config-file precedence can be
+    applied (reference: run.py:422-425 _add_arg tracking)."""
+
+    def __init__(self, option_strings, dest, nargs=None, const=None, **kw):
+        self._const = const
+        self._nargs = nargs
+        super().__init__(option_strings, dest, nargs=nargs, const=const, **kw)
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        if self._const is not None and values in (None, []):
+            values = self._const
+        setattr(namespace, self.dest, values)
+        if not hasattr(namespace, "seen_args"):
+            namespace.seen_args = set()
+        namespace.seen_args.add(self.dest)
+
+
+def _add(parser, *flags, **kw):
+    if kw.get("action") == "store_true":
+        kw.pop("action")
+        kw.update(action=_RecordAction, nargs=0, const=True, default=kw.get(
+            "default", None))
+    else:
+        kw.setdefault("action", _RecordAction)
+    parser.add_argument(*flags, **kw)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="tpurun",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        description="Launch a distributed horovod_tpu job.",
+        epilog=textwrap.dedent("""\
+            Example:
+                tpurun -np 4 -H host1:2,host2:2 python train.py
+            """))
+    parser.add_argument("-v", "--version", action="version",
+                        version=__version__)
+    _add(parser, "-np", "--num-proc", dest="np", type=int,
+         help="Total number of worker processes (one per TPU chip).")
+    _add(parser, "-H", "--hosts", dest="hosts",
+         help="Comma-separated host:slots list, e.g. host1:4,host2:4.")
+    _add(parser, "--hostfile", dest="hostfile",
+         help="mpirun-style hostfile ('hostname slots=N' per line).")
+    _add(parser, "-p", "--ssh-port", dest="ssh_port", type=int,
+         help="SSH port on all hosts.")
+    _add(parser, "--start-timeout", dest="start_timeout", type=int,
+         default=600, help="Seconds to wait for all processes to start.")
+    _add(parser, "--output-filename", dest="output_dir",
+         help="Capture each rank's output under <dir>/rank.N/std{out,err}.")
+    _add(parser, "--verbose", dest="verbose", action="store_true",
+         help="Verbose launcher logging.")
+    _add(parser, "--disable-cache", dest="disable_cache",
+         action="store_true",
+         help="Do not cache ssh reachability checks.")
+    parser.add_argument("--check-build", action="store_true",
+                        help="Print capability report and exit "
+                             "(reference: run/run.py:268-303).")
+    _add(parser, "--config-file", dest="config_file",
+         help="YAML config file; flags given after it take precedence.")
+    _add(parser, "--no-jax-distributed", dest="no_jax_distributed",
+         action="store_true",
+         help="Do not bootstrap jax.distributed (host data plane only).")
+    _add(parser, "--mesh-shape", dest="mesh_shape",
+         help="Global mesh as 'cross,local' (default: hosts x slots).")
+
+    params = parser.add_argument_group("tunable parameters")
+    _add(params, "--fusion-threshold-mb", dest="fusion_threshold_mb",
+         type=float, help="Tensor fusion buffer threshold in MB.")
+    _add(params, "--cycle-time-ms", dest="cycle_time_ms", type=float,
+         help="Background cycle time in ms.")
+    _add(params, "--cache-capacity", dest="cache_capacity", type=int,
+         help="Response cache capacity.")
+    _add(params, "--hierarchical-allreduce", dest="hierarchical_allreduce",
+         action="store_true",
+         help="Force two-level (ICI then DCN) allreduce.")
+    _add(params, "--hierarchical-allgather", dest="hierarchical_allgather",
+         action="store_true",
+         help="Force two-level (ICI then DCN) allgather.")
+
+    timeline = parser.add_argument_group("timeline")
+    _add(timeline, "--timeline-filename", dest="timeline_filename",
+         help="Chrome-trace timeline output (rank 0).")
+    _add(timeline, "--timeline-mark-cycles", dest="timeline_mark_cycles",
+         action="store_true", help="Mark cycles in the timeline.")
+
+    autotune = parser.add_argument_group("autotune")
+    _add(autotune, "--autotune", dest="autotune", action="store_true",
+         help="Enable Bayesian autotuning of fusion/cycle parameters.")
+    _add(autotune, "--autotune-log-file", dest="autotune_log_file",
+         help="CSV log of autotune trials.")
+    _add(autotune, "--autotune-warmup-samples", dest="autotune_warmup_samples",
+         type=int, help="Discarded warmup samples per trial.")
+    _add(autotune, "--autotune-steps-per-sample",
+         dest="autotune_steps_per_sample", type=int,
+         help="Steps per timing sample.")
+    _add(autotune, "--autotune-bayes-opt-max-samples",
+         dest="autotune_bayes_opt_max_samples", type=int,
+         help="Max Bayesian-optimization samples.")
+    _add(autotune, "--autotune-gaussian-process-noise",
+         dest="autotune_gaussian_process_noise", type=float,
+         help="GP noise regularization in [0, 1].")
+
+    stall = parser.add_argument_group("stall check")
+    _add(stall, "--no-stall-check", dest="no_stall_check",
+         action="store_true", help="Disable the stall inspector.")
+    _add(stall, "--stall-check-warning-time-seconds",
+         dest="stall_check_warning_time_seconds", type=float,
+         help="Seconds before a stall warning is logged.")
+    _add(stall, "--stall-check-shutdown-time-seconds",
+         dest="stall_check_shutdown_time_seconds", type=float,
+         help="Seconds before a stall aborts the job (0 = never).")
+
+    logging_group = parser.add_argument_group("logging")
+    _add(logging_group, "--log-level", dest="log_level",
+         choices=["trace", "debug", "info", "warning", "error", "fatal"],
+         help="Runtime log level.")
+    _add(logging_group, "--log-hide-timestamp", dest="log_hide_timestamp",
+         action="store_true", help="Hide timestamps in log output.")
+
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="Training command to run on every slot.")
+
+    args = parser.parse_args(argv)
+    if not hasattr(args, "seen_args"):
+        args.seen_args = set()
+
+    if args.config_file:
+        config = config_parser.parse_config_file(args.config_file)
+        config_parser.set_args_from_config_file(args, config)
+    config_parser.validate_config_args(args)
+    return args
+
+
+def check_build(out=sys.stdout) -> None:
+    """Capability report (reference: run/run.py:268-303 --check-build)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.runtime.native import native_built
+
+    def mark(flag: bool) -> str:
+        return "[X]" if flag else "[ ]"
+
+    out.write(textwrap.dedent(f"""\
+        horovod_tpu v{__version__}:
+
+        Available frameworks:
+            {mark(True)} JAX
+            {mark(_flax_available())} Flax
+
+        Available controllers:
+            {mark(True)} XLA (in-jit SPMD)
+            {mark(native_built())} Socket (native TCP)
+
+        Available tensor operations:
+            {mark(hvd.xla_built())} XLA collectives (ICI/DCN)
+            {mark(native_built())} Native host ring
+            {mark(hvd.mpi_built())} MPI
+            {mark(hvd.nccl_built())} NCCL
+            {mark(hvd.gloo_built())} Gloo
+        """))
+
+
+def _flax_available() -> bool:
+    try:
+        import flax  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# ssh reachability (reference: run/run.py:60-112, cached per run/run.py:49-60)
+# ---------------------------------------------------------------------------
+
+def _cache_path() -> str:
+    return os.path.join(CACHE_DIR, "ssh_checks.json")
+
+
+def check_all_hosts_ssh_successful(hostnames: List[str],
+                                   ssh_port: Optional[int] = None,
+                                   use_cache: bool = True) -> None:
+    import json
+
+    remote = [h for h in hostnames if not launcher.is_local_host(h)]
+    if not remote:
+        return
+
+    cache = {}
+    if use_cache and os.path.exists(_cache_path()):
+        try:
+            with open(_cache_path()) as f:
+                cache = json.load(f)
+        except (ValueError, OSError):
+            cache = {}
+
+    now = time.time()
+    failed = []
+    for host in remote:
+        entry = cache.get(host)
+        if entry and now - entry < CACHE_TTL_S:
+            continue
+        port_arg = f"-p {ssh_port}" if ssh_port else ""
+        result = subprocess.run(
+            f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no "
+            f"{port_arg} {host} true",
+            shell=True, capture_output=True,
+            timeout=SSH_CHECK_TIMEOUT_S)
+        if result.returncode == 0:
+            cache[host] = now
+        else:
+            failed.append(host)
+
+    if use_cache:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        with open(_cache_path(), "w") as f:
+            json.dump(cache, f)
+
+    if failed:
+        raise RuntimeError(
+            "passwordless ssh checked failed for hosts: "
+            + ", ".join(failed)
+            + ". Set up passwordless ssh or run single-host.")
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+
+    if args.check_build:
+        check_build()
+        return 0
+
+    command = list(args.command or [])
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        sys.stderr.write("tpurun: no command given\n")
+        return 2
+
+    if args.hostfile:
+        host_infos = hosts_mod.parse_hostfile(args.hostfile)
+    elif args.hosts:
+        host_infos = hosts_mod.parse_hosts(args.hosts)
+    else:
+        nproc = args.np or 1
+        host_infos = [hosts_mod.HostInfo("localhost", nproc)]
+    np = args.np or sum(h.slots for h in host_infos)
+
+    check_all_hosts_ssh_successful(
+        [h.hostname for h in host_infos], args.ssh_port,
+        use_cache=not args.disable_cache)
+
+    slots = hosts_mod.allocate(host_infos, np)
+    if args.verbose:
+        for s in slots:
+            sys.stderr.write(f"tpurun: rank {s.rank} -> {s.hostname} "
+                             f"(local {s.local_rank}/{s.local_size}, "
+                             f"cross {s.cross_rank}/{s.cross_size})\n")
+
+    env = dict(os.environ)
+    env.update(config_parser.env_from_args(args))
+    env["HOROVOD_NP"] = str(np)
+
+    import shlex as _shlex
+    command_str = " ".join(_shlex.quote(c) for c in command)
+    return launcher.launch_job(
+        command_str, slots, env=env, ssh_port=args.ssh_port,
+        output_dir=args.output_dir,
+        use_jax_distributed=not args.no_jax_distributed,
+        start_timeout=args.start_timeout)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,236 @@
+"""Generic driver/task TCP services.
+
+TPU-native port of the reference's launcher service pair (reference:
+horovod/run/common/service/driver_service.py, task_service.py;
+run/common/util/network.py): small request/response servers speaking the
+HMAC-authenticated pickle ``Wire`` (util.py). The driver runs next to
+``tpurun``; one task service runs on every host to (a) prove the host is
+reachable, (b) report its routable addresses (the reference's NIC-discovery
+ring, run/run.py:195-265), and (c) execute commands on behalf of the driver
+(the Spark-style launch path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.run import util
+
+
+# -- request types (reference: driver_service/task_service message classes) --
+
+@dataclasses.dataclass
+class RegisterTaskRequest:
+    index: int
+    addresses: List[Tuple[str, int]]
+    host_hash: str
+
+
+@dataclasses.dataclass
+class AllTaskAddressesRequest:
+    index: int
+
+
+@dataclasses.dataclass
+class RunCommandRequest:
+    command: str
+    env: dict
+
+
+@dataclasses.dataclass
+class CommandExitCodeRequest:
+    pass
+
+
+@dataclasses.dataclass
+class PingRequest:
+    pass
+
+
+@dataclasses.dataclass
+class OkResponse:
+    payload: object = None
+
+
+@dataclasses.dataclass
+class ErrorResponse:
+    message: str = ""
+
+
+def local_addresses(port: int) -> List[Tuple[str, int]]:
+    """All non-loopback addresses this host answers on, plus loopback as a
+    fallback — the launcher intersects these across hosts the way the
+    reference's ring probe intersects NICs (run/run.py:195-265)."""
+    addrs: List[Tuple[str, int]] = []
+    try:
+        host = socket.gethostname()
+        for info in socket.getaddrinfo(host, None, socket.AF_INET):
+            ip = info[4][0]
+            if (ip, port) not in addrs:
+                addrs.append((ip, port))
+    except socket.gaierror:
+        pass
+    # address used for a default route, if any
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            ip = s.getsockname()[0]
+            if (ip, port) not in addrs:
+                addrs.insert(0, (ip, port))
+    except OSError:
+        pass
+    if ("127.0.0.1", port) not in addrs:
+        addrs.append(("127.0.0.1", port))
+    return addrs
+
+
+class _WireHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        wire: util.Wire = self.server.wire  # type: ignore[attr-defined]
+        try:
+            req = wire.read(self.rfile)
+        except (EOFError, IOError):
+            return
+        try:
+            resp = self.server.service._handle(req)  # type: ignore
+        except Exception as e:  # noqa: BLE001 — ship the error to the caller
+            resp = ErrorResponse(str(e))
+        try:
+            wire.write(resp, self.wfile)
+        except (BrokenPipeError, IOError):
+            pass
+
+
+class BasicService:
+    """Threaded TCP service with the HMAC wire protocol."""
+
+    def __init__(self, key: bytes, port: int = 0):
+        self._server = socketserver.ThreadingTCPServer(
+            ("0.0.0.0", port), _WireHandler, bind_and_activate=True)
+        self._server.daemon_threads = True
+        self._server.wire = util.Wire(key)  # type: ignore[attr-defined]
+        self._server.service = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _handle(self, req):
+        if isinstance(req, PingRequest):
+            return OkResponse()
+        return ErrorResponse(f"unhandled request {type(req).__name__}")
+
+
+class DriverService(BasicService):
+    """Collects task registrations (reference:
+    run/common/service/driver_service.py)."""
+
+    def __init__(self, key: bytes, num_tasks: int, port: int = 0):
+        self._lock = threading.Lock()
+        self._tasks: Dict[int, RegisterTaskRequest] = {}
+        self._all_registered = threading.Event()
+        self._num_tasks = num_tasks
+        super().__init__(key, port)
+
+    def _handle(self, req):
+        if isinstance(req, RegisterTaskRequest):
+            with self._lock:
+                self._tasks[req.index] = req
+                if len(self._tasks) >= self._num_tasks:
+                    self._all_registered.set()
+            return OkResponse()
+        if isinstance(req, AllTaskAddressesRequest):
+            with self._lock:
+                task = self._tasks.get(req.index)
+            if task is None:
+                return ErrorResponse(f"task {req.index} not registered")
+            return OkResponse(task.addresses)
+        return super()._handle(req)
+
+    def wait_for_initial_registration(self, timeout: util.Timeout) -> None:
+        while not self._all_registered.wait(timeout=0.1):
+            timeout.check()
+
+    def task_addresses(self) -> Dict[int, List[Tuple[str, int]]]:
+        with self._lock:
+            return {i: t.addresses for i, t in self._tasks.items()}
+
+    def task_host_hashes(self) -> Dict[int, str]:
+        with self._lock:
+            return {i: t.host_hash for i, t in self._tasks.items()}
+
+
+class TaskService(BasicService):
+    """Per-host agent: registers with the driver, can run commands
+    (reference: run/common/service/task_service.py:155)."""
+
+    def __init__(self, key: bytes, index: int, port: int = 0):
+        self.index = index
+        self._command_proc = None
+        self._command_lock = threading.Lock()
+        super().__init__(key, port)
+
+    def _handle(self, req):
+        if isinstance(req, RunCommandRequest):
+            import subprocess
+
+            with self._command_lock:
+                if self._command_proc is not None:
+                    return ErrorResponse("command already running")
+                self._command_proc = subprocess.Popen(
+                    req.command, shell=True, env=req.env,
+                    start_new_session=True)
+            return OkResponse()
+        if isinstance(req, CommandExitCodeRequest):
+            with self._command_lock:
+                proc = self._command_proc
+            if proc is None:
+                return OkResponse(None)
+            return OkResponse(proc.poll())
+        return super()._handle(req)
+
+    def register(self, driver_addr: Tuple[str, int], key: bytes,
+                 timeout: Optional[util.Timeout] = None) -> None:
+        req = RegisterTaskRequest(
+            self.index, local_addresses(self.port), util.host_hash())
+        client = ServiceClient(driver_addr, key)
+        timeout = timeout or util.Timeout(60, "driver registration")
+        while True:
+            try:
+                client.call(req)
+                return
+            except (ConnectionError, OSError):
+                timeout.check()
+                time.sleep(0.2)
+
+
+class ServiceClient:
+    """One-shot request/response client for BasicService servers."""
+
+    def __init__(self, addr: Tuple[str, int], key: bytes,
+                 timeout: float = 10.0):
+        self._addr = addr
+        self._wire = util.Wire(key)
+        self._timeout = timeout
+
+    def call(self, req):
+        with socket.create_connection(self._addr, timeout=self._timeout) as s:
+            rfile = s.makefile("rb")
+            wfile = s.makefile("wb")
+            self._wire.write(req, wfile)
+            resp = self._wire.read(rfile)
+        if isinstance(resp, ErrorResponse):
+            raise RuntimeError(f"service error: {resp.message}")
+        return resp.payload if isinstance(resp, OkResponse) else resp

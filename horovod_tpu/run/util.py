@@ -1,0 +1,227 @@
+"""Launcher utility belt: secrets, wire codec, process exec, host hashing.
+
+TPU-native equivalents of the reference's ``horovod/run/common/util/``
+modules (reference: secret.py, codec.py, network.py, safe_shell_exec.py,
+host_hash.py, timeout.py — SURVEY.md §2.6). Same responsibilities, no
+cloudpickle dependency (stdlib pickle + HMAC-SHA256).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import pickle
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# secret (reference: run/common/util/secret.py:26-36)
+# ---------------------------------------------------------------------------
+
+SECRET_LENGTH = 32
+SECRET_ENV = "HOROVOD_SECRET_KEY"
+
+
+def make_secret_key() -> bytes:
+    """Per-run random key used to HMAC every launcher wire message."""
+    return os.urandom(SECRET_LENGTH)
+
+
+def encode_secret(key: bytes) -> str:
+    return base64.b64encode(key).decode("ascii")
+
+
+def decode_secret(text: str) -> bytes:
+    return base64.b64decode(text.encode("ascii"))
+
+
+# ---------------------------------------------------------------------------
+# codec (reference: run/common/util/codec.py)
+# ---------------------------------------------------------------------------
+
+def dumps_base64(obj) -> str:
+    return base64.b64encode(pickle.dumps(obj)).decode("ascii")
+
+
+def loads_base64(text: str):
+    return pickle.loads(base64.b64decode(text.encode("ascii")))
+
+
+# ---------------------------------------------------------------------------
+# HMAC'd message framing (reference: run/common/util/network.py:50-84 — the
+# ``Wire`` class: every payload is followed by an HMAC-SHA256 digest keyed
+# with the per-run secret; receivers verify before unpickling)
+# ---------------------------------------------------------------------------
+
+class Wire:
+    """Length-prefixed, HMAC-authenticated pickle framing over a socket
+    file. Authenticating before unpickling is what makes the launcher's
+    TCP services safe to expose on cluster networks."""
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    def write(self, obj, wfile) -> None:
+        payload = pickle.dumps(obj)
+        digest = hmac.new(self._key, payload, hashlib.sha256).digest()
+        wfile.write(len(payload).to_bytes(8, "big"))
+        wfile.write(digest)
+        wfile.write(payload)
+        wfile.flush()
+
+    def read(self, rfile):
+        header = _read_exactly(rfile, 8)
+        length = int.from_bytes(header, "big")
+        if length > (1 << 31):
+            raise IOError(f"wire message too large: {length}")
+        digest = _read_exactly(rfile, 32)
+        payload = _read_exactly(rfile, length)
+        expected = hmac.new(self._key, payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(digest, expected):
+            raise IOError("wire message failed HMAC verification")
+        return pickle.loads(payload)
+
+
+def _read_exactly(rfile, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = rfile.read(n - len(buf))
+        if not chunk:
+            raise EOFError("connection closed mid-message")
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# host hash (reference: run/common/util/host_hash.py:38) — ranks on the same
+# node must agree on a node identity for local_rank assignment
+# ---------------------------------------------------------------------------
+
+def host_hash(salt: str = "") -> str:
+    hostname = socket.gethostname()
+    return hashlib.md5((hostname + salt).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# timeout helper (reference: run/common/util/timeout.py:32)
+# ---------------------------------------------------------------------------
+
+class Timeout:
+    def __init__(self, timeout_sec: float, message: str = "operation"):
+        self._deadline = time.monotonic() + timeout_sec
+        self._message = message
+
+    def remaining(self) -> float:
+        return max(0.0, self._deadline - time.monotonic())
+
+    def timed_out(self) -> bool:
+        return time.monotonic() >= self._deadline
+
+    def check(self) -> None:
+        if self.timed_out():
+            raise TimeoutError(
+                f"{self._message} timed out. This may indicate that a host "
+                f"is unreachable or the job failed to start; check "
+                f"connectivity and per-rank logs.")
+
+
+# ---------------------------------------------------------------------------
+# safe shell exec (reference: run/common/util/safe_shell_exec.py:29-57).
+# The reference interposes a middleman process that forwards signals and
+# kills the whole process tree; on Linux we get the same guarantee with a
+# dedicated session (setsid) + killpg.
+# ---------------------------------------------------------------------------
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def execute(command, env: Optional[dict] = None, stdout=None, stderr=None,
+            index: Optional[int] = None, events=None,
+            prefix_output: bool = True) -> int:
+    """Run ``command`` (shell string) in its own process group, streaming
+    output to ``stdout``/``stderr`` (file-like), optionally prefixed with
+    ``[index]<tag>`` per line like mpirun --tag-output. ``events`` is a list
+    of ``threading.Event``; when any fires, the process tree is terminated
+    (SIGTERM, then SIGKILL after a grace period)."""
+    proc = subprocess.Popen(
+        command, shell=True, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+
+    stop = threading.Event()
+    watchers = []
+    for event in (events or []):
+        t = threading.Thread(
+            target=_wait_then_kill, args=(event, stop, proc), daemon=True)
+        t.start()
+        watchers.append(t)
+
+    pumps = []
+    for src, dst, tag in ((proc.stdout, stdout or sys.stdout, "stdout"),
+                          (proc.stderr, stderr or sys.stderr, "stderr")):
+        t = threading.Thread(
+            target=_pump, args=(src, dst, index, tag, prefix_output),
+            daemon=True)
+        t.start()
+        pumps.append(t)
+
+    try:
+        proc.wait()
+    finally:
+        stop.set()
+    for t in pumps:
+        t.join(timeout=5)
+    return proc.returncode
+
+
+def _wait_then_kill(event: threading.Event, stop: threading.Event, proc):
+    while not stop.is_set():
+        if event.wait(timeout=0.1):
+            break
+    if stop.is_set() or proc.poll() is not None:
+        return
+    terminate_tree(proc)
+
+
+def terminate_tree(proc) -> None:
+    """SIGTERM the process group, escalate to SIGKILL after the grace
+    period (reference: safe_shell_exec's tree-kill contract)."""
+    try:
+        pgid = os.getpgid(proc.pid)
+    except (ProcessLookupError, PermissionError):
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.1)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def _pump(src, dst, index, tag, prefix: bool) -> None:
+    try:
+        for raw in iter(src.readline, b""):
+            line = raw.decode("utf-8", errors="replace")
+            if prefix and index is not None:
+                line = f"[{index}]<{tag}>: {line}"
+            try:
+                dst.write(line)
+                dst.flush()
+            except ValueError:  # closed file
+                return
+    except Exception:
+        pass

@@ -1,0 +1,376 @@
+"""Launcher tests — slot allocation, config translation, wire security,
+rendezvous, services, and end-to-end tpurun fan-out.
+
+Mirrors the reference's launcher unit tests (reference: test/test_run.py:
+53-216 — pure-Python arg/config/env translation, no cluster) plus
+end-to-end local fan-out the reference exercises in its Docker images.
+"""
+
+import io
+import json
+import os
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+from horovod_tpu.run import config_parser, hosts, launcher, service, util
+from horovod_tpu.run.rendezvous import KVStoreClient, RendezvousServer
+from horovod_tpu.run.run import check_build, parse_args, run_commandline
+
+
+# ---------------------------------------------------------------------------
+# host parsing / slot allocation (reference: gloo_run.py:56-114 semantics)
+# ---------------------------------------------------------------------------
+
+def test_parse_hosts():
+    infos = hosts.parse_hosts("h1:2, h2:4,h3")
+    assert [(h.hostname, h.slots) for h in infos] == [
+        ("h1", 2), ("h2", 4), ("h3", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    path = tmp_path / "hostfile"
+    path.write_text("h1 slots=2\n# comment\nh2 slots=4\nh3\n")
+    infos = hosts.parse_hostfile(str(path))
+    assert [(h.hostname, h.slots) for h in infos] == [
+        ("h1", 2), ("h2", 4), ("h3", 1)]
+
+
+def test_allocate_uniform():
+    slots = hosts.allocate(hosts.parse_hosts("h1:2,h2:2"), 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [s.hostname for s in slots] == ["h1", "h1", "h2", "h2"]
+    assert [s.local_rank for s in slots] == [0, 1, 0, 1]
+    assert all(s.local_size == 2 for s in slots)
+    assert [s.cross_rank for s in slots] == [0, 0, 1, 1]
+    assert all(s.cross_size == 2 for s in slots)
+
+
+def test_allocate_heterogeneous():
+    # h1:3, h2:1 → local sizes differ; cross_size depends on local_rank
+    slots = hosts.allocate(hosts.parse_hosts("h1:3,h2:1"), 4)
+    by_rank = {s.rank: s for s in slots}
+    assert by_rank[3].hostname == "h2"
+    assert by_rank[3].local_rank == 0 and by_rank[3].local_size == 1
+    # local_rank 0 exists on both hosts
+    assert by_rank[0].cross_size == 2 and by_rank[3].cross_size == 2
+    # local_rank 1 and 2 exist only on h1
+    assert by_rank[1].cross_size == 1 and by_rank[2].cross_size == 1
+    assert by_rank[3].cross_rank == 1
+
+
+def test_allocate_truncates_to_np():
+    slots = hosts.allocate(hosts.parse_hosts("h1:4,h2:4"), 3)
+    assert len(slots) == 3
+    assert all(s.hostname == "h1" for s in slots)
+    assert slots[0].local_size == 3  # only 3 used on h1
+
+
+def test_allocate_oversubscribe_raises():
+    with pytest.raises(ValueError):
+        hosts.allocate(hosts.parse_hosts("h1:2"), 3)
+
+
+def test_slot_env_contract():
+    slot = hosts.allocate(hosts.parse_hosts("h1:2,h2:2"), 4)[2]
+    env = slot.to_env()
+    assert env["HOROVOD_RANK"] == "2"
+    assert env["HOROVOD_SIZE"] == "4"
+    assert env["HOROVOD_LOCAL_RANK"] == "0"
+    assert env["HOROVOD_CROSS_RANK"] == "1"
+
+
+# ---------------------------------------------------------------------------
+# CLI args / config file / env translation (reference: test_run.py:53-216)
+# ---------------------------------------------------------------------------
+
+def test_args_to_env():
+    args = parse_args(
+        ["-np", "2", "--fusion-threshold-mb", "32", "--cycle-time-ms", "7.5",
+         "--cache-capacity", "2048", "--timeline-filename", "/tmp/t.json",
+         "--autotune", "--log-level", "debug", "python", "train.py"])
+    env = config_parser.env_from_args(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "7.5"
+    assert env["HOROVOD_CACHE_CAPACITY"] == "2048"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_LOG_LEVEL"] == "debug"
+    assert args.command == ["python", "train.py"]
+
+
+def test_config_file_and_cli_precedence(tmp_path):
+    config = tmp_path / "cfg.yaml"
+    config.write_text(textwrap.dedent("""\
+        params:
+            fusion_threshold_mb: 16
+            cycle_time_ms: 3.0
+        timeline:
+            filename: /tmp/from_config.json
+        stall_check:
+            enabled: false
+        """))
+    # --cycle-time-ms on the CLI beats the config file
+    args = parse_args(
+        ["-np", "2", "--config-file", str(config),
+         "--cycle-time-ms", "9.0", "cmd"])
+    env = config_parser.env_from_args(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "9.0"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/from_config.json"
+    assert env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+
+
+def test_validation_rejects_bad_values():
+    with pytest.raises(ValueError):
+        parse_args(["-np", "2", "--cycle-time-ms", "-1", "cmd"])
+
+
+def test_check_build_reports_capabilities():
+    out = io.StringIO()
+    check_build(out)
+    text = out.getvalue()
+    assert "JAX" in text and "XLA collectives" in text
+    assert "[X] XLA (in-jit SPMD)" in text
+
+
+# ---------------------------------------------------------------------------
+# wire security (reference: network.py:50-84 HMAC framing)
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_and_tamper_rejection():
+    key = util.make_secret_key()
+    wire = util.Wire(key)
+    buf = io.BytesIO()
+    wire.write({"hello": [1, 2, 3]}, buf)
+    buf.seek(0)
+    assert wire.read(buf) == {"hello": [1, 2, 3]}
+
+    # flip a payload byte → HMAC must fail before unpickling
+    raw = bytearray(buf.getvalue())
+    raw[-1] ^= 0xFF
+    with pytest.raises(IOError):
+        wire.read(io.BytesIO(bytes(raw)))
+
+    # wrong key → reject
+    with pytest.raises(IOError):
+        util.Wire(util.make_secret_key()).read(io.BytesIO(buf.getvalue()))
+
+
+def test_secret_encode_roundtrip():
+    key = util.make_secret_key()
+    assert util.decode_secret(util.encode_secret(key)) == key
+
+
+# ---------------------------------------------------------------------------
+# rendezvous KV store (reference: run/rendezvous/http_server.py)
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_put_get_finish():
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    try:
+        client = KVStoreClient("127.0.0.1", port, scope="global", timeout=5)
+        with pytest.raises(KeyError):
+            client.get("addr", wait=False)
+        client.set("addr", b"1.2.3.4:99")
+        assert client.get("addr") == b"1.2.3.4:99"
+        # scoped keys are independent (global vs local_0)
+        client.set("addr", b"other", scope="local_0")
+        assert client.get("addr", scope="local_0") == b"other"
+        assert client.get("addr") == b"1.2.3.4:99"
+        client.finish("addr")
+        assert server.finished_keys("global") == {"addr"}
+    finally:
+        server.stop()
+
+
+def test_rendezvous_waits_for_publication():
+    import threading
+    import time as time_mod
+
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    try:
+        client = KVStoreClient("127.0.0.1", port, timeout=10)
+
+        def publish():
+            time_mod.sleep(0.3)
+            client.set("late", b"v")
+
+        t = threading.Thread(target=publish)
+        t.start()
+        assert client.get("late") == b"v"  # long-polls until published
+        t.join()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# driver/task services (reference: run/common/service/*)
+# ---------------------------------------------------------------------------
+
+def test_driver_task_registration_and_command():
+    key = util.make_secret_key()
+    driver = service.DriverService(key, num_tasks=2)
+    tasks = [service.TaskService(key, index=i) for i in range(2)]
+    try:
+        for t in tasks:
+            t.register(("127.0.0.1", driver.port), key)
+        driver.wait_for_initial_registration(util.Timeout(10, "registration"))
+        addrs = driver.task_addresses()
+        assert set(addrs) == {0, 1}
+
+        # driver asks task 0 to run a command, polls its exit code
+        client = service.ServiceClient(("127.0.0.1", tasks[0].port), key)
+        with tempfile.TemporaryDirectory() as d:
+            marker = os.path.join(d, "ran")
+            client.call(service.RunCommandRequest(
+                f"touch {marker}", dict(os.environ)))
+            deadline = 50
+            code = None
+            while deadline and code is None:
+                code = client.call(service.CommandExitCodeRequest())
+                deadline -= 1
+                if code is None:
+                    import time as time_mod
+                    time_mod.sleep(0.1)
+            assert code == 0
+            assert os.path.exists(marker)
+    finally:
+        driver.shutdown()
+        for t in tasks:
+            t.shutdown()
+
+
+def test_wrong_key_rejected_by_service():
+    key = util.make_secret_key()
+    driver = service.DriverService(key, num_tasks=1)
+    try:
+        bad_client = service.ServiceClient(
+            ("127.0.0.1", driver.port), util.make_secret_key())
+        with pytest.raises((EOFError, IOError, RuntimeError)):
+            bad_client.call(service.PingRequest())
+    finally:
+        driver.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end local fan-out
+# ---------------------------------------------------------------------------
+
+SCRIPT = textwrap.dedent("""\
+    import json, os, sys
+    keys = ["HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+            "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR", "HOROVOD_GLOO_RENDEZVOUS_PORT",
+            "HOROVOD_CYCLE_TIME"]
+    out = {k: os.environ.get(k) for k in keys}
+    path = os.path.join(os.environ["TEST_OUT_DIR"],
+                        "rank_%s.json" % os.environ["HOROVOD_RANK"])
+    with open(path, "w") as f:
+        json.dump(out, f)
+""")
+
+
+def test_tpurun_local_fanout(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(SCRIPT)
+    os.environ["TEST_OUT_DIR"] = str(tmp_path)
+    try:
+        code = run_commandline(
+            ["-np", "3", "--no-jax-distributed", "--cycle-time-ms", "2.5",
+             sys.executable, str(script)])
+    finally:
+        os.environ.pop("TEST_OUT_DIR", None)
+    assert code == 0
+    ranks = []
+    for r in range(3):
+        with open(tmp_path / f"rank_{r}.json") as f:
+            env = json.load(f)
+        ranks.append(int(env["HOROVOD_RANK"]))
+        assert env["HOROVOD_SIZE"] == "3"
+        assert env["HOROVOD_LOCAL_SIZE"] == "3"
+        assert env["HOROVOD_CROSS_SIZE"] == "1"
+        assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+        assert env["HOROVOD_GLOO_RENDEZVOUS_PORT"] is not None
+    assert sorted(ranks) == [0, 1, 2]
+
+
+def test_tpurun_output_capture(tmp_path):
+    outdir = tmp_path / "logs"
+    code = run_commandline(
+        ["-np", "2", "--no-jax-distributed",
+         "--output-filename", str(outdir),
+         sys.executable, "-c", "import os; print('hello from', os.environ['HOROVOD_RANK'])"])
+    assert code == 0
+    for r in range(2):
+        content = (outdir / f"rank.{r}" / "stdout").read_text()
+        assert f"hello from {r}" in content
+
+
+def test_tpurun_failure_propagates(tmp_path):
+    # rank 1 exits non-zero; job must fail (reference: gloo_run.py:256-262)
+    script = tmp_path / "fail.py"
+    script.write_text(textwrap.dedent("""\
+        import os, sys, time
+        if os.environ["HOROVOD_RANK"] == "1":
+            sys.exit(3)
+        time.sleep(30)  # must be killed, not run 30s
+    """))
+    import time as time_mod
+    t0 = time_mod.monotonic()
+    code = run_commandline(
+        ["-np", "2", "--no-jax-distributed", sys.executable, str(script)])
+    elapsed = time_mod.monotonic() - t0
+    assert code == 3
+    assert elapsed < 25  # surviving rank was torn down
+
+
+def test_tpurun_no_command_errors():
+    assert run_commandline(["-np", "2"]) == 2
+
+
+def test_tpurun_end_to_end_collective():
+    """tpurun-launched workers form a world and allreduce through the
+    socket controller — the full launcher→init→collective path the
+    reference exercises via `horovodrun -np 2 pytest ...`."""
+    from horovod_tpu.runtime.native import native_built
+
+    if not native_built():
+        pytest.skip("native transport not built")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "mp_worker.py")
+    env_backup = os.environ.get("XLA_FLAGS")
+    os.environ.pop("XLA_FLAGS", None)
+    try:
+        code = run_commandline(
+            ["-np", "2", "--no-jax-distributed",
+             sys.executable, worker, "collectives"])
+    finally:
+        if env_backup is not None:
+            os.environ["XLA_FLAGS"] = env_backup
+    assert code == 0
+
+
+def test_safe_exec_kills_process_tree():
+    import threading
+    import time as time_mod
+
+    event = threading.Event()
+    results = {}
+
+    def run():
+        results["code"] = util.execute(
+            f"{sys.executable} -c 'import time; time.sleep(60)'",
+            events=[event], prefix_output=False)
+
+    t = threading.Thread(target=run)
+    t.start()
+    time_mod.sleep(0.5)
+    event.set()
+    t.join(timeout=20)
+    assert not t.is_alive()
+    assert results["code"] != 0
