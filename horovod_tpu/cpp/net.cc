@@ -21,12 +21,14 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <cerrno>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -61,6 +63,57 @@ int recv_all(int fd, void* buf, size_t n) {
     }
     p += k;
     n -= static_cast<size_t>(k);
+  }
+  return 0;
+}
+
+// Full-duplex exchange: send `sn` bytes to `sfd` while receiving `rn` bytes
+// from `rfd`, making progress on whichever direction is ready. Required for
+// the ring steps: every rank sends and receives a chunk simultaneously, so a
+// blocking send of a chunk larger than the kernel socket buffers would
+// deadlock the whole ring (all ranks stuck in send, nobody draining).
+int duplex_exchange(int sfd, const void* send_buf, size_t sn, int rfd,
+                    void* recv_buf, size_t rn) {
+  const char* sp = static_cast<const char*>(send_buf);
+  char* rp = static_cast<char*>(recv_buf);
+  while (sn > 0 || rn > 0) {
+    pollfd fds[2];
+    nfds_t nfds = 0;
+    int si = -1, ri = -1;
+    if (sn > 0) {
+      si = nfds;
+      fds[nfds++] = {sfd, POLLOUT, 0};
+    }
+    if (rn > 0) {
+      ri = nfds;
+      fds[nfds++] = {rfd, POLLIN, 0};
+    }
+    int pr = ::poll(fds, nfds, -1);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t k = ::send(sfd, sp, sn, MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (k < 0) {
+        if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+          return -1;
+      } else {
+        sp += k;
+        sn -= static_cast<size_t>(k);
+      }
+    }
+    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t k = ::recv(rfd, rp, rn, MSG_DONTWAIT);
+      if (k == 0) return -1;  // peer closed
+      if (k < 0) {
+        if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+          return -1;
+      } else {
+        rp += k;
+        rn -= static_cast<size_t>(k);
+      }
+    }
   }
   return 0;
 }
@@ -143,11 +196,24 @@ struct Comm {
 // handshake tags
 constexpr uint32_t KHELLO = 0x68766431;  // "hvd1"
 
+// ring address book entry: where each rank's ring listener is reachable.
+// The coordinator fills `ip` from getpeername() on the rank's star socket —
+// the address the rank actually routes from — so multi-host rings dial the
+// right machine, not the coordinator host.
+struct RingAddr {
+  char ip[46];  // INET6_ADDRSTRLEN
+  int32_t port;
+};
+
 int comm_init(Comm* c, int rank, int world, const char* coord_host,
               int coord_port, int timeout_ms) {
   c->rank = rank;
   c->world = world;
-  c->star.assign(world < 1 ? 1 : world, -1);
+  if (world < 1) {
+    c->error = "bad world size";
+    return -1;
+  }
+  c->star.assign(world, -1);
   if (world == 1) return 0;
 
   // --- star setup + rendezvous of ring listen ports ---
@@ -166,10 +232,16 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
                  std::to_string(coord_port);
       return -1;
     }
-    std::vector<int> ring_ports(world, 0);
-    ring_ports[0] = ring_listen_port;
+    std::vector<RingAddr> ring_addrs(world);
+    std::memset(ring_addrs.data(), 0, sizeof(RingAddr) * world);
+    std::snprintf(ring_addrs[0].ip, sizeof(ring_addrs[0].ip), "%s",
+                  coord_host);
+    ring_addrs[0].port = ring_listen_port;
     for (int i = 1; i < world; ++i) {
-      int fd = ::accept(lfd, nullptr, nullptr);
+      sockaddr_in peer_addr{};
+      socklen_t peer_len = sizeof(peer_addr);
+      int fd = ::accept(lfd, reinterpret_cast<sockaddr*>(&peer_addr),
+                        &peer_len);
       if (fd < 0) {
         c->error = "accept failed";
         return -1;
@@ -187,22 +259,23 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
         return -1;
       }
       c->star[peer_rank] = fd;
-      ring_ports[peer_rank] = peer_ring_port;
+      ::inet_ntop(AF_INET, &peer_addr.sin_addr, ring_addrs[peer_rank].ip,
+                  sizeof(ring_addrs[peer_rank].ip));
+      ring_addrs[peer_rank].port = peer_ring_port;
     }
     ::close(lfd);
     // broadcast the ring address book
     for (int r = 1; r < world; ++r) {
-      if (send_all(c->star[r], ring_ports.data(),
-                   sizeof(int) * world) != 0) {
+      if (send_all(c->star[r], ring_addrs.data(),
+                   sizeof(RingAddr) * world) != 0) {
         c->error = "address book send failed";
         return -1;
       }
     }
-    // ring connects: rank r dials (r+1)%world; everyone accepts from
-    // predecessor. All ring traffic is on localhost for multi-process
-    // single-host; multi-host uses the coordinator host for all ranks.
-    c->ring_next = tcp_connect_retry(coord_host, ring_ports[1 % world],
-                                     timeout_ms);
+    // ring connects: rank r dials (r+1)%world at that rank's own address;
+    // everyone accepts from its predecessor.
+    c->ring_next = tcp_connect_retry(ring_addrs[1 % world].ip,
+                                     ring_addrs[1 % world].port, timeout_ms);
     c->ring_prev = ::accept(ring_listen_fd, nullptr, nullptr);
   } else {
     int fd = tcp_connect_retry(coord_host, coord_port, timeout_ms);
@@ -219,14 +292,13 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
       c->error = "hello send failed";
       return -1;
     }
-    std::vector<int> ring_ports(world, 0);
-    if (recv_all(fd, ring_ports.data(), sizeof(int) * world) != 0) {
+    std::vector<RingAddr> ring_addrs(world);
+    if (recv_all(fd, ring_addrs.data(), sizeof(RingAddr) * world) != 0) {
       c->error = "address book recv failed";
       return -1;
     }
-    c->ring_next = tcp_connect_retry(coord_host,
-                                     ring_ports[(rank + 1) % world],
-                                     timeout_ms);
+    const RingAddr& next = ring_addrs[(rank + 1) % world];
+    c->ring_next = tcp_connect_retry(next.ip, next.port, timeout_ms);
     c->ring_prev = ::accept(ring_listen_fd, nullptr, nullptr);
   }
   ::close(ring_listen_fd);
@@ -342,15 +414,16 @@ int ring_allreduce_t(Comm* c, T* data, uint64_t count) {
   std::vector<T> recv_buf(max_chunk);
 
   // reduce-scatter: after w-1 steps, rank r owns the full sum of chunk
-  // (r+1) % w
+  // (r+1) % w. Send+recv run full-duplex so chunks larger than the kernel
+  // socket buffers can't deadlock the ring.
   for (int step = 0; step < w - 1; ++step) {
     int send_chunk = (c->rank - step + w) % w;
     int recv_chunk = (c->rank - step - 1 + w) % w;
     uint64_t send_n = begin[send_chunk + 1] - begin[send_chunk];
     uint64_t recv_n = begin[recv_chunk + 1] - begin[recv_chunk];
-    if (send_all(c->ring_next, data + begin[send_chunk], send_n * sizeof(T)) != 0)
-      return -1;
-    if (recv_all(c->ring_prev, recv_buf.data(), recv_n * sizeof(T)) != 0)
+    if (duplex_exchange(c->ring_next, data + begin[send_chunk],
+                        send_n * sizeof(T), c->ring_prev, recv_buf.data(),
+                        recv_n * sizeof(T)) != 0)
       return -1;
     T* dst = data + begin[recv_chunk];
     for (uint64_t i = 0; i < recv_n; ++i) dst[i] += recv_buf[i];
@@ -361,11 +434,10 @@ int ring_allreduce_t(Comm* c, T* data, uint64_t count) {
     int recv_chunk = (c->rank - step + w) % w;
     uint64_t send_n = begin[send_chunk + 1] - begin[send_chunk];
     uint64_t recv_n = begin[recv_chunk + 1] - begin[recv_chunk];
-    if (send_all(c->ring_next, data + begin[send_chunk], send_n * sizeof(T)) != 0)
+    if (duplex_exchange(c->ring_next, data + begin[send_chunk],
+                        send_n * sizeof(T), c->ring_prev,
+                        data + begin[recv_chunk], recv_n * sizeof(T)) != 0)
       return -1;
-    if (recv_all(c->ring_prev, data + begin[recv_chunk], recv_n * sizeof(T)) != 0)
-      return -1;
-    (void)recv_n;
   }
   return 0;
 }

@@ -48,6 +48,28 @@ class Executor:
         self.net = net
         self._programs: Dict[tuple, Any] = {}
         self._lock = threading.Lock()
+        # Multi-process with a global mesh (jax.distributed): the hot op
+        # (allreduce) must ride XLA collectives over ICI/DCN, not the host
+        # TCP ring — the ring stays as control plane + fallback. Requires
+        # homogeneous device ownership (the reference likewise gates
+        # hierarchical paths on homogeneity, mpi_controller.cc:25-81).
+        self._spmd_world = jax.process_count() > 1
+        self._proc_mesh = None
+        if self._spmd_world:
+            # One-device-per-process sub-mesh for the fused allreduce: each
+            # process transfers its fusion buffer to device exactly once (no
+            # k-fold duplication across its local devices) and the reduction
+            # is exact for ints (one row per process, no dup correction).
+            by_proc: Dict[int, list] = {}
+            for d in mesh.devices.flatten():
+                by_proc.setdefault(d.process_index, []).append(d)
+            firsts = [min(ds, key=lambda d: d.id)
+                      for _, ds in sorted(by_proc.items())]
+            if len(firsts) == jax.process_count():
+                import numpy as _np
+                from jax.sharding import Mesh
+
+                self._proc_mesh = Mesh(_np.array(firsts), ("proc",))
 
     def _replicated(self):
         return NamedSharding(self.mesh, P())
@@ -104,7 +126,10 @@ class Executor:
                 return
 
             if response.response_type == types.ALLREDUCE:
-                if self.net is not None:
+                if (self.net is not None and self._spmd_world
+                        and self._proc_mesh is not None):
+                    self._execute_allreduce_spmd(entries, timeline)
+                elif self.net is not None:
                     self._execute_allreduce_host(entries, timeline)
                 else:
                     self._execute_allreduce(response, entries, timeline)
@@ -180,6 +205,66 @@ class Executor:
             out = buf[off:off + n].reshape(orig.shape).astype(orig.dtype)
             e.output = out
             off += n
+
+    def _fused_spmd_allreduce_program(self, n: int, dtype, average: bool):
+        """One compiled XLA program per (flat size, dtype, op): the global
+        stacked fusion buffer (P, n) — one row per process, sharded over the
+        per-process sub-mesh — is mean/sum-reduced over the process axis,
+        output replicated. Integer sums are exact (no duplication)."""
+        key = ("spmd_allreduce", n, str(dtype), average)
+        with self._lock:
+            fn = self._programs.get(key)
+            if fn is not None:
+                return fn
+
+        replicated = NamedSharding(self._proc_mesh, P())
+
+        def f(buf):
+            return jnp.mean(buf, axis=0) if average else jnp.sum(buf, axis=0)
+
+        fn = jax.jit(f, out_shardings=replicated)
+        with self._lock:
+            self._programs[key] = fn
+        return fn
+
+    def _execute_allreduce_spmd(self, entries, timeline=None) -> None:
+        """Fused allreduce over a one-device-per-process sub-mesh in
+        multi-process mode: pack entries into one flat host buffer, place it
+        on this process's row of a (P, n) global array (single host→device
+        transfer), reduce with a compiled XLA collective (rides ICI/DCN),
+        unpack the replicated result. The analogue of NCCLAllreduce on the
+        reference's GPU path (nccl_operations.cc:55-105) with XLA in place
+        of NCCL."""
+        import numpy as np
+
+        arrays = [np.asarray(e.tensor) for e in entries]
+        if timeline is not None:
+            timeline.activity_start(entries[0].name,
+                                    timeline_mod.MEMCPY_IN_FUSION_BUFFER)
+        flat = np.concatenate([a.ravel() for a in arrays])
+        mesh = self._proc_mesh
+        n_proc = mesh.devices.size
+        row_sharding = NamedSharding(mesh, P("proc"))
+        local_dev = [d for d in mesh.devices.flatten()
+                     if d.process_index == jax.process_index()][0]
+        local_row = jax.device_put(flat[None], local_dev)
+        global_stack = jax.make_array_from_single_device_arrays(
+            (n_proc,) + flat.shape, row_sharding, [local_row])
+        if timeline is not None:
+            timeline.activity_end(entries[0].name)
+            timeline.activity_start(entries[0].name,
+                                    timeline_mod.XLA_COLLECTIVE)
+        avg = entries[0].average
+        fn = self._fused_spmd_allreduce_program(
+            int(flat.size), flat.dtype, avg)
+        out = np.asarray(fn(global_stack))
+        if timeline is not None:
+            timeline.activity_end(entries[0].name)
+        off = 0
+        for e, a in zip(entries, arrays):
+            e.output = out[off:off + a.size].reshape(a.shape).astype(
+                a.dtype, copy=False)
+            off += a.size
 
     def _execute_allgather_host(self, response, entries) -> None:
         import numpy as np

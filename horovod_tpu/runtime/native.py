@@ -38,9 +38,25 @@ def load_library(build_if_missing: bool = True):
         if _lib is not None:
             return _lib
         if not os.path.exists(_LIB_PATH) and build_if_missing:
+            # Simultaneously-launched workers all race to build here; an
+            # fcntl lock serializes them (and the Makefile writes the .so
+            # atomically via tmp+rename) so nobody dlopens a half-written
+            # library.
             try:
-                subprocess.run(["make", "-C", _CPP_DIR], check=True,
-                               capture_output=True, timeout=120)
+                import fcntl
+
+                lock_path = os.path.join(_CPP_DIR, ".build_lock")
+                with open(lock_path, "w") as lock_file:
+                    fcntl.flock(lock_file, fcntl.LOCK_EX)
+                    try:
+                        if not os.path.exists(_LIB_PATH):
+                            subprocess.run(["make", "-C", _CPP_DIR],
+                                           check=True, capture_output=True,
+                                           timeout=120)
+                    finally:
+                        fcntl.flock(lock_file, fcntl.LOCK_UN)
+            except NativeUnavailableError:
+                raise
             except Exception as exc:
                 raise NativeUnavailableError(
                     f"could not build native transport: {exc}") from exc

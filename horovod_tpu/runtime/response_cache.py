@@ -59,13 +59,21 @@ class ResponseCache:
 
     def cached(self, request: msg.Request) -> CacheState:
         """reference: response_cache.cc:50-76 — a name hit with changed
-        shape/dtype/params is INVALID, not HIT."""
+        shape/dtype/params is INVALID, not HIT.
+
+        Deliberately does NOT touch LRU order: announcement timing differs
+        across workers, so a touch here would diverge the eviction order and
+        eventually remap the same cache bit to different tensors on
+        different workers. Order mutations happen only on the synchronized
+        paths — ``get_by_bit`` with agreed common bits, ``put`` /
+        ``invalidate`` with agreed responses — which every worker executes
+        in the identical sequence (the invariant the reference maintains as
+        well: response_cache.cc cached() is const)."""
         bit = self._name_to_bit.get(request.tensor_name)
         if bit is None or bit not in self._entries:
             return CacheState.MISS
         _, key = self._entries[bit]
         if key == self._params_key(request):
-            self._entries.move_to_end(bit)  # a hit refreshes LRU order
             return CacheState.HIT
         return CacheState.INVALID
 

@@ -88,6 +88,46 @@ def main():
                 np.asarray(hvd.synchronize(h2)),
                 2 * np.mean(np.arange(world, dtype=np.float32)))
 
+    elif scenario == "large_allreduce":
+        # chunks far larger than kernel socket buffers: the ring must run
+        # full-duplex or it deadlocks (every rank blocked in send)
+        n = 8 * 1024 * 1024  # 32 MB fp32
+        h = hvd.allreduce_async(
+            np.full((n,), float(rank), np.float32), name="big/x")
+        out = np.asarray(hvd.synchronize(h))
+        np.testing.assert_allclose(
+            out[::65537], np.mean(np.arange(world, dtype=np.float32)))
+
+    elif scenario == "spmd_allreduce":
+        # launcher default mode: jax.distributed forms a global mesh and the
+        # hot op rides XLA collectives, not the host ring (net is control
+        # plane only). Verifies routing + numerics.
+        import jax as _jax
+
+        assert _jax.process_count() == world, (
+            _jax.process_count(), world)
+        from horovod_tpu.runtime.runtime import get_runtime
+        rt = get_runtime()
+        assert rt.executor._spmd_world
+        assert rt.executor._proc_mesh is not None
+        for step in range(3):
+            h = hvd.allreduce_async(
+                np.full((6,), float(hvd.rank()), np.float32), name="spmd/g")
+            out = np.asarray(hvd.synchronize(h))
+            np.testing.assert_allclose(
+                out, np.mean(np.arange(world, dtype=np.float32)))
+        h = hvd.allreduce_async(np.full((3,), 2.0, np.float32),
+                                name="spmd/sum", average=False)
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   2.0 * world)
+        # integer sum must be exact through the SPMD path
+        h = hvd.allreduce_async(
+            np.full((2,), 1 << 24, np.int32), name="spmd/int",
+            average=False)
+        out = np.asarray(hvd.synchronize(h))
+        assert out.dtype == np.int32
+        np.testing.assert_array_equal(out, (1 << 24) * world)
+
     elif scenario == "shape_mismatch":
         # reference: error paths (test_tensorflow.py:314-384) — mismatched
         # shapes across ranks must error on every rank

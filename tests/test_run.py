@@ -333,26 +333,41 @@ def test_tpurun_no_command_errors():
     assert run_commandline(["-np", "2"]) == 2
 
 
-def test_tpurun_end_to_end_collective():
-    """tpurun-launched workers form a world and allreduce through the
-    socket controller — the full launcher→init→collective path the
-    reference exercises via `horovodrun -np 2 pytest ...`."""
+def _run_mp_worker(monkeypatch, scenario, extra_flags=()):
+    """tpurun-launch mp_worker.py ranks (workers don't want the parent's
+    8-fake-device XLA_FLAGS)."""
     from horovod_tpu.runtime.native import native_built
 
     if not native_built():
         pytest.skip("native transport not built")
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "mp_worker.py")
-    env_backup = os.environ.get("XLA_FLAGS")
-    os.environ.pop("XLA_FLAGS", None)
-    try:
-        code = run_commandline(
-            ["-np", "2", "--no-jax-distributed",
-             sys.executable, worker, "collectives"])
-    finally:
-        if env_backup is not None:
-            os.environ["XLA_FLAGS"] = env_backup
-    assert code == 0
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    return run_commandline(
+        ["-np", "2", *extra_flags, sys.executable, worker, scenario])
+
+
+def test_tpurun_end_to_end_collective(monkeypatch):
+    """tpurun-launched workers form a world and allreduce through the
+    socket controller — the full launcher→init→collective path the
+    reference exercises via `horovodrun -np 2 pytest ...`."""
+    assert _run_mp_worker(
+        monkeypatch, "collectives", ["--no-jax-distributed"]) == 0
+
+
+def test_tpurun_large_tensor_ring(monkeypatch):
+    """32 MB fused buffer through the host ring — regression test for the
+    full-duplex exchange (a blocking ring deadlocks once chunks exceed
+    kernel socket buffering)."""
+    assert _run_mp_worker(
+        monkeypatch, "large_allreduce", ["--no-jax-distributed"]) == 0
+
+
+def test_tpurun_spmd_global_mesh(monkeypatch):
+    """Default tpurun mode: jax.distributed global mesh; the enqueue
+    runtime's allreduce rides XLA collectives over the mesh (ICI analogue),
+    with the socket net as control plane only."""
+    assert _run_mp_worker(monkeypatch, "spmd_allreduce") == 0
 
 
 def test_safe_exec_kills_process_tree():

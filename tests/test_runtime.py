@@ -132,10 +132,29 @@ class TestResponseCache:
         c = ResponseCache(capacity=2)
         c.put(msg.Response(types.ALLREDUCE, ["a"]), _req("a"))
         c.put(msg.Response(types.ALLREDUCE, ["b"]), _req("b"))
-        assert c.cached(_req("a")) == CacheState.HIT  # touch a
+        # synchronized touch (fast-path serve) refreshes LRU order
+        c.get_by_bit(c.bit_for_name("a"))
         c.put(msg.Response(types.ALLREDUCE, ["c"]), _req("c"))  # evicts b
         assert c.cached(_req("b")) == CacheState.MISS
         assert c.cached(_req("a")) == CacheState.HIT
+
+    def test_local_lookup_does_not_diverge_eviction(self):
+        """Workers announce in different orders; cached() must not reorder
+        LRU or capacity eviction would pick different victims per worker and
+        remap the same cache bit to different tensors (cross-worker
+        corruption). Only synchronized paths may touch order."""
+        def run(lookup_order):
+            c = ResponseCache(capacity=2)
+            c.put(msg.Response(types.ALLREDUCE, ["a"]), _req("a"))
+            c.put(msg.Response(types.ALLREDUCE, ["b"]), _req("b"))
+            for name in lookup_order:  # local announcements, any order
+                c.cached(_req(name))
+            c.put(msg.Response(types.ALLREDUCE, ["c"]), _req("c"))
+            return {n: c.bit_for_name(n)
+                    for n in "abc"
+                    if c.cached(_req(n)) == CacheState.HIT}
+
+        assert run(["a", "b", "a"]) == run(["b", "a", "b"])
 
     def test_bits_recycled_after_invalidation(self):
         # a shape-varying tensor renegotiated every step must not grow the
