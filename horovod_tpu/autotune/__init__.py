@@ -1,0 +1,9 @@
+"""Autotuner package — reference: horovod/common/parameter_manager.cc and
+optim/{bayesian_optimization,gaussian_process}.cc (SURVEY.md §2.1)."""
+
+from horovod_tpu.autotune.bayesian_optimization import BayesianOptimization
+from horovod_tpu.autotune.gaussian_process import GaussianProcessRegressor
+from horovod_tpu.autotune.parameter_manager import ParameterManager, Params
+
+__all__ = ["BayesianOptimization", "GaussianProcessRegressor",
+           "ParameterManager", "Params"]

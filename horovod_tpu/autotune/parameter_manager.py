@@ -1,0 +1,233 @@
+"""Autotuning parameter manager.
+
+TPU-native analogue of the reference's ``ParameterManager`` (reference:
+horovod/common/parameter_manager.cc/.h:225-251): while training runs, try
+different runtime knob settings, score each by negotiation+collective
+throughput (bytes/µs — reference: parameter_manager.cc:142-176), and
+converge on the best.
+
+Tuned knobs (reference: parameter_manager.h:225-228):
+* categorical — ``cache_enabled``, ``hierarchical_allreduce``,
+  ``hierarchical_allgather``;
+* continuous, jointly via Bayesian optimization —
+  ``fusion_threshold_mb`` and ``cycle_time_ms``.
+
+Tuning schedule (a simplification of the reference's nested tunable-param
+chain, same spirit): warmup discard → one-at-a-time sweep of each
+categorical value → Bayesian optimization over the continuous box →
+freeze at the best configuration seen. Scores are medians over
+``SAMPLES_PER_POINT`` samples of ``steps_per_sample`` update calls each
+(reference: 5-sample medians, 10 steps per sample).
+
+Only the coordinator tunes; every cycle it broadcasts the current
+parameter blob and all workers apply it (reference: SynchronizeParameters,
+controller.cc:32-46).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from horovod_tpu.autotune.bayesian_optimization import BayesianOptimization
+
+SAMPLES_PER_POINT = 5  # reference: parameter_manager.cc five-sample medians
+
+# continuous search box: fusion threshold (MB), cycle time (ms)
+FUSION_MB_BOUNDS = (0.0, 64.0)
+CYCLE_MS_BOUNDS = (1.0, 25.0)
+
+
+@dataclasses.dataclass
+class Params:
+    """The synchronized knob set (reference: the POD Params struct bcast by
+    SynchronizeParameters)."""
+
+    fusion_threshold_bytes: int
+    cycle_time_ms: float
+    cache_enabled: bool
+    hierarchical_allreduce: bool
+    hierarchical_allgather: bool
+    active: bool = True  # still tuning?
+
+    _FMT = "<qdBBBB"
+
+    def pack(self) -> bytes:
+        return struct.pack(
+            self._FMT, self.fusion_threshold_bytes, self.cycle_time_ms,
+            int(self.cache_enabled), int(self.hierarchical_allreduce),
+            int(self.hierarchical_allgather), int(self.active))
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "Params":
+        f, c, ce, ha, hg, act = struct.unpack(cls._FMT, blob)
+        return cls(f, c, bool(ce), bool(ha), bool(hg), bool(act))
+
+
+# Swept categorical knobs. The hierarchical flags stay in the Params blob
+# (synchronized + frozen like the rest) but are excluded from the sweep
+# until the executor consults them — sweeping a no-op knob would just burn
+# sample windows on noise.
+_CATEGORICAL = ("cache_enabled",)
+
+
+class ParameterManager:
+    """Coordinator-side tuner; workers just apply broadcast params."""
+
+    def __init__(self, initial: Params, warmup_samples: int = 3,
+                 steps_per_sample: int = 10, bayes_opt_max_samples: int = 20,
+                 gp_noise: float = 0.8, log_path: str = "",
+                 rank: int = 0):
+        self.current = dataclasses.replace(initial)
+        self.best = dataclasses.replace(initial)
+        self.best_score = -np.inf
+        self.active = True
+        self._warmup_remaining = warmup_samples
+        self._steps_per_sample = max(steps_per_sample, 1)
+        self._log_path = log_path
+        self._rank = rank
+
+        # accumulation state
+        self._step_count = 0
+        self._bytes = 0
+        self._seconds = 0.0
+        self._scores: List[float] = []
+
+        # tuning schedule state
+        self._phase = "categorical"
+        self._cat_index = 0       # which categorical knob
+        self._cat_value = False   # which value is being scored
+        self._cat_scores: dict = {}
+        # the first scored point must actually RUN the value it is labeled
+        # with — apply it now rather than scoring the default under a
+        # mismatched label
+        setattr(self.current, _CATEGORICAL[0], False)
+        self._bo = BayesianOptimization(
+            bounds=[FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS],
+            alpha=max(gp_noise, 1e-6) * 1e-2)
+        self._bo_remaining = bayes_opt_max_samples
+
+        if self._log_path and self._rank == 0:
+            with open(self._log_path, "w") as f:
+                f.write("timestamp,fusion_threshold_mb,cycle_time_ms,"
+                        "cache_enabled,hierarchical_allreduce,"
+                        "hierarchical_allgather,score_bytes_per_us\n")
+
+    # ------------------------------------------------------------------
+    def update(self, nbytes: int, seconds: float) -> bool:
+        """Record one cycle's traffic; returns True when params changed
+        (reference: ParameterManager::Update, parameter_manager.cc:142-176).
+        """
+        if not self.active:
+            return False
+        if nbytes <= 0:
+            # idle cycle — the socket controllers sync every cycle even
+            # with nothing enqueued; scoring those would measure the cycle
+            # cadence, not the knobs (reference advances only on tensor
+            # traffic, parameter_manager.cc:142-160)
+            return False
+        self._bytes += int(nbytes)
+        self._seconds += float(seconds)
+        self._step_count += 1
+        if self._step_count < self._steps_per_sample:
+            return False
+        # one sample
+        score = (self._bytes / (self._seconds * 1e6)
+                 if self._seconds > 0 else 0.0)
+        self._step_count = 0
+        self._bytes = 0
+        self._seconds = 0.0
+
+        if self._warmup_remaining > 0:
+            self._warmup_remaining -= 1
+            return False
+        self._scores.append(score)
+        if len(self._scores) < SAMPLES_PER_POINT:
+            return False
+        point_score = float(np.median(self._scores))
+        self._scores.clear()
+        return self._tune(point_score)
+
+    # ------------------------------------------------------------------
+    def _log(self, score: float) -> None:
+        if not self._log_path or self._rank != 0:
+            return
+        with open(self._log_path, "a") as f:
+            c = self.current
+            f.write(f"{time.time():.3f},"
+                    f"{c.fusion_threshold_bytes / (1024 * 1024):.3f},"
+                    f"{c.cycle_time_ms:.3f},{int(c.cache_enabled)},"
+                    f"{int(c.hierarchical_allreduce)},"
+                    f"{int(c.hierarchical_allgather)},{score:.3f}\n")
+
+    def _record(self, score: float) -> None:
+        self._log(score)
+        if score > self.best_score:
+            self.best_score = score
+            self.best = dataclasses.replace(self.current)
+
+    def _tune(self, score: float) -> bool:
+        """Advance the schedule; returns True when current params changed
+        (reference: ParameterManager::Tune)."""
+        self._record(score)
+
+        if self._phase == "categorical":
+            knob = _CATEGORICAL[self._cat_index]
+            self._cat_scores[(knob, self._cat_value)] = score
+            if not self._cat_value:
+                # score the other value next
+                self._cat_value = True
+                setattr(self.current, knob, True)
+                return True
+            # both values scored — keep the better, move to next knob
+            better = (self._cat_scores[(knob, True)]
+                      >= self._cat_scores[(knob, False)])
+            setattr(self.current, knob, better)
+            self._cat_index += 1
+            self._cat_value = False
+            if self._cat_index >= len(_CATEGORICAL):
+                self._phase = "bayesian"
+                nxt = self._bo.next_sample()
+                self._apply_continuous(nxt)
+            else:
+                setattr(self.current, _CATEGORICAL[self._cat_index], False)
+            return True
+
+        if self._phase == "bayesian":
+            x = np.array([
+                self.current.fusion_threshold_bytes / (1024.0 * 1024.0),
+                self.current.cycle_time_ms])
+            self._bo.add_sample(x, score)
+            self._bo_remaining -= 1
+            if self._bo_remaining <= 0:
+                self._finish()
+                return True
+            self._apply_continuous(self._bo.next_sample())
+            return True
+
+        return False
+
+    def _apply_continuous(self, x) -> None:
+        self.current.fusion_threshold_bytes = int(
+            max(0.0, float(x[0])) * 1024 * 1024)
+        self.current.cycle_time_ms = float(np.clip(
+            x[1], CYCLE_MS_BOUNDS[0], CYCLE_MS_BOUNDS[1]))
+
+    def _finish(self) -> None:
+        """Freeze at the best configuration seen (reference: tuning ends and
+        best params stick; logged for resume-with-tuned-flags,
+        docs/autotune.rst:30-37)."""
+        self.current = dataclasses.replace(self.best)
+        self.current.active = False
+        self.active = False
+        self._log(self.best_score)
+
+    # ------------------------------------------------------------------
+    def params(self) -> Params:
+        p = dataclasses.replace(self.current)
+        p.active = self.active
+        return p

@@ -134,6 +134,10 @@ class Controller:
         self.rank = rank
         self.world = world
         self.cache = ResponseCache(cache_capacity)
+        # Autotunable (reference: parameter_manager.h:225-228 tunes
+        # cache_enabled). Toggled only via the synchronized parameter
+        # broadcast so every worker flips at the same cycle boundary.
+        self.cache_enabled = cache_capacity > 0
         self.message_table = MessageTable()  # coordinator only
         self._should_shut_down = False
         # name -> Request for every announcement not yet resolved on this
@@ -170,6 +174,12 @@ class Controller:
     def barrier(self) -> None:
         raise NotImplementedError
 
+    def bcast_blob(self, blob: Optional[bytes]) -> bytes:
+        """Coordinator broadcasts an opaque blob; workers receive it. Used
+        for the per-cycle autotune parameter sync (reference:
+        SynchronizeParameters, controller.cc:32-46)."""
+        raise NotImplementedError
+
     @property
     def is_coordinator(self) -> bool:
         return self.rank == 0
@@ -200,7 +210,8 @@ class Controller:
             self._pending[name] = r
             if name in self._awaiting:
                 continue  # already at the coordinator; do not re-send
-            state = self.cache.cached(r)
+            state = (self.cache.cached(r) if self.cache_enabled
+                     else CacheState.MISS)
             stale = (state == CacheState.HIT and
                      now - self._deferred_first_seen.get(name, now)
                      > self.STALE_HIT_SECONDS)
@@ -297,7 +308,7 @@ class Controller:
                 for name in resp.tensor_names:
                     self.cache.invalidate(name)
                 continue
-            if resp.response_type != types.ERROR:
+            if resp.response_type != types.ERROR and self.cache_enabled:
                 for name in resp.tensor_names:
                     req = self._pending.get(name)
                     if req is not None \
@@ -346,6 +357,10 @@ class LocalController(Controller):
     def bcast_responses(self, responses):
         assert responses is not None
         return responses
+
+    def bcast_blob(self, blob):
+        assert blob is not None
+        return blob
 
     def barrier(self) -> None:
         pass

@@ -84,6 +84,29 @@ class Runtime:
         # stale deferred hits renegotiate on the same clock as stall warnings
         self.controller.STALE_HIT_SECONDS = st.config.stall_check_time_seconds
         self._cycle_time_s = st.config.cycle_time_ms / 1000.0
+
+        # Autotuning (reference: parameter_manager wired into RunLoopOnce +
+        # SynchronizeParameters each cycle, operations.cc:500-550 /
+        # controller.cc:32-46). Coordinator tunes; everyone applies.
+        self.param_manager = None
+        self._autotune_active = bool(st.config.autotune)
+        if self._autotune_active and self.controller.is_coordinator:
+            from horovod_tpu.autotune.parameter_manager import (
+                ParameterManager, Params)
+
+            initial = Params(
+                fusion_threshold_bytes=st.config.fusion_threshold_bytes,
+                cycle_time_ms=st.config.cycle_time_ms,
+                cache_enabled=self.controller.cache_enabled,
+                hierarchical_allreduce=st.config.hierarchical_allreduce,
+                hierarchical_allgather=st.config.hierarchical_allgather)
+            self.param_manager = ParameterManager(
+                initial,
+                warmup_samples=st.config.autotune_warmup_samples,
+                steps_per_sample=st.config.autotune_steps_per_sample,
+                bayes_opt_max_samples=st.config.autotune_bayes_opt_max_samples,
+                gp_noise=st.config.autotune_gaussian_process_noise,
+                log_path=st.config.autotune_log, rank=st.rank)
         self._stop = threading.Event()
         self._woken = threading.Event()
         self._thread = threading.Thread(
@@ -184,15 +207,45 @@ class Runtime:
         if not requests and getattr(self.controller, "net", None) is None \
                 and not self.controller._should_shut_down:
             return True
+        cycle_t0 = time.monotonic()
         responses, shut_down = self.controller.compute_response_list(
             requests, self._st.config.fusion_threshold_bytes,
             timeline=self.timeline, stall_inspector=self.stall_inspector)
+        cycle_bytes = 0
         for response in responses:
             entries = self.queue.get_entries(response.tensor_names)
             if entries:
                 self.executor.execute(response, entries,
                                       timeline=self.timeline)
+                if self._autotune_active:
+                    for e in entries:
+                        cycle_bytes += types.entry_nbytes(e)
+        if self._autotune_active:
+            self._autotune_sync(cycle_bytes, time.monotonic() - cycle_t0)
         return not shut_down
+
+    def _autotune_sync(self, nbytes: int, seconds: float) -> None:
+        """Coordinator scores the cycle and broadcasts current params;
+        every worker applies them at the same cycle boundary (reference:
+        SynchronizeParameters, controller.cc:32-46)."""
+        from horovod_tpu.autotune.parameter_manager import Params
+
+        if self.param_manager is not None:
+            self.param_manager.update(nbytes, seconds)
+            blob = self.param_manager.params().pack()
+            blob = self.controller.bcast_blob(blob)
+        else:
+            blob = self.controller.bcast_blob(None)
+        params = Params.unpack(bytes(blob))
+        cfg = self._st.config
+        cfg.fusion_threshold_bytes = params.fusion_threshold_bytes
+        cfg.cycle_time_ms = params.cycle_time_ms
+        cfg.hierarchical_allreduce = params.hierarchical_allreduce
+        cfg.hierarchical_allgather = params.hierarchical_allgather
+        self._cycle_time_s = params.cycle_time_ms / 1000.0
+        self.controller.cache_enabled = params.cache_enabled
+        if not params.active:
+            self._autotune_active = False
 
     def _finalize(self) -> None:
         self.queue.finalize(types.Status.Aborted(types.SHUT_DOWN_ERROR))

@@ -62,6 +62,12 @@ class SocketController(Controller):
             blob = self.net.bcast(None)
         return msg.unpack_response_list(blob)
 
+    def bcast_blob(self, blob: Optional[bytes]) -> bytes:
+        if self.rank == 0:
+            assert blob is not None
+            return self.net.bcast(blob)
+        return self.net.bcast(None)
+
     def barrier(self) -> None:
         self.net.barrier()
 

@@ -101,3 +101,24 @@ class TensorTableEntry:
     dtype: Any = None
     shape: tuple = ()
     enqueue_time: float = 0.0
+
+
+def entry_nbytes(entry: "TensorTableEntry") -> int:
+    """Per-worker payload bytes of one enqueued tensor (autotune throughput
+    scoring; reference: parameter_manager scores bytes/us of processed
+    tensors). Uses the same wire-shape convention as the announcement path
+    (runtime._enqueue): a worker-stacked array counts shape[1:], so scores
+    are comparable across single- and multi-process modes."""
+    from horovod_tpu.ops import collectives
+    from horovod_tpu.runtime import fusion
+
+    shape = (entry.shape[1:] if collectives._is_worker_stacked(entry.tensor)
+             else entry.shape)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    try:
+        item = fusion._dtype_size(str(entry.dtype))
+    except TypeError:
+        item = 4
+    return n * item

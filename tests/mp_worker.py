@@ -88,6 +88,28 @@ def main():
                 np.asarray(hvd.synchronize(h2)),
                 2 * np.mean(np.arange(world, dtype=np.float32)))
 
+    elif scenario == "autotune":
+        # coordinator tunes, workers apply via the per-cycle param
+        # broadcast; collectives stay correct while knobs change
+        from horovod_tpu.runtime.runtime import get_runtime
+        rt = get_runtime()
+        if rank == 0:
+            assert rt.param_manager is not None
+        else:
+            assert rt.param_manager is None
+        # fixed iteration count on every rank — breaking early when this
+        # rank observes convergence would shut down while peers still have
+        # collectives in flight
+        for i in range(250):
+            h = hvd.allreduce_async(
+                np.full((8,), float(rank), np.float32), name=f"at/{i % 3}")
+            out = np.asarray(hvd.synchronize(h))
+            np.testing.assert_allclose(
+                out, np.mean(np.arange(world, dtype=np.float32)))
+        assert not rt._autotune_active, "autotune did not converge"
+        # every worker holds the frozen tuned config
+        assert rt._st.config.cycle_time_ms > 0
+
     elif scenario == "large_allreduce":
         # chunks far larger than kernel socket buffers: the ring must run
         # full-duplex or it deadlocks (every rank blocked in send)

@@ -1,0 +1,176 @@
+"""Autotuner tests: GP regression, Bayesian optimization, parameter
+manager schedule, and end-to-end runtime integration.
+
+The reference has no standalone autotuner tests (its C++ is tested through
+the bindings); here the tuner is exercised directly plus through the
+runtime the way HOROVOD_AUTOTUNE=1 would engage it.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.autotune.bayesian_optimization import BayesianOptimization
+from horovod_tpu.autotune.gaussian_process import GaussianProcessRegressor
+from horovod_tpu.autotune.parameter_manager import (
+    SAMPLES_PER_POINT, ParameterManager, Params)
+
+
+class TestGaussianProcess:
+    def test_interpolates_training_points(self):
+        gp = GaussianProcessRegressor(alpha=1e-8)
+        X = np.linspace(0, 1, 7)[:, None]
+        y = np.sin(3 * X[:, 0])
+        gp.fit(X, y)
+        mu, std = gp.predict(X)
+        np.testing.assert_allclose(mu, y, atol=1e-3)
+        assert np.all(std < 0.1)
+
+    def test_uncertainty_grows_off_data(self):
+        gp = GaussianProcessRegressor(alpha=1e-8, length_scale=0.1)
+        X = np.array([[0.0], [0.1]])
+        gp.fit(X, np.array([1.0, 2.0]))
+        _, std_near = gp.predict(np.array([[0.05]]))
+        _, std_far = gp.predict(np.array([[3.0]]))
+        assert std_far[0] > std_near[0]
+
+    def test_predict_before_fit(self):
+        gp = GaussianProcessRegressor()
+        mu, std = gp.predict(np.array([[0.5]]))
+        assert mu.shape == (1,) and std.shape == (1,)
+
+
+class TestBayesianOptimization:
+    def test_finds_maximum_of_concave_function(self):
+        # f(x, y) = -(x-3)^2 - (y-7)^2, max at (3, 7)
+        bo = BayesianOptimization(bounds=[(0, 10), (0, 10)], seed=1)
+        for _ in range(25):
+            x = bo.next_sample()
+            y = -(x[0] - 3.0) ** 2 - (x[1] - 7.0) ** 2
+            bo.add_sample(x, y)
+        best_x, best_y = bo.best()
+        assert best_y > -2.0, (best_x, best_y)  # within ~1.4 of optimum
+
+    def test_respects_bounds(self):
+        bo = BayesianOptimization(bounds=[(2, 4)], seed=0)
+        for _ in range(10):
+            x = bo.next_sample()
+            assert 2.0 <= x[0] <= 4.0
+            bo.add_sample(x, float(x[0]))
+
+
+def _mk_manager(**kw):
+    initial = Params(
+        fusion_threshold_bytes=64 * 1024 * 1024, cycle_time_ms=5.0,
+        cache_enabled=True, hierarchical_allreduce=False,
+        hierarchical_allgather=False)
+    kw.setdefault("warmup_samples", 1)
+    kw.setdefault("steps_per_sample", 2)
+    kw.setdefault("bayes_opt_max_samples", 6)
+    return ParameterManager(initial, **kw)
+
+
+def _feed_point(pm, score, steps_per_sample=2):
+    """Feed exactly one tuning point's worth of samples with a fixed
+    throughput (bytes/us = score)."""
+    changed = False
+    guard = 0
+    while True:
+        changed = pm.update(int(score * 1e6 * 0.01), 0.01)
+        guard += 1
+        if changed or not pm.active or guard > 200:
+            return changed
+
+
+class TestParameterManager:
+    def test_warmup_discarded_then_samples_collected(self):
+        pm = _mk_manager()
+        # warmup sample (steps_per_sample updates) produces no tuning
+        for _ in range(2):
+            assert not pm.update(1000, 0.001)
+        # now SAMPLES_PER_POINT samples must pass before the first tune
+        n_updates = 2 * SAMPLES_PER_POINT
+        changed = [pm.update(1000, 0.001) for _ in range(n_updates)]
+        assert changed[-1]  # first categorical flip happened
+        assert sum(changed) == 1
+
+    def test_categorical_sweep_keeps_better_value(self):
+        pm = _mk_manager()
+        # cache_enabled=True default scores high; False scores low
+        scores = {True: 100.0, False: 10.0}
+        for _ in range(40):
+            if not pm.active:
+                break
+            s = scores[pm.current.cache_enabled]
+            pm.update(int(s * 1e6 * 0.001), 0.001)
+            if pm._phase != "categorical" or pm._cat_index > 0:
+                break
+        assert pm.current.cache_enabled is True
+
+    def test_converges_and_freezes_at_best(self):
+        pm = _mk_manager(bayes_opt_max_samples=4)
+        # peak throughput at fusion_threshold ~ 32MB, cycle ~ 3ms
+        def score_of(p):
+            mb = p.fusion_threshold_bytes / (1024 * 1024)
+            return 100.0 - (mb - 32.0) ** 2 / 50 - (p.cycle_time_ms - 3) ** 2
+        guard = 0
+        while pm.active and guard < 2000:
+            s = max(score_of(pm.current), 1.0)
+            pm.update(int(s * 1e6 * 0.001), 0.001)
+            guard += 1
+        assert not pm.active
+        assert not pm.current.active
+        # frozen config equals the best recorded one
+        assert pm.current.fusion_threshold_bytes == pm.best.fusion_threshold_bytes
+        assert pm.best_score >= 1.0
+
+    def test_csv_log_written(self, tmp_path):
+        log = tmp_path / "autotune.csv"
+        pm = _mk_manager(log_path=str(log))
+        guard = 0
+        while pm.active and guard < 2000:
+            pm.update(50_000, 0.001)
+            guard += 1
+        text = log.read_text().strip().splitlines()
+        assert text[0].startswith("timestamp,fusion_threshold_mb")
+        assert len(text) > 3  # one line per scored point
+
+    def test_params_blob_roundtrip(self):
+        p = Params(12345678, 7.25, False, True, False, active=True)
+        assert Params.unpack(p.pack()) == p
+
+
+class TestRuntimeIntegration:
+    def test_autotune_engages_and_converges(self, hvd, monkeypatch):
+        """HOROVOD_AUTOTUNE=1: the runtime scores cycles, tunes, broadcasts
+        params, and keeps collectives correct while knobs change."""
+        monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "0")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "2")
+        hvd.shutdown()
+        hvd.init()
+        try:
+            from horovod_tpu.runtime.runtime import get_runtime
+
+            rt = get_runtime()
+            assert rt.param_manager is not None
+            seen_cycle_times = set()
+            for i in range(120):
+                h = hvd.allreduce_async(
+                    np.full((16,), 1.0, np.float32), name=f"at/{i % 4}")
+                out = np.asarray(hvd.synchronize(h))
+                np.testing.assert_allclose(out, 1.0)
+                seen_cycle_times.add(round(rt._cycle_time_s, 6))
+                if not rt._autotune_active:
+                    break
+            assert not rt._autotune_active, "autotune did not converge"
+            # params actually moved at least once during tuning
+            assert len(seen_cycle_times) > 1
+            # frozen config matches the manager's best
+            assert (rt._st.config.fusion_threshold_bytes
+                    == rt.param_manager.best.fusion_threshold_bytes)
+        finally:
+            hvd.shutdown()

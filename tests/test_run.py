@@ -363,6 +363,17 @@ def test_tpurun_large_tensor_ring(monkeypatch):
         monkeypatch, "large_allreduce", ["--no-jax-distributed"]) == 0
 
 
+def test_tpurun_autotune_sync(monkeypatch):
+    """--autotune: coordinator tunes, workers apply the per-cycle param
+    broadcast; the job converges and stays numerically correct."""
+    assert _run_mp_worker(
+        monkeypatch, "autotune",
+        ["--no-jax-distributed", "--autotune",
+         "--autotune-warmup-samples", "0",
+         "--autotune-steps-per-sample", "1",
+         "--autotune-bayes-opt-max-samples", "2"]) == 0
+
+
 def test_tpurun_spmd_global_mesh(monkeypatch):
     """Default tpurun mode: jax.distributed global mesh; the enqueue
     runtime's allreduce rides XLA collectives over the mesh (ICI analogue),
