@@ -68,10 +68,9 @@ class Params:
         return cls(f, c, bool(ce), bool(ha), bool(hg), bool(act))
 
 
-# Swept categorical knobs. The hierarchical flags stay in the Params blob
-# (synchronized + frozen like the rest) but are excluded from the sweep
-# until the executor consults them — sweeping a no-op knob would just burn
-# sample windows on noise.
+# Default swept categorical knobs. The hierarchical flags join the sweep
+# only when the runtime's data plane actually consults them (two-level
+# mesh) — sweeping a no-op knob would just burn sample windows on noise.
 _CATEGORICAL = ("cache_enabled",)
 
 
@@ -81,7 +80,10 @@ class ParameterManager:
     def __init__(self, initial: Params, warmup_samples: int = 3,
                  steps_per_sample: int = 10, bayes_opt_max_samples: int = 20,
                  gp_noise: float = 0.8, log_path: str = "",
-                 rank: int = 0):
+                 rank: int = 0, sweep: tuple = _CATEGORICAL):
+        # an empty sweep (e.g. cache disabled via capacity 0 and no
+        # two-level mesh) skips the categorical phase entirely
+        self._sweep = tuple(sweep)
         self.current = dataclasses.replace(initial)
         self.best = dataclasses.replace(initial)
         self.best_score = -np.inf
@@ -102,10 +104,13 @@ class ParameterManager:
         self._cat_index = 0       # which categorical knob
         self._cat_value = False   # which value is being scored
         self._cat_scores: dict = {}
-        # the first scored point must actually RUN the value it is labeled
-        # with — apply it now rather than scoring the default under a
-        # mismatched label
-        setattr(self.current, _CATEGORICAL[0], False)
+        if self._sweep:
+            # the first scored point must actually RUN the value it is
+            # labeled with — apply it now rather than scoring the default
+            # under a mismatched label
+            setattr(self.current, self._sweep[0], False)
+        else:
+            self._phase = "bayesian"
         self._bo = BayesianOptimization(
             bounds=[FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS],
             alpha=max(gp_noise, 1e-6) * 1e-2)
@@ -176,7 +181,7 @@ class ParameterManager:
         self._record(score)
 
         if self._phase == "categorical":
-            knob = _CATEGORICAL[self._cat_index]
+            knob = self._sweep[self._cat_index]
             self._cat_scores[(knob, self._cat_value)] = score
             if not self._cat_value:
                 # score the other value next
@@ -189,12 +194,12 @@ class ParameterManager:
             setattr(self.current, knob, better)
             self._cat_index += 1
             self._cat_value = False
-            if self._cat_index >= len(_CATEGORICAL):
+            if self._cat_index >= len(self._sweep):
                 self._phase = "bayesian"
                 nxt = self._bo.next_sample()
                 self._apply_continuous(nxt)
             else:
-                setattr(self.current, _CATEGORICAL[self._cat_index], False)
+                setattr(self.current, self._sweep[self._cat_index], False)
             return True
 
         if self._phase == "bayesian":

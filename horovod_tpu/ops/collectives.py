@@ -176,6 +176,86 @@ def _reduce_stacked_fn(mesh, op: int):
     return _cached(("reduce_stacked", mesh, op), build)
 
 
+def two_level_reduce_block(v, local: int, world: int, average: bool):
+    """Shared RS→AR→AG body for two-level allreduce, called inside a
+    shard_map block with a flat per-device vector ``v``: reduce-scatter
+    over ``local`` (ICI), allreduce over ``cross`` (DCN — 1/local of the
+    bytes), allgather over ``local`` (reference:
+    NCCLHierarchicalAllreduce, ops/nccl_operations.cc:150-346). Used by
+    both the eager stacked path and the executor's fused program."""
+    n = v.shape[0]
+    pad = (-n) % local
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+    s = lax.psum_scatter(v, mesh_mod.LOCAL_AXIS, scatter_dimension=0,
+                         tiled=True)           # ICI: (n/local,)
+    s = lax.psum(s, mesh_mod.CROSS_AXIS)       # DCN: 1/local bytes
+    g = lax.all_gather(s, mesh_mod.LOCAL_AXIS, axis=0,
+                       tiled=True)             # ICI: (n,)
+    if average:
+        g = g / world
+    return g[:n]
+
+
+def _hierarchical_reduce_stacked_fn(mesh, op: int):
+    """Two-level allreduce over a stacked (W, *S) array (knob common.h:75).
+    Only SUM/AVERAGE decompose this way (the reference's hierarchical path
+    is likewise sum-only); other ops use the flat program."""
+
+    def build():
+        cross, local = mesh.devices.shape
+        world = cross * local
+
+        def inner(x):
+            # per-device block (1, *S) of the stacked (W, *S) input
+            return two_level_reduce_block(
+                x[0].reshape(-1), local, world, average=(op == Average))
+
+        def f(x):
+            out = jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=P(mesh_mod.GLOBAL_AXES),
+                out_specs=P(), check_vma=False)(x)
+            return out.reshape(x.shape[1:])
+
+        return jax.jit(f, out_shardings=_replicated(mesh))
+
+    return _cached(("hier_reduce_stacked", mesh, op), build)
+
+
+def _hierarchical_gather_stacked_fn(mesh):
+    """Two-level allgather: gather over ``local`` then over ``cross``
+    (reference: MPIHierarchicalAllgather's node-then-cross structure,
+    ops/mpi_operations.cc:168-314; knob common.h:76)."""
+
+    def build():
+        def inner(x):
+            # block (1, s0, *S) -> full (W*s0, *S) on every device
+            g = lax.all_gather(x[0], mesh_mod.LOCAL_AXIS, axis=0, tiled=True)
+            g = lax.all_gather(g, mesh_mod.CROSS_AXIS, axis=0, tiled=True)
+            return g
+
+        def f(x):
+            return jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=P(mesh_mod.GLOBAL_AXES),
+                out_specs=P(), check_vma=False)(x)
+
+        return jax.jit(f, out_shardings=_replicated(mesh))
+
+    return _cached(("hier_gather_stacked", mesh), build)
+
+
+def _hierarchical_enabled(st, op: Optional[int] = None) -> bool:
+    """Hierarchical path applies when configured and the mesh actually has
+    two levels (reference gates on hierarchical params + homogeneity,
+    nccl_operations.cc:348-355)."""
+    cross, local = st.mesh.devices.shape
+    if cross <= 1 or local <= 1:
+        return False
+    return op is None or op in (Sum, Average)
+
+
 def _bcast_stacked_fn(mesh, root: int):
     def build():
         return jax.jit(
@@ -294,7 +374,11 @@ def allreduce(
     st = basics._ensure_init()
     x = tensor_c if isinstance(tensor_c, jax.Array) else jnp.asarray(tensor_c)
     if _is_worker_stacked(x):
-        out = _reduce_stacked_fn(st.mesh, red_op)(x)
+        if (st.config.hierarchical_allreduce
+                and _hierarchical_enabled(st, red_op)):
+            out = _hierarchical_reduce_stacked_fn(st.mesh, red_op)(x)
+        else:
+            out = _reduce_stacked_fn(st.mesh, red_op)(x)
     else:
         # Replicated: every worker holds the same value.
         if red_op in (Average, Min, Max):
@@ -364,6 +448,9 @@ def allgather(tensor, name: Optional[str] = None, axis_name=None):
                 "must have rank >= 1 (stacked input rank >= 2); got shape "
                 f"{x.shape}"
             )
+        if (st.config.hierarchical_allgather
+                and _hierarchical_enabled(st)):
+            return _hierarchical_gather_stacked_fn(st.mesh)(x)
         return _gather_stacked_fn(st.mesh)(x)
     # Replicated: every worker contributes the same tensor.
     if x.ndim < 1:

@@ -74,8 +74,9 @@ class Executor:
     def _replicated(self):
         return NamedSharding(self.mesh, P())
 
-    def _fused_allreduce_program(self, shapes, dtype, average: bool):
-        key = ("fused_allreduce", shapes, str(dtype), average)
+    def _fused_allreduce_program(self, shapes, dtype, average: bool,
+                                 hierarchical: bool = False):
+        key = ("fused_allreduce", shapes, str(dtype), average, hierarchical)
         with self._lock:
             fn = self._programs.get(key)
             if fn is not None:
@@ -88,10 +89,30 @@ class Executor:
                 n *= int(d)
             sizes.append(n)
 
+        if hierarchical:
+            # two-level reduction over the fused buffer (shared body with
+            # the eager path: collectives.two_level_reduce_block)
+            cross, local = self.mesh.devices.shape
+            world = cross * local
+
+            def inner(xblk):
+                return collectives.two_level_reduce_block(
+                    xblk[0], local, world, average)
+
+            def reduce_buf(buf):
+                return jax.shard_map(
+                    inner, mesh=self.mesh,
+                    in_specs=P(mesh_mod.GLOBAL_AXES),
+                    out_specs=P(), check_vma=False)(buf)
+        else:
+            def reduce_buf(buf):
+                return (jnp.mean(buf, axis=0) if average
+                        else jnp.sum(buf, axis=0))
+
         def f(*tensors):
             flat = [t.reshape(t.shape[0], -1) for t in tensors]
             buf = jnp.concatenate(flat, axis=1) if len(flat) > 1 else flat[0]
-            red = jnp.mean(buf, axis=0) if average else jnp.sum(buf, axis=0)
+            red = reduce_buf(buf)
             outs = []
             off = 0
             for shape, n in zip(shapes, sizes):
@@ -103,6 +124,12 @@ class Executor:
         with self._lock:
             self._programs[key] = fn
         return fn
+
+    def hierarchical_available(self) -> bool:
+        """Two-level collectives need both mesh axes populated (reference
+        gates hierarchical on topology, nccl_operations.cc:348-355)."""
+        cross, local = self.mesh.devices.shape
+        return cross > 1 and local > 1
 
     def execute(self, response, entries: List[types.TensorTableEntry],
                 timeline=None) -> None:
@@ -313,7 +340,10 @@ class Executor:
             timeline.activity_end(stacked[0].name)
             timeline.activity_start(stacked[0].name,
                                     timeline_mod.XLA_COLLECTIVE)
-        fn = self._fused_allreduce_program(shapes, dtype, avg)
+        hier = (collectives.state_mod.global_state()
+                .config.hierarchical_allreduce
+                and self.hierarchical_available())
+        fn = self._fused_allreduce_program(shapes, dtype, avg, hier)
         outs = fn(*[e.tensor for e in stacked])
         for e, out in zip(stacked, outs):
             e.output = out

@@ -79,7 +79,10 @@ class ResponseCache:
 
     def put(self, response: msg.Response, request: msg.Request) -> int:
         """Insert (or refresh) a single-tensor response; evicts LRU at
-        capacity (reference: response_cache.cc:144-230)."""
+        capacity (reference: response_cache.cc:144-230). No-op at
+        capacity 0 (cache disabled via HOROVOD_CACHE_CAPACITY=0)."""
+        if self.capacity <= 0:
+            return -1
         name = request.tensor_name
         bit = self._name_to_bit.get(name)
         if bit is not None and bit in self._entries:

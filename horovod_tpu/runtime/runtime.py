@@ -100,13 +100,22 @@ class Runtime:
                 cache_enabled=self.controller.cache_enabled,
                 hierarchical_allreduce=st.config.hierarchical_allreduce,
                 hierarchical_allgather=st.config.hierarchical_allgather)
+            # hierarchical knobs join the sweep only where the data plane
+            # consults them: the XLA mesh path with a two-level mesh; the
+            # cache knob only when a cache exists to toggle
+            sweep = (["cache_enabled"] if st.config.cache_capacity > 0
+                     else [])
+            if (getattr(self.controller, "net", None) is None
+                    and self.executor.hierarchical_available()):
+                sweep += ["hierarchical_allreduce", "hierarchical_allgather"]
             self.param_manager = ParameterManager(
                 initial,
                 warmup_samples=st.config.autotune_warmup_samples,
                 steps_per_sample=st.config.autotune_steps_per_sample,
                 bayes_opt_max_samples=st.config.autotune_bayes_opt_max_samples,
                 gp_noise=st.config.autotune_gaussian_process_noise,
-                log_path=st.config.autotune_log, rank=st.rank)
+                log_path=st.config.autotune_log, rank=st.rank,
+                sweep=tuple(sweep))
         self._stop = threading.Event()
         self._woken = threading.Event()
         self._thread = threading.Thread(
@@ -218,6 +227,12 @@ class Runtime:
                 self.executor.execute(response, entries,
                                       timeline=self.timeline)
                 if self._autotune_active:
+                    # JAX dispatch is async: block so the score measures
+                    # the collective itself, not host dispatch latency
+                    # (the reference scores completed-op wall time)
+                    jax.block_until_ready(
+                        [e.output for e in entries
+                         if e.output is not None])
                     for e in entries:
                         cycle_bytes += types.entry_nbytes(e)
         if self._autotune_active:

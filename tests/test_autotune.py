@@ -72,18 +72,6 @@ def _mk_manager(**kw):
     return ParameterManager(initial, **kw)
 
 
-def _feed_point(pm, score, steps_per_sample=2):
-    """Feed exactly one tuning point's worth of samples with a fixed
-    throughput (bytes/us = score)."""
-    changed = False
-    guard = 0
-    while True:
-        changed = pm.update(int(score * 1e6 * 0.01), 0.01)
-        guard += 1
-        if changed or not pm.active or guard > 200:
-            return changed
-
-
 class TestParameterManager:
     def test_warmup_discarded_then_samples_collected(self):
         pm = _mk_manager()
@@ -143,6 +131,33 @@ class TestParameterManager:
 
 
 class TestRuntimeIntegration:
+    def test_autotune_with_cache_disabled(self, hvd, monkeypatch):
+        """HOROVOD_CACHE_CAPACITY=0 + --autotune: the cache knob leaves the
+        sweep (toggling it on would crash put() on a zero-capacity cache)
+        and tuning still runs over the continuous knobs."""
+        monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+        monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "0")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "0")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "2")
+        hvd.shutdown()
+        hvd.init()
+        try:
+            from horovod_tpu.runtime.runtime import get_runtime
+
+            rt = get_runtime()
+            assert "cache_enabled" not in rt.param_manager._sweep
+            for i in range(80):
+                h = hvd.allreduce_async(
+                    np.full((8,), 1.0, np.float32), name=f"cz/{i % 2}")
+                np.testing.assert_allclose(
+                    np.asarray(hvd.synchronize(h)), 1.0)
+                if not rt._autotune_active:
+                    break
+            assert not rt._autotune_active
+        finally:
+            hvd.shutdown()
+
     def test_autotune_engages_and_converges(self, hvd, monkeypatch):
         """HOROVOD_AUTOTUNE=1: the runtime scores cycles, tunes, broadcasts
         params, and keeps collectives correct while knobs change."""

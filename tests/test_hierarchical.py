@@ -1,0 +1,118 @@
+"""Hierarchical (two-level ICI/DCN) collective tests on the virtual
+(2, 4) CPU mesh.
+
+The two-level RS→AR→AG decomposition (reference:
+NCCLHierarchicalAllreduce, ops/nccl_operations.cc:150-346; hierarchical
+allgather mpi_operations.cc:168-314; knobs common.h:75-76) must be
+numerically identical to the flat path — the difference is which wires the
+bytes ride.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu  # noqa: F401  (conftest provides the hvd fixture)
+
+
+@pytest.fixture
+def hvd_hier(hvd, monkeypatch):
+    """Re-init with hierarchical knobs on (env-driven, like tpurun
+    --hierarchical-allreduce)."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "1")
+    hvd.shutdown()
+    hvd.init(mesh_shape=(2, 4))
+    yield hvd
+    hvd.shutdown()
+
+
+class TestHierarchicalAllreduce:
+    def test_matches_flat_average(self, hvd_hier):
+        hvd = hvd_hier
+        x = hvd.stack_per_worker(
+            [np.full((5, 3), float(r), np.float32) for r in range(8)])
+        out = np.asarray(hvd.allreduce(x))
+        np.testing.assert_allclose(out, 3.5)
+
+    def test_matches_flat_sum(self, hvd_hier):
+        hvd = hvd_hier
+        x = hvd.stack_per_worker(
+            [np.full((7,), float(r + 1), np.float32) for r in range(8)])
+        out = np.asarray(hvd.allreduce(x, average=False))
+        np.testing.assert_allclose(out, sum(range(1, 9)))
+
+    def test_padding_when_not_divisible(self, hvd_hier):
+        # 5 elements over local=4 needs padding inside the RS/AG phases
+        hvd = hvd_hier
+        vals = [np.arange(5, dtype=np.float32) + r for r in range(8)]
+        x = hvd.stack_per_worker(vals)
+        out = np.asarray(hvd.allreduce(x))
+        np.testing.assert_allclose(out, np.mean(np.stack(vals), axis=0),
+                                   rtol=1e-6)
+
+    def test_min_max_fall_back_to_flat(self, hvd_hier):
+        hvd = hvd_hier
+        x = hvd.stack_per_worker(
+            [np.full((4,), float(r), np.float32) for r in range(8)])
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Min)),
+                                   0.0)
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Max)),
+                                   7.0)
+
+    def test_named_async_fused_hierarchical(self, hvd_hier):
+        """The enqueue runtime's fused program takes the two-level path."""
+        hvd = hvd_hier
+        handles = [
+            hvd.allreduce_async(
+                hvd.stack_per_worker(
+                    [np.full((6,), float(r * (i + 1)), np.float32)
+                     for r in range(8)]),
+                name=f"hier/{i}")
+            for i in range(3)
+        ]
+        for i, h in enumerate(handles):
+            out = np.asarray(hvd.synchronize(h))
+            np.testing.assert_allclose(
+                out, np.mean([r * (i + 1) for r in range(8)]))
+
+    def test_flat_when_mesh_single_level(self, hvd, monkeypatch):
+        # (1, 8) mesh: no cross axis — hierarchical silently degrades
+        monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+        hvd.shutdown()
+        hvd.init(mesh_shape=(1, 8))
+        x = hvd.stack_per_worker(
+            [np.full((3,), float(r), np.float32) for r in range(8)])
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x)), 3.5)
+        hvd.shutdown()
+
+
+class TestHierarchicalAllgather:
+    def test_matches_flat(self, hvd_hier):
+        hvd = hvd_hier
+        vals = [np.full((2, 3), float(r), np.float32) for r in range(8)]
+        out = np.asarray(hvd.allgather(hvd.stack_per_worker(vals)))
+        np.testing.assert_allclose(out, np.concatenate(vals, axis=0))
+
+    def test_rank_order_preserved(self, hvd_hier):
+        # worker order must be global rank order, not per-level order
+        hvd = hvd_hier
+        vals = [np.array([[r * 10.0]], np.float32) for r in range(8)]
+        out = np.asarray(hvd.allgather(hvd.stack_per_worker(vals)))
+        np.testing.assert_allclose(out[:, 0], [r * 10.0 for r in range(8)])
+
+
+class TestAutotuneSweepsHierarchical:
+    def test_sweep_includes_hierarchical_on_two_level_mesh(
+            self, hvd, monkeypatch):
+        monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+        hvd.shutdown()
+        hvd.init(mesh_shape=(2, 4))
+        try:
+            from horovod_tpu.runtime.runtime import get_runtime
+
+            pm = get_runtime().param_manager
+            assert pm is not None
+            assert "hierarchical_allreduce" in pm._sweep
+            assert "hierarchical_allgather" in pm._sweep
+        finally:
+            hvd.shutdown()
