@@ -1,0 +1,324 @@
+"""horovod_tpu.torch — PyTorch binding for the TPU-native framework.
+
+Rebuild of the reference's torch API (reference: horovod/torch/__init__.py
+:1-404): ``import horovod_tpu.torch as hvd`` gives the same surface as the
+reference — ``hvd.init()``, ``hvd.DistributedOptimizer`` with per-parameter
+gradient hooks firing async allreduces as gradients become ready,
+``hvd.broadcast_parameters`` / ``hvd.broadcast_optimizer_state`` for the
+checkpoint-on-rank-0 convention, and the full sync/async collective op set
+with autograd support.
+
+Torch runs on CPU; the collectives run on the XLA data plane through the
+dynamic enqueue runtime (negotiation, response cache, tensor fusion —
+SURVEY.md §2.1).
+"""
+
+import collections
+import contextlib
+
+
+import torch
+
+from horovod_tpu.core.basics import (  # noqa: F401 — re-exported lifecycle
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mesh,
+    is_homogeneous,
+    mpi_built,
+    gloo_built,
+    nccl_built,
+    xla_built,
+    mpi_enabled,
+    mpi_threads_supported,
+)
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    Average,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    poll,
+    synchronize,
+)
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Optimizer wrapper that allreduces gradients as they become ready.
+
+    Reference: horovod/torch/__init__.py:47-203. Each parameter gets a
+    post-accumulate-grad hook; after ``backward_passes_per_step`` backward
+    passes the hook fires an async in-place allreduce on the gradient, and
+    ``step()`` synchronizes all outstanding handles before applying
+    updates, overlapping communication with the remainder of backward.
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for i, v in enumerate(
+                    v for param_group in self.param_groups
+                    for v in param_group["params"])
+            ]
+
+        # The name is the cross-rank negotiation key: dups break fusion
+        # (reference: horovod/torch/__init__.py:66-80).
+        all_names = [name for name, _ in named_parameters]
+        if len(set(all_names)) < len(all_names):
+            seen, dups = set(), set()
+            for name in all_names:
+                (dups if name in seen else seen).add(name)
+            raise ValueError(
+                f"parameter names must be unique, duplicates: {sorted(dups)}")
+        named_set = {p for _, p in named_parameters}
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p not in named_set:
+                    raise ValueError(
+                        "named_parameters was specified but one or more "
+                        "optimizer parameters were not named")
+
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce_delay = {}
+        self._handles = {}
+        self._grad_accs = []  # keep hook owners alive (legacy path)
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        if size() > 1:
+            self._register_hooks()
+
+    def _register_hooks(self):
+        """reference: horovod/torch/__init__.py:108-126 (expand_as
+        grad_fn trick); torch>=2.1 has a first-class API for it."""
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    if hasattr(p, "register_post_accumulate_grad_hook"):
+                        p.register_post_accumulate_grad_hook(
+                            self._make_post_hook(p))
+                    else:  # pragma: no cover — old torch
+                        p_tmp = p.expand_as(p)
+                        grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                        grad_acc.register_hook(self._make_hook(p))
+                        self._grad_accs.append(grad_acc)
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(p)
+        return allreduce_async_(p.grad, average=True, name=name,
+                                compression=self._compression)
+
+    def _make_post_hook(self, p):
+        def hook(param):
+            self._mark_ready(p)
+
+        return hook
+
+    def _make_hook(self, p):  # pragma: no cover — old torch
+        def hook(*ignore):
+            self._mark_ready(p)
+
+        return hook
+
+    def _mark_ready(self, p):
+        """reference: horovod/torch/__init__.py:127-143."""
+        if p in self._handles and self._handles[p] is not None:
+            if self._allreduce_delay[p] <= 0:
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to step(). "
+                    "Increase backward_passes_per_step to accumulate "
+                    "gradients locally.")
+        assert not p.grad.requires_grad
+        assert self._allreduce_delay[p] > 0
+        self._allreduce_delay[p] -= 1
+        if self._allreduce_delay[p] == 0:
+            self._handles[p] = self._allreduce_grad_async(p)
+
+    def synchronize(self):
+        """Wait for all outstanding allreduces and restore dtypes
+        (reference: horovod/torch/__init__.py:145-183)."""
+        missing = [p for p in self._requires_update
+                   if p not in self._handles]
+        for p in missing:
+            self._handles[p] = self._allreduce_grad_async(p)
+            self._allreduce_delay[p] = 0
+        for p, handle in self._handles.items():
+            if handle is None:
+                continue
+            output = synchronize(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            if output is not p.grad:
+                p.grad.data = output.to(p.grad.dtype)
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """For callers that invoked ``synchronize()`` manually before
+        ``step()`` (reference: horovod/torch/__init__.py:185-193)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+
+                warnings.warn(
+                    "optimizer.step() called after optimizer.synchronize() "
+                    "but outside the optimizer.skip_synchronize() context — "
+                    "gradients will be allreduced a second time, slowing "
+                    "training; wrap step() in skip_synchronize()")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        """API-misuse race detection (reference:
+        horovod/torch/__init__.py:197-202, SURVEY.md §5.2): zeroing grads
+        while async allreduces are reading them corrupts the average."""
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(). "
+                "This is prohibited as it can cause a race condition.")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1):
+    """Wrap a torch optimizer for distributed gradient averaging
+    (reference: horovod/torch/__init__.py:205-253)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast parameters from root to all workers — model init / resume
+    (reference: horovod/torch/__init__.py:255-297). Accepts a
+    ``state_dict()`` or an iterable of (name, tensor)."""
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    elif isinstance(params, collections.abc.Iterable):
+        params = list(params)
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+
+    handles = []
+    for name, p in params:
+        if p is None:
+            continue
+        if not isinstance(p, torch.Tensor):
+            # non-tensor state_dict entries (e.g. num_batches_tracked ints)
+            continue
+        handles.append(broadcast_async_(p.data, root_rank, name=name))
+    for handle in handles:
+        synchronize(handle)
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcast the optimizer state from root to all workers (reference:
+    horovod/torch/__init__.py:299-403 — the reference wraps scalars into
+    tensors and broadcasts per-entry with a type-restoration callback;
+    here the structure travels once as pickled bytes and tensor state is
+    broadcast tensor-wise)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+
+    # 1. Non-tensor structure (param_groups + scalar state) plus tensor
+    #    metadata via one object broadcast.
+    skeleton = {
+        "param_groups": state_dict["param_groups"],
+        "state_scalars": {
+            pid: {k: v for k, v in s.items()
+                  if not isinstance(v, torch.Tensor)}
+            for pid, s in state_dict["state"].items()
+        },
+        "state_meta": {
+            pid: {k: (tuple(v.shape), str(v.dtype))
+                  for k, v in s.items() if isinstance(v, torch.Tensor)}
+            for pid, s in state_dict["state"].items()
+        },
+    }
+    skeleton = broadcast_object(skeleton, root_rank,
+                                name="optimizer.state_skeleton")
+
+    if rank() != root_rank:
+        state_dict["param_groups"] = skeleton["param_groups"]
+        for pid, scalars in skeleton["state_scalars"].items():
+            state_dict["state"].setdefault(pid, {}).update(scalars)
+
+    # 2. Tensor state broadcast tensor-wise (dtype-preserving); non-root
+    #    ranks allocate from the skeleton's metadata when missing.
+    handles = []
+    for pid, meta in skeleton["state_meta"].items():
+        for key, (shape, dtype_str) in sorted(meta.items()):
+            entry = state_dict["state"].setdefault(pid, {})
+            t = entry.get(key)
+            if not isinstance(t, torch.Tensor):
+                dtype = getattr(torch, dtype_str.replace("torch.", ""))
+                t = torch.zeros(shape, dtype=dtype)
+                entry[key] = t
+            handles.append(
+                broadcast_async_(t.data, root_rank,
+                                 name=f"optimizer.state.{pid}.{key}"))
+    for h in handles:
+        synchronize(h)
+    optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Broadcast an arbitrary picklable object (used for epochs / RNG
+    state in resume flows; reference examples:
+    pytorch_imagenet_resnet50.py resume_from_epoch broadcast)."""
+    import pickle
+
+    name = name or "broadcast_object"
+    if size() == 1:
+        return obj
+    if rank() == root_rank:
+        payload = pickle.dumps(obj)
+        sz = torch.tensor([len(payload)], dtype=torch.int64)
+    else:
+        sz = torch.zeros(1, dtype=torch.int64)
+    broadcast_(sz, root_rank, name=f"{name}.size")
+    if rank() == root_rank:
+        buf = torch.frombuffer(bytearray(payload), dtype=torch.uint8).clone()
+    else:
+        buf = torch.zeros(int(sz.item()), dtype=torch.uint8)
+    broadcast_(buf, root_rank, name=f"{name}.bytes")
+    if rank() == root_rank:
+        return obj
+    return pickle.loads(buf.numpy().tobytes())

@@ -1,0 +1,82 @@
+"""Gradient compression algorithms for the torch binding.
+
+TPU-native rebuild of the reference's torch compression (reference:
+horovod/torch/compression.py:1-78): compressors shrink the tensor before it
+hits the wire/ICI and restore it after. fp16 compression halves allreduce
+bytes; on TPU the natural wire dtype is bfloat16 (same byte savings, MXU
+native, no overflow rescaling needed), so both are offered.
+"""
+
+import torch
+
+
+class Compressor:
+    """Interface for compressing and decompressing a tensor
+    (reference: horovod/torch/compression.py:23-35)."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context) for later decompression."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        """Restore the tensor to its pre-compression dtype."""
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Default: no compression (reference: compression.py:38-49)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to float16 before the collective
+    (reference: compression.py:52-75)."""
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if tensor.is_floating_point() and dtype != torch.float16:
+            return tensor.to(torch.float16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-idiomatic variant: bfloat16 wire dtype — same 2x byte saving as
+    fp16 with float32's exponent range (no overflow on large gradients)."""
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if tensor.is_floating_point() and dtype != torch.bfloat16:
+            return tensor.to(torch.bfloat16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce
+    (reference: horovod/torch/compression.py:68-78)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -84,6 +84,16 @@ def test_shape_mismatch_errors_on_all_ranks():
         assert p.returncode == 0, out
 
 
+@pytest.mark.parametrize("world", [2, 3])
+def test_torch_binding_across_processes(world):
+    """Torch DistributedOptimizer + broadcasts under a real multi-process
+    world (reference: test/test_torch.py under mpirun -np 2)."""
+    procs, outs = _launch("torch", world, timeout=150)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK rank=" in out
+
+
 def test_stall_triggers_global_shutdown():
     procs, outs = _launch(
         "stall_shutdown", 2,

@@ -1,0 +1,244 @@
+"""Torch binding tests — collective semantics, autograd, optimizer.
+
+Mirrors the reference's torch op-test structure (reference:
+test/test_torch.py:1-1382): collective results asserted against locally
+computed expectations, gradient correctness per op, optimizer wrapper
+behavior (hooks, synchronize, zero_grad race guard), and parameter /
+optimizer-state broadcast.
+
+World model: one process owning the 8-device CPU mesh = 8 workers holding
+identical (replicated) values, so average is identity and sum multiplies by
+world size — the single-controller invariant. The true multi-process torch
+path (distinct per-rank values over the socket controller) is exercised by
+test_multiprocess.py's torch scenario.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _world():
+    hvd.shutdown()
+    hvd.init(mesh_shape=(1, WORLD))
+    yield
+    hvd.shutdown()
+
+
+class TestOps:
+    def test_allreduce_average_identity(self):
+        x = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        out = hvd.allreduce(x)
+        assert torch.allclose(out, x)
+        assert out is not x
+
+    def test_allreduce_inplace(self):
+        x = torch.ones(4)
+        out = hvd.allreduce_(x)
+        assert out is x
+        assert torch.allclose(out, torch.ones(4))
+
+    def test_allreduce_sum_dtypes(self):
+        for dtype in [torch.float32, torch.float64, torch.float16,
+                      torch.bfloat16, torch.int32, torch.int64]:
+            x = torch.ones(5, dtype=dtype)
+            out = hvd.synchronize(hvd.allreduce_async(x, average=False))
+            assert out.dtype == dtype, dtype
+            assert torch.equal(out, x * WORLD), dtype
+
+    def test_allreduce_fp16_compression(self):
+        x = torch.full((8,), 2.0)
+        out = hvd.allreduce(x, compression=hvd.Compression.fp16)
+        assert out.dtype == torch.float32
+        assert torch.allclose(out, x)
+
+    def test_allreduce_bf16_compression(self):
+        x = torch.full((8,), 2.0)
+        out = hvd.allreduce(x, compression=hvd.Compression.bf16)
+        assert out.dtype == torch.float32
+        assert torch.allclose(out, x)
+
+    def test_allgather(self):
+        x = torch.randn(3, 2)
+        out = hvd.allgather(x)
+        assert out.shape == (3 * WORLD, 2)
+        assert torch.allclose(out, x.repeat(WORLD, 1))
+
+    def test_broadcast(self):
+        x = torch.randn(4)
+        out = hvd.broadcast(x, root_rank=0)
+        assert torch.allclose(out, x)
+
+    def test_poll_synchronize(self):
+        h = hvd.allreduce_async(torch.ones(3))
+        out = hvd.synchronize(h)
+        assert hvd.poll(h)
+        assert torch.allclose(out, torch.ones(3))
+
+    def test_allreduce_grad(self):
+        x = torch.randn(5, requires_grad=True)
+        out = hvd.allreduce(x)
+        out.sum().backward()
+        assert torch.allclose(x.grad, torch.ones(5))
+
+    def test_allgather_grad(self):
+        # Each of the WORLD (identical) workers computes the same loss over
+        # the gathered output; the distributed gradient is the sum-allreduce
+        # of grad_output sliced to this worker's segment → WORLD * ones.
+        x = torch.randn(3, 2, requires_grad=True)
+        out = hvd.allgather(x)
+        out.sum().backward()
+        assert torch.allclose(x.grad, torch.full((3, 2), float(WORLD)))
+
+    def test_broadcast_grad(self):
+        # rank 0 is the root, so it receives the summed gradient.
+        x = torch.randn(4, requires_grad=True)
+        out = hvd.broadcast(x, root_rank=0)
+        (out * 2).sum().backward()
+        assert torch.allclose(x.grad, torch.full((4,), 2.0 * WORLD))
+
+
+class TestDistributedOptimizer:
+    def _model(self):
+        torch.manual_seed(0)
+        return torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2))
+
+    def test_step_updates(self):
+        model = self._model()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        before = [p.clone() for p in model.parameters()]
+        loss = model(torch.randn(16, 4)).pow(2).mean()
+        loss.backward()
+        opt.step()
+        after = list(model.parameters())
+        assert any(not torch.allclose(b, a)
+                   for b, a in zip(before, after))
+
+    def test_matches_undistributed_sgd(self):
+        # With replicated workers, averaged grads == local grads, so the
+        # wrapped optimizer must reproduce plain SGD exactly.
+        model_a, model_b = self._model(), self._model()
+        model_b.load_state_dict(model_a.state_dict())
+        opt_a = torch.optim.SGD(model_a.parameters(), lr=0.1)
+        opt_b = hvd.DistributedOptimizer(
+            torch.optim.SGD(model_b.parameters(), lr=0.1),
+            named_parameters=model_b.named_parameters())
+        x = torch.randn(8, 4)
+        for opt, model in [(opt_a, model_a), (opt_b, model_b)]:
+            model(x).pow(2).mean().backward()
+            opt.step()
+        for pa, pb in zip(model_a.parameters(), model_b.parameters()):
+            assert torch.allclose(pa, pb, atol=1e-6)
+
+    def test_zero_grad_race_guard(self):
+        # reference: torch/__init__.py:197-202 — zero_grad between backward
+        # and step must raise while async handles are outstanding.
+        model = self._model()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        opt._handles[next(model.parameters())] = object()
+        with pytest.raises(AssertionError, match="race"):
+            opt.zero_grad()
+        opt._handles.clear()
+
+    def test_duplicate_names_rejected(self):
+        model = self._model()
+        params = list(model.named_parameters())
+        params[1] = (params[0][0], params[1][1])
+        with pytest.raises(ValueError, match="unique"):
+            hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=params)
+
+    def test_backward_passes_per_step_accumulates(self):
+        model = self._model()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        x = torch.randn(4, 4)
+        model(x).pow(2).mean().backward()
+        # after one backward pass no allreduce has fired yet
+        assert not opt._handles
+        model(x).pow(2).mean().backward()
+        assert opt._handles
+        opt.step()
+
+    def test_skip_synchronize(self):
+        model = self._model()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        loss = model(torch.randn(4, 4)).pow(2).mean()
+        loss.backward()
+        opt.synchronize()
+        with opt.skip_synchronize():
+            opt.step()
+
+
+class TestBroadcastState:
+    def test_broadcast_parameters_state_dict(self):
+        model = torch.nn.Linear(3, 3)
+        want = {k: v.clone() for k, v in model.state_dict().items()}
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        for k, v in model.state_dict().items():
+            assert torch.allclose(v, want[k])
+
+    def test_broadcast_parameters_named(self):
+        model = torch.nn.Linear(3, 3)
+        hvd.broadcast_parameters(model.named_parameters(), root_rank=0)
+
+    def test_broadcast_optimizer_state(self):
+        model = torch.nn.Linear(3, 3)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        model(torch.randn(2, 3)).sum().backward()
+        opt.step()
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        state = opt.state_dict()["state"]
+        assert any("momentum_buffer" in s for s in state.values())
+
+    def test_broadcast_optimizer_state_adam(self):
+        model = torch.nn.Linear(3, 3)
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+        model(torch.randn(2, 3)).sum().backward()
+        opt.step()
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        state = opt.state_dict()["state"]
+        assert any("exp_avg" in s for s in state.values())
+
+    def test_broadcast_object(self):
+        assert hvd.broadcast_object({"epoch": 3}) == {"epoch": 3}
+
+    def test_lbfgs_rejected(self):
+        model = torch.nn.Linear(3, 3)
+        opt = torch.optim.LBFGS(model.parameters())
+        with pytest.raises(ValueError, match="LBFGS"):
+            hvd.broadcast_optimizer_state(opt)
+
+
+class TestNumpyBridge:
+    def test_bf16_roundtrip(self):
+        from horovod_tpu.torch.mpi_ops import _from_numpy, _to_numpy
+
+        x = torch.randn(7).to(torch.bfloat16)
+        arr = _to_numpy(x)
+        back = _from_numpy(arr, x)
+        assert back.dtype == torch.bfloat16
+        assert torch.equal(back, x)
+
+    def test_noncontiguous(self):
+        from horovod_tpu.torch.mpi_ops import _to_numpy
+
+        x = torch.randn(4, 4).t()
+        arr = _to_numpy(x)
+        np.testing.assert_allclose(arr, x.numpy())
