@@ -73,6 +73,9 @@ from horovod_tpu.parallel.dp import (
     broadcast_optimizer_state,
     broadcast_object,
 )
+from horovod_tpu.parallel.ring import ring_attention
+from horovod_tpu.parallel.ulysses import ulysses_attention
+from horovod_tpu.ops.pallas import flash_attention
 
 __all__ = [
     "__version__",
@@ -94,4 +97,6 @@ __all__ = [
     "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "Compression",
+    # long-context / sequence parallelism (TPU-first extensions)
+    "flash_attention", "ring_attention", "ulysses_attention",
 ]

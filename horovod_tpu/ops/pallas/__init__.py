@@ -1,0 +1,15 @@
+"""Pallas TPU kernels for the framework's hot ops."""
+
+from horovod_tpu.ops.pallas.flash_attention import (
+    attention_reference,
+    flash_attention,
+    flash_attention_partial,
+    merge_partials,
+)
+
+__all__ = [
+    "flash_attention",
+    "flash_attention_partial",
+    "merge_partials",
+    "attention_reference",
+]

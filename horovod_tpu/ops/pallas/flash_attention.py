@@ -1,0 +1,449 @@
+"""Fused blockwise (flash) attention as a Pallas TPU kernel.
+
+The hot op of the transformer model family. Online-softmax attention that
+never materialises the ``(seq, seq)`` score matrix: per query block, key/value
+blocks stream through VMEM while a running (max, sum, accumulator) triple is
+maintained — the MXU does the two matmuls, the VPU the rescaling. A custom
+VJP provides the matching blockwise backward kernels (dq; dk/dv), so memory
+stays O(seq · head_dim) end to end.
+
+This kernel is also the *local* building block of ring attention
+(horovod_tpu/parallel/ring.py): it accepts dynamic ``q_offset``/``k_offset``
+global position scalars and returns the per-row log-sum-exp, so partial
+results computed against one shard of keys/values can be merged exactly
+across ppermute steps (see ``merge_partials``).
+
+The reference framework has no attention kernels at all (it is a pure
+data-parallel gradient-averaging layer — SURVEY.md §5.7); this module is part
+of the TPU-first long-context extension, not a port.
+
+On non-TPU backends (CPU tests) the kernels run in Pallas interpret mode;
+set ``HOROVOD_PALLAS_INTERPRET=0/1`` to force either way.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = float("-inf")
+
+
+def _use_interpret() -> bool:
+    env = os.environ.get("HOROVOD_PALLAS_INTERPRET")
+    if env is not None:
+        return env not in ("0", "false", "False")
+    return jax.devices()[0].platform != "tpu"
+
+
+def _vma(*arrays) -> frozenset:
+    """Union of the inputs' varying-mesh-axes, so pallas_call outputs carry
+    the right vma under ``shard_map(check_vma=True)``."""
+    out = frozenset()
+    for a in arrays:
+        out |= getattr(jax.typeof(a), "vma", frozenset())
+    return out
+
+
+def _pick_block(seq: int, requested: int) -> int:
+    """Largest block ≤ requested that divides seq (power-of-two friendly)."""
+    b = min(requested, seq)
+    while seq % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                *, sm_scale, causal, block_q, block_k, kv_seq):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale  # (bq, d)
+    nk = kv_seq // block_k
+
+    q_start = q_off_ref[0] + qi * block_q
+    q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+
+    if causal:
+        # Only k blocks whose first global id can be <= the last q id.
+        last_q = q_start + block_q - 1
+        nk_dyn = jnp.clip(
+            (last_q - k_off_ref[0]) // block_k + 1, 0, nk)
+    else:
+        nk_dyn = nk
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            k_ids = (k_off_ref[0] + j * block_k
+                     + jax.lax.broadcasted_iota(
+                         jnp.int32, (block_q, block_k), 1))
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        # Rows with every key masked so far have m_new == -inf; subtracting
+        # -inf would give NaN, so shift by a safe 0 instead — every exp()
+        # argument is then -inf and the row correctly accumulates nothing.
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        alpha = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m, l, acc = jax.lax.fori_loop(0, nk_dyn, body, (m0, l0, acc0))
+
+    # Fully-masked rows (l == 0): output 0, lse -inf so a later merge
+    # treats this partial as absent.
+    empty = l == 0.0
+    l_safe = jnp.where(empty, 1.0, l)
+    m_fin = jnp.where(empty, 0.0, m)
+    o_ref[0, 0, :, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse = jnp.where(empty, NEG_INF, m_fin + jnp.log(l_safe))
+    # Row vectors are stored broadcast across LANES lanes to satisfy TPU
+    # tiling (same layout as the stock TPU flash kernel's l/m buffers).
+    lse_ref[0, 0, :, :] = jax.lax.broadcast_in_dim(
+        lse, (block_q, LANES), (0,))
+
+
+# Per-row scalars (lse, delta) are stored as (B, H, S, LANES) with the value
+# broadcast across lanes, satisfying the TPU (8, 128) tiling constraint.
+LANES = 128
+
+
+def _make_specs(block_q, block_k, dim, q_seq, kv_seq):
+    """Common BlockSpecs: q-like blocks, full-sequence k/v, row vectors."""
+    q_spec = pl.BlockSpec((1, 1, block_q, dim), lambda b, h, i: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, kv_seq, dim), lambda b, h, i: (b, h, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, LANES),
+                            lambda b, h, i: (b, h, i, 0))
+    return q_spec, kv_spec, row_spec
+
+
+# The scalar offsets ride as int32 arrays of shape (1,); gridded kernels see
+# the whole array in scalar memory, indexed as ref[0].
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+_OFF_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_fwd(q, k, v, q_offset, k_offset, *, sm_scale, causal,
+               block_q, block_k, interpret):
+    batch, heads, q_seq, dim = q.shape
+    kv_seq = k.shape[2]
+    block_q = _pick_block(q_seq, block_q)
+    block_k = _pick_block(kv_seq, block_k)
+    grid = (batch, heads, q_seq // block_q)
+    q_spec, kv_spec, row_spec = _make_specs(block_q, block_k, dim,
+                                            q_seq, kv_seq)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_seq=kv_seq)
+
+    vma = _vma(q, k, v, q_offset, k_offset)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, kv_spec, kv_spec],
+        out_specs=[q_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((batch, heads, q_seq, LANES), jnp.float32,
+                                 vma=vma),
+        ],
+        interpret=interpret,
+    )(q_offset, k_offset, q, k, v)
+    return o, lse  # lse lane-broadcast: (B, H, S, LANES)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref,
+                   *, sm_scale, causal, block_q, block_k, kv_seq):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    nk = kv_seq // block_k
+
+    q_start = q_off_ref[0] + qi * block_q
+    q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    # Fully-masked rows have lse = -inf and all s = -inf; shifting by 0
+    # instead of -inf keeps exp(s - lse) at 0 rather than NaN.
+    lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+
+    if causal:
+        last_q = q_start + block_q - 1
+        nk_dyn = jnp.clip((last_q - k_off_ref[0]) // block_k + 1, 0, nk)
+    else:
+        nk_dyn = nk
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            k_ids = (k_off_ref[0] + j * block_k
+                     + jax.lax.broadcasted_iota(
+                         jnp.int32, (block_q, block_k), 1))
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse_safe[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, nk_dyn, body, jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32))
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref,
+                    *, sm_scale, causal, block_q, block_k, q_seq):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0, :, :].astype(jnp.float32)
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    nq = q_seq // block_q
+
+    k_start = k_off_ref[0] + ki * block_k
+    k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    if causal:
+        # First q block whose last global id can be >= the first k id.
+        j0 = jnp.clip((k_start - q_off_ref[0]) // block_q, 0, nq)
+    else:
+        j0 = 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q), 0]
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_ids = (q_off_ref[0] + j * block_q
+                     + jax.lax.broadcasted_iota(
+                         jnp.int32, (block_q, block_k), 0))
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse_safe[:, None])
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dim = k_ref.shape[-1]
+    dk0 = jnp.zeros((block_k, dim), jnp.float32)
+    dv0 = jnp.zeros((block_k, dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(j0, nq, body, (dk0, dv0))
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
+               block_q, block_k, interpret):
+    batch, heads, q_seq, dim = q.shape
+    kv_seq = k.shape[2]
+    block_q = _pick_block(q_seq, block_q)
+    block_k = _pick_block(kv_seq, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
+
+    q_spec, kv_spec, row_spec = _make_specs(block_q, block_k, dim,
+                                            q_seq, kv_seq)
+
+    vma = _vma(q, k, v, do, q_offset, k_offset)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_seq=kv_seq),
+        grid=(batch, heads, q_seq // block_q),
+        in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec,
+                  row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+        interpret=interpret,
+    )(q_offset, k_offset, q, k, v, do, lse, delta)
+
+    # dk/dv: grid over k blocks; q-side tensors stream via pl.ds.
+    k_block_spec = pl.BlockSpec((1, 1, block_k, dim),
+                                lambda b, h, i: (b, h, i, 0))
+    q_full_spec = pl.BlockSpec((1, 1, q_seq, dim), lambda b, h, i: (b, h, 0, 0))
+    row_full_spec = pl.BlockSpec((1, 1, q_seq, LANES),
+                                 lambda b, h, i: (b, h, 0, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_seq=q_seq),
+        grid=(batch, heads, kv_seq // block_k),
+        in_specs=[_OFF_SPEC, _OFF_SPEC, q_full_spec, k_block_spec,
+                  k_block_spec, q_full_spec, row_full_spec, row_full_spec],
+        out_specs=[k_block_spec, k_block_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype, vma=vma),
+            jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma),
+        ],
+        interpret=interpret,
+    )(q_offset, k_offset, q, k, v, do, lse, delta)
+
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API: differentiable flash attention (+ residuals for ring merging)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, q_offset, k_offset, sm_scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, q_offset, k_offset, sm_scale=sm_scale,
+                      causal=causal, block_q=block_q, block_k=block_k,
+                      interpret=_use_interpret())
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, q_offset, k_offset, sm_scale, causal,
+                   block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, q_offset, k_offset, sm_scale=sm_scale,
+                        causal=causal, block_q=block_q, block_k=block_k,
+                        interpret=_use_interpret())
+    return o, (q, k, v, o, lse, q_offset, k_offset)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse, q_offset, k_offset = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset,
+                            sm_scale=sm_scale, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=_use_interpret())
+    zero = jnp.zeros((1,), jnp.int32)
+    return dq, dk, dv, zero, zero
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _as_offset(x) -> jax.Array:
+    return jnp.asarray(x, jnp.int32).reshape((1,))
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    q_offset=0,
+    k_offset=0,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Fused attention over ``(batch, heads, seq, head_dim)`` inputs.
+
+    ``q_offset``/``k_offset`` are the global sequence positions of the first
+    query/key row — used by ring attention, where each device holds one
+    sequence shard and the causal mask depends on global, not local, indices.
+    They may be traced scalars (e.g. derived from ``lax.axis_index``).
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError("flash_attention expects (batch, heads, seq, dim)")
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    return _flash(q, k, v, _as_offset(q_offset), _as_offset(k_offset),
+                  float(sm_scale), bool(causal), int(block_q), int(block_k))
+
+
+def flash_attention_partial(
+    q, k, v, *, causal=False, sm_scale=None, q_offset=0, k_offset=0,
+    block_q: int = 128, block_k: int = 128,
+):
+    """Forward-only partial attention returning ``(out, lse)``.
+
+    ``out`` is normalised over the *local* keys only; ``lse`` is the per-row
+    log-sum-exp normaliser, so partials over disjoint key shards can be
+    combined exactly with :func:`merge_partials`. Used by the ring-attention
+    forward (the ring backward re-derives gradients through its own loop).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    o, lse = _flash_fwd(q, k, v, _as_offset(q_offset), _as_offset(k_offset),
+                        sm_scale=float(sm_scale), causal=bool(causal),
+                        block_q=int(block_q), block_k=int(block_k),
+                        interpret=_use_interpret())
+    return o, lse[..., 0]
+
+
+def merge_partials(o_a, lse_a, o_b, lse_b):
+    """Exactly combine two attention partials over disjoint key sets.
+
+    Each partial is (normalised output, log-sum-exp). Rows absent from one
+    side carry ``lse = -inf`` and contribute nothing.
+    """
+    lse = jnp.logaddexp(lse_a, lse_b)
+    # exp(-inf - -inf) would be NaN; an absent row has weight exactly 0.
+    w_a = jnp.where(lse_a == NEG_INF, 0.0, jnp.exp(lse_a - lse))
+    w_b = jnp.where(lse_b == NEG_INF, 0.0, jnp.exp(lse_b - lse))
+    o = (o_a.astype(jnp.float32) * w_a[..., None]
+         + o_b.astype(jnp.float32) * w_b[..., None])
+    return o.astype(o_a.dtype), lse
+
+
+def attention_reference(q, k, v, *, causal=False, sm_scale=None,
+                        q_offset=0, k_offset=0):
+    """Naive O(seq²) attention — ground truth for kernel tests."""
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        q_ids = q_offset + jnp.arange(q.shape[2])[:, None]
+        k_ids = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

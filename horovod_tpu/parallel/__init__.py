@@ -1,0 +1,24 @@
+"""Parallelism strategies: data parallel (reference parity) plus the
+TPU-first long-context extensions (ring + Ulysses sequence parallelism)."""
+
+from horovod_tpu.parallel.dp import (
+    DistributedGradientTape,
+    DistributedOptimizer,
+    allreduce_gradients,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from horovod_tpu.parallel.ring import ring_attention
+from horovod_tpu.parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "DistributedOptimizer",
+    "DistributedGradientTape",
+    "allreduce_gradients",
+    "broadcast_parameters",
+    "broadcast_optimizer_state",
+    "broadcast_object",
+    "ring_attention",
+    "ulysses_attention",
+]
