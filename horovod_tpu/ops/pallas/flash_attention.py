@@ -1,11 +1,14 @@
 """Fused blockwise (flash) attention as a Pallas TPU kernel.
 
 The hot op of the transformer model family. Online-softmax attention that
-never materialises the ``(seq, seq)`` score matrix: per query block, key/value
-blocks stream through VMEM while a running (max, sum, accumulator) triple is
-maintained — the MXU does the two matmuls, the VPU the rescaling. A custom
-VJP provides the matching blockwise backward kernels (dq; dk/dv), so memory
-stays O(seq · head_dim) end to end.
+never materialises the ``(seq, seq)`` score matrix: the grid walks
+(batch, head, q-block, k-block) with the k-block axis innermost, so exactly
+one ``(block, head_dim)`` tile of each of q/k/v is resident in VMEM at a
+time while a running (max, sum, accumulator) triple lives in VMEM scratch —
+the MXU does the two matmuls, the VPU the rescaling. A custom VJP provides
+the matching blockwise backward kernels (dq; dk/dv), so both compute and
+VMEM stay O(block² + block·head_dim) per grid step end to end, independent
+of sequence length.
 
 This kernel is also the *local* building block of ring attention
 (horovod_tpu/parallel/ring.py): it accepts dynamic ``q_offset``/``k_offset``
@@ -24,22 +27,31 @@ set ``HOROVOD_PALLAS_INTERPRET=0/1`` to force either way.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from horovod_tpu.utils import env as env_mod
 
 NEG_INF = float("-inf")
 
+# Per-row scalars (lse, delta) are stored as (B, H, S, LANES) with the value
+# broadcast across lanes, satisfying the TPU (8, 128) tiling constraint.
+LANES = 128
+
+# Softmax runs in base 2 inside the kernels (exp2 is cheaper than exp on the
+# VPU): scores are pre-scaled by log2(e), the log-sum-exp converts back on
+# the way out.
+LOG2E = float(np.log2(np.e))
+
 
 def _use_interpret() -> bool:
-    env = os.environ.get("HOROVOD_PALLAS_INTERPRET")
-    if env is not None:
-        return env not in ("0", "false", "False")
-    return jax.devices()[0].platform != "tpu"
+    default = jax.devices()[0].platform != "tpu"
+    return env_mod._get_bool("HOROVOD_PALLAS_INTERPRET", default)
 
 
 def _vma(*arrays) -> frozenset:
@@ -59,90 +71,109 @@ def _pick_block(seq: int, requested: int) -> int:
     return b
 
 
+def _compiler_params(grid_len: int):
+    # All grid axes are embarrassingly parallel except the innermost, which
+    # carries the online-softmax accumulator in scratch.
+    sem = ("parallel",) * (grid_len - 1) + ("arbitrary",)
+    return pltpu.CompilerParams(dimension_semantics=sem)
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
 
 def _fwd_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, sm_scale, causal, block_q, block_k, kv_seq):
+                acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q, block_k):
     qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale  # (bq, d)
-    nk = kv_seq // block_k
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
 
     q_start = q_off_ref[0] + qi * block_q
-    q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_start = k_off_ref[0] + kj * block_k
+    last_q = q_start + block_q - 1
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    if causal:
-        # Only k blocks whose first global id can be <= the last q id.
-        last_q = q_start + block_q - 1
-        nk_dyn = jnp.clip(
-            (last_q - k_off_ref[0]) // block_k + 1, 0, nk)
-    else:
-        nk_dyn = nk
-
-    def body(j, carry):
-        m_prev, l_prev, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    def update(masked):
+        # Scores and the running max are tracked in base 2 (pre-scaled by
+        # LOG2E) so the inner loop uses exp2, which is cheaper on the VPU.
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * (sm_scale * LOG2E)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)  # (bk, d)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal:
-            k_ids = (k_off_ref[0] + j * block_k
-                     + jax.lax.broadcasted_iota(
-                         jnp.int32, (block_q, block_k), 1))
+        if masked:
+            q_ids = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         # Rows with every key masked so far have m_new == -inf; subtracting
         # -inf would give NaN, so shift by a safe 0 instead — every exp()
         # argument is then -inf and the row correctly accumulates nothing.
         m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
-        alpha = jnp.exp(m_prev - m_safe)
-        p = jnp.exp(s - m_safe[:, None])
+        alpha = jnp.exp2(m_prev - m_safe)
+        p = jnp.exp2(s - m_safe[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc
+        m_ref[...] = jax.lax.broadcast_in_dim(m_new, m_ref.shape, (0,))
+        l_ref[...] = jax.lax.broadcast_in_dim(l_new, l_ref.shape, (0,))
 
-    m, l, acc = jax.lax.fori_loop(0, nk_dyn, body, (m0, l0, acc0))
+    if causal:
+        # Skip k blocks entirely in this q block's future; mask only blocks
+        # straddling the diagonal — interior blocks skip the iota/where.
+        # Offsets are dynamic scalars, so this is predicated rather than
+        # pruned from the (static) grid.
+        interior = k_start + block_k - 1 <= q_start
+        pl.when(interior)(lambda: update(False))
+        pl.when(jnp.logical_and(k_start <= last_q,
+                                jnp.logical_not(interior)))(
+            lambda: update(True))
+    else:
+        update(False)
 
-    # Fully-masked rows (l == 0): output 0, lse -inf so a later merge
-    # treats this partial as absent.
-    empty = l == 0.0
-    l_safe = jnp.where(empty, 1.0, l)
-    m_fin = jnp.where(empty, 0.0, m)
-    o_ref[0, 0, :, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse = jnp.where(empty, NEG_INF, m_fin + jnp.log(l_safe))
-    # Row vectors are stored broadcast across LANES lanes to satisfy TPU
-    # tiling (same layout as the stock TPU flash kernel's l/m buffers).
-    lse_ref[0, 0, :, :] = jax.lax.broadcast_in_dim(
-        lse, (block_q, LANES), (0,))
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
+        # Fully-masked rows (l == 0): output 0, lse -inf so a later merge
+        # treats this partial as absent.
+        empty = l == 0.0
+        l_safe = jnp.where(empty, 1.0, l)
+        m_fin = jnp.where(empty, 0.0, m)
+        o_ref[0, 0, :, :] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse = jnp.where(empty, NEG_INF,
+                        m_fin * (1.0 / LOG2E) + jnp.log(l_safe))
+        # Row vectors are stored broadcast across LANES lanes to satisfy TPU
+        # tiling (same layout as the stock TPU flash kernel's l/m buffers).
+        lse_ref[0, 0, :, :] = jax.lax.broadcast_in_dim(
+            lse, (block_q, LANES), (0,))
 
 
-# Per-row scalars (lse, delta) are stored as (B, H, S, LANES) with the value
-# broadcast across lanes, satisfying the TPU (8, 128) tiling constraint.
-LANES = 128
-
-
-def _make_specs(block_q, block_k, dim, q_seq, kv_seq):
-    """Common BlockSpecs: q-like blocks, full-sequence k/v, row vectors."""
-    q_spec = pl.BlockSpec((1, 1, block_q, dim), lambda b, h, i: (b, h, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, kv_seq, dim), lambda b, h, i: (b, h, 0, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q, LANES),
-                            lambda b, h, i: (b, h, i, 0))
-    return q_spec, kv_spec, row_spec
+def _make_specs(block_q, block_k, dim):
+    """BlockSpecs for a (b, h, q-block, k-block) grid: q-side tiles index by
+    the q-block id, k-side tiles by the k-block id — one block of each input
+    is in VMEM per grid step regardless of sequence length."""
+    q_spec = pl.BlockSpec((1, 1, block_q, dim), lambda b, h, i, j: (b, h, i, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, dim), lambda b, h, i, j: (b, h, j, 0))
+    qrow_spec = pl.BlockSpec((1, 1, block_q, LANES),
+                             lambda b, h, i, j: (b, h, i, 0))
+    return q_spec, k_spec, qrow_spec
 
 
 # The scalar offsets ride as int32 arrays of shape (1,); gridded kernels see
 # the whole array in scalar memory, indexed as ref[0].
-from jax.experimental.pallas import tpu as pltpu  # noqa: E402
-
 _OFF_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
@@ -152,25 +183,30 @@ def _flash_fwd(q, k, v, q_offset, k_offset, *, sm_scale, causal,
     kv_seq = k.shape[2]
     block_q = _pick_block(q_seq, block_q)
     block_k = _pick_block(kv_seq, block_k)
-    grid = (batch, heads, q_seq // block_q)
-    q_spec, kv_spec, row_spec = _make_specs(block_q, block_k, dim,
-                                            q_seq, kv_seq)
+    grid = (batch, heads, q_seq // block_q, kv_seq // block_k)
+    q_spec, k_spec, qrow_spec = _make_specs(block_q, block_k, dim)
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_seq=kv_seq)
+        block_q=block_q, block_k=block_k)
 
     vma = _vma(q, k, v, q_offset, k_offset)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, kv_spec, kv_spec],
-        out_specs=[q_spec, row_spec],
+        in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, k_spec, k_spec],
+        out_specs=[q_spec, qrow_spec],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
             jax.ShapeDtypeStruct((batch, heads, q_seq, LANES), jnp.float32,
                                  vma=vma),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dim), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        compiler_params=_compiler_params(len(grid)),
         interpret=interpret,
     )(q_offset, k_offset, q, k, v)
     return o, lse  # lse lane-broadcast: (B, H, S, LANES)
@@ -182,150 +218,186 @@ def _flash_fwd(q, k, v, q_offset, k_offset, *, sm_scale, causal,
 
 
 def _bwd_dq_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
-                   lse_ref, delta_ref, dq_ref,
-                   *, sm_scale, causal, block_q, block_k, kv_seq):
+                   lse_ref, delta_ref, dq_ref, dq_acc_ref,
+                   *, sm_scale, causal, block_q, block_k):
     qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32)
-    do = do_ref[0, 0, :, :].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]
-    delta = delta_ref[0, 0, :, 0]
-    nk = kv_seq // block_k
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
 
     q_start = q_off_ref[0] + qi * block_q
-    q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    # Fully-masked rows have lse = -inf and all s = -inf; shifting by 0
-    # instead of -inf keeps exp(s - lse) at 0 rather than NaN.
-    lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+    k_start = k_off_ref[0] + kj * block_k
+    last_q = q_start + block_q - 1
 
-    if causal:
-        last_q = q_start + block_q - 1
-        nk_dyn = jnp.clip((last_q - k_off_ref[0]) // block_k + 1, 0, nk)
-    else:
-        nk_dyn = nk
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = sm_scale * jax.lax.dot_general(
+    def update(masked):
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        # Fully-masked rows have lse = -inf and all s = -inf; shifting by 0
+        # instead of -inf keeps exp(s - lse) at 0 rather than NaN.
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse) * LOG2E
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = (sm_scale * LOG2E) * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        if causal:
-            k_ids = (k_off_ref[0] + j * block_k
-                     + jax.lax.broadcasted_iota(
-                         jnp.int32, (block_q, block_k), 1))
+        if masked:
+            q_ids = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, NEG_INF)
-        p = jnp.exp(s - lse_safe[:, None])
+        p = jnp.exp2(s - lse_safe[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(
+        dq_acc_ref[...] = dq_acc_ref[...] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(
-        0, nk_dyn, body, jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32))
-    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+    if causal:
+        interior = k_start + block_k - 1 <= q_start
+        pl.when(interior)(lambda: update(False))
+        pl.when(jnp.logical_and(k_start <= last_q,
+                                jnp.logical_not(interior)))(
+            lambda: update(True))
+    else:
+        update(False)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
-                    lse_ref, delta_ref, dk_ref, dv_ref,
-                    *, sm_scale, causal, block_q, block_k, q_seq):
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc_ref,
+                    dv_acc_ref, *, sm_scale, causal, block_q, block_k):
     ki = pl.program_id(2)
-    k = k_ref[0, 0, :, :].astype(jnp.float32)
-    v = v_ref[0, 0, :, :].astype(jnp.float32)
-    nq = q_seq // block_q
+    qj = pl.program_id(3)
+    nq = pl.num_programs(3)
 
     k_start = k_off_ref[0] + ki * block_k
-    k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    q_start = q_off_ref[0] + qj * block_q
+    last_q = q_start + block_q - 1
 
-    if causal:
-        # First q block whose last global id can be >= the first k id.
-        j0 = jnp.clip((k_start - q_off_ref[0]) // block_q, 0, nq)
-    else:
-        j0 = 0
+    @pl.when(qj == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    def body(j, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q), 0]
-        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q), 0]
-        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
-        s = sm_scale * jax.lax.dot_general(
+    def update(masked):
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse) * LOG2E
+        s = (sm_scale * LOG2E) * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal:
-            q_ids = (q_off_ref[0] + j * block_q
-                     + jax.lax.broadcasted_iota(
-                         jnp.int32, (block_q, block_k), 0))
+        if masked:
+            q_ids = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, NEG_INF)
-        p = jnp.exp(s - lse_safe[:, None])
-        dv = dv + jax.lax.dot_general(
+        p = jnp.exp2(s - lse_safe[:, None])
+        dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
-        dk = dk + jax.lax.dot_general(
+        dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    dim = k_ref.shape[-1]
-    dk0 = jnp.zeros((block_k, dim), jnp.float32)
-    dv0 = jnp.zeros((block_k, dim), jnp.float32)
-    dk, dv = jax.lax.fori_loop(j0, nq, body, (dk0, dv0))
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+    if causal:
+        # q blocks entirely before this k block contribute nothing; blocks
+        # entirely past the diagonal need no mask.
+        interior = k_start + block_k - 1 <= q_start
+        pl.when(interior)(lambda: update(False))
+        pl.when(jnp.logical_and(last_q >= k_start,
+                                jnp.logical_not(interior)))(
+            lambda: update(True))
+    else:
+        update(False)
+
+    @pl.when(qj == nq - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def compute_delta(o, do) -> jax.Array:
+    """The backward's per-row correction term, lane-broadcast: delta_i =
+    sum_d do[i,d]·o[i,d], shape (B, H, S, LANES). Depends only on the final
+    output/cotangent, so callers running many partial backwards against the
+    same (o, do) — e.g. the ring sweep — compute it once and pass it in."""
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    return jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
 
 
 def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
-               block_q, block_k, interpret):
+               block_q, block_k, interpret, delta=None):
     batch, heads, q_seq, dim = q.shape
     kv_seq = k.shape[2]
     block_q = _pick_block(q_seq, block_q)
     block_k = _pick_block(kv_seq, block_k)
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
+    if delta is None:
+        delta = compute_delta(o, do)
 
-    q_spec, kv_spec, row_spec = _make_specs(block_q, block_k, dim,
-                                            q_seq, kv_seq)
+    q_spec, k_spec, qrow_spec = _make_specs(block_q, block_k, dim)
 
     vma = _vma(q, k, v, do, q_offset, k_offset)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, kv_seq=kv_seq),
-        grid=(batch, heads, q_seq // block_q),
-        in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec,
-                  row_spec, row_spec],
+            block_q=block_q, block_k=block_k),
+        grid=(batch, heads, q_seq // block_q, kv_seq // block_k),
+        in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, k_spec, k_spec, q_spec,
+                  qrow_spec, qrow_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+        scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
+        compiler_params=_compiler_params(4),
         interpret=interpret,
     )(q_offset, k_offset, q, k, v, do, lse, delta)
 
-    # dk/dv: grid over k blocks; q-side tensors stream via pl.ds.
-    k_block_spec = pl.BlockSpec((1, 1, block_k, dim),
-                                lambda b, h, i: (b, h, i, 0))
-    q_full_spec = pl.BlockSpec((1, 1, q_seq, dim), lambda b, h, i: (b, h, 0, 0))
-    row_full_spec = pl.BlockSpec((1, 1, q_seq, LANES),
-                                 lambda b, h, i: (b, h, 0, 0))
+    # dk/dv: grid over (b, h, k-block, q-block) — q-side tiles stream along
+    # the innermost axis while dk/dv accumulate in scratch.
+    kq_k_spec = pl.BlockSpec((1, 1, block_k, dim),
+                             lambda b, h, i, j: (b, h, i, 0))
+    kq_q_spec = pl.BlockSpec((1, 1, block_q, dim),
+                             lambda b, h, i, j: (b, h, j, 0))
+    kq_qrow_spec = pl.BlockSpec((1, 1, block_q, LANES),
+                                lambda b, h, i, j: (b, h, j, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, q_seq=q_seq),
-        grid=(batch, heads, kv_seq // block_k),
-        in_specs=[_OFF_SPEC, _OFF_SPEC, q_full_spec, k_block_spec,
-                  k_block_spec, q_full_spec, row_full_spec, row_full_spec],
-        out_specs=[k_block_spec, k_block_spec],
+            block_q=block_q, block_k=block_k),
+        grid=(batch, heads, kv_seq // block_k, q_seq // block_q),
+        in_specs=[_OFF_SPEC, _OFF_SPEC, kq_q_spec, kq_k_spec,
+                  kq_k_spec, kq_q_spec, kq_qrow_spec, kq_qrow_spec],
+        out_specs=[kq_k_spec, kq_k_spec],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype, vma=vma),
             jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dim), jnp.float32),
+            pltpu.VMEM((block_k, dim), jnp.float32),
+        ],
+        compiler_params=_compiler_params(4),
         interpret=interpret,
     )(q_offset, k_offset, q, k, v, do, lse, delta)
 
@@ -337,8 +409,9 @@ def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, q_offset, k_offset, sm_scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, q_offset, k_offset, sm_scale, causal, block_q, block_k,
+           bwd_block_q, bwd_block_k):
     o, _ = _flash_fwd(q, k, v, q_offset, k_offset, sm_scale=sm_scale,
                       causal=causal, block_q=block_q, block_k=block_k,
                       interpret=_use_interpret())
@@ -346,18 +419,19 @@ def _flash(q, k, v, q_offset, k_offset, sm_scale, causal, block_q, block_k):
 
 
 def _flash_vjp_fwd(q, k, v, q_offset, k_offset, sm_scale, causal,
-                   block_q, block_k):
+                   block_q, block_k, bwd_block_q, bwd_block_k):
     o, lse = _flash_fwd(q, k, v, q_offset, k_offset, sm_scale=sm_scale,
                         causal=causal, block_q=block_q, block_k=block_k,
                         interpret=_use_interpret())
     return o, (q, k, v, o, lse, q_offset, k_offset)
 
 
-def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, res, do):
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, bwd_block_q,
+                   bwd_block_k, res, do):
     q, k, v, o, lse, q_offset, k_offset = res
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset,
                             sm_scale=sm_scale, causal=causal,
-                            block_q=block_q, block_k=block_k,
+                            block_q=bwd_block_q, block_k=bwd_block_k,
                             interpret=_use_interpret())
     zero = jnp.zeros((1,), jnp.int32)
     return dq, dk, dv, zero, zero
@@ -379,8 +453,10 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     q_offset=0,
     k_offset=0,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
+    bwd_block_q: int = 1024,
+    bwd_block_k: int = 1024,
 ) -> jax.Array:
     """Fused attention over ``(batch, heads, seq, head_dim)`` inputs.
 
@@ -388,18 +464,23 @@ def flash_attention(
     query/key row — used by ring attention, where each device holds one
     sequence shard and the causal mask depends on global, not local, indices.
     They may be traced scalars (e.g. derived from ``lax.axis_index``).
+
+    Block-size defaults are tuned on v5e (head_dim 128): the forward prefers
+    tall k blocks, the backward square 1024 blocks. Sequences shorter than a
+    block fall back to the largest divisor automatically.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError("flash_attention expects (batch, heads, seq, dim)")
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
     return _flash(q, k, v, _as_offset(q_offset), _as_offset(k_offset),
-                  float(sm_scale), bool(causal), int(block_q), int(block_k))
+                  float(sm_scale), bool(causal), int(block_q), int(block_k),
+                  int(bwd_block_q), int(bwd_block_k))
 
 
 def flash_attention_partial(
     q, k, v, *, causal=False, sm_scale=None, q_offset=0, k_offset=0,
-    block_q: int = 128, block_k: int = 128,
+    block_q: int = 512, block_k: int = 1024,
 ):
     """Forward-only partial attention returning ``(out, lse)``.
 

@@ -20,16 +20,15 @@ mesh axis).
 
 Causal masking works on *global* sequence positions (each device derives its
 shard's offset from ``lax.axis_index``); kv shards that are entirely in a
-query shard's future are self-skipping — the kernel's dynamic loop bounds
-clamp their work to zero, so causal ring attention does ~half the FLOPs of
-the bidirectional case just like a single-chip causal kernel.
+query shard's future are self-skipping — the kernel predicates those grid
+steps to no-ops, so causal ring attention does ~half the FLOPs of the
+bidirectional case just like a single-chip causal kernel.
 """
 
 from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +40,7 @@ from horovod_tpu.ops.pallas.flash_attention import (
     _as_offset,
     _flash_bwd,
     _use_interpret,
+    compute_delta,
     flash_attention_partial,
     merge_partials,
 )
@@ -64,14 +64,18 @@ def _pcast(x, axis_name):
     return lax.pcast(x, axis_name, to="varying")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None,
-                   block_q=128, block_k=128):
+                   block_q=512, block_k=1024,
+                   bwd_block_q=1024, bwd_block_k=1024):
     """Exact flash attention over a sequence sharded on ``axis_name``.
 
     Must be called inside ``shard_map`` (or another context binding
     ``axis_name``); ``q``/``k``/``v`` are the local shards, shaped
     ``(batch, heads, seq_local, head_dim)``. Returns the local output shard.
+
+    ``block_q``/``block_k`` tune the forward kernel; ``bwd_block_q``/
+    ``bwd_block_k`` the backward sweep (larger square blocks win there).
     """
     o, _ = _ring_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k)
     return o
@@ -112,12 +116,14 @@ def _ring_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k):
     return o.astype(q.dtype), lse
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k):
+def _ring_vjp_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
+                  bwd_block_q, bwd_block_k):
     o, lse = _ring_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k)
     return o, (q, k, v, o, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k, res, do):
+def _ring_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k,
+                  bwd_block_q, bwd_block_k, res, do):
     q, k, v, o, lse = res
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -125,6 +131,9 @@ def _ring_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k, res, do):
     q_off = my * q.shape[2]
     perm = _axis_perm(axis_name)
     lse4 = jnp.broadcast_to(lse[..., None], lse.shape + (LANES,))
+    # delta depends only on (o, do) — loop-invariant across the ring sweep,
+    # so compute its O(B·H·S·D) reduction once, not once per ring step.
+    delta = compute_delta(o, do)
     scale = (1.0 / math.sqrt(q.shape[-1]) if sm_scale is None else sm_scale)
 
     def step(carry, s):
@@ -134,8 +143,8 @@ def _ring_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k, res, do):
             q, k_cur, v_cur, o, lse4, do,
             _as_offset(q_off), _as_offset(src * s_local),
             sm_scale=float(scale), causal=causal,
-            block_q=block_q, block_k=block_k,
-            interpret=_use_interpret())
+            block_q=bwd_block_q, block_k=bwd_block_k,
+            interpret=_use_interpret(), delta=delta)
         dq = dq + dq_p.astype(dq.dtype)
         dk_acc = dk_acc + dk_p.astype(dk_acc.dtype)
         dv_acc = dv_acc + dv_p.astype(dv_acc.dtype)
@@ -154,12 +163,3 @@ def _ring_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k, res, do):
 
 
 ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
-
-
-def ring_attention_reference(q_full, k_full, v_full, *, causal=False,
-                             sm_scale=None):
-    """Ground truth for tests: plain attention on the gathered sequence."""
-    from horovod_tpu.ops.pallas.flash_attention import attention_reference
-
-    return attention_reference(q_full, k_full, v_full, causal=causal,
-                               sm_scale=sm_scale)

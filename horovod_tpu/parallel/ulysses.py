@@ -28,7 +28,8 @@ from horovod_tpu.ops.pallas.flash_attention import flash_attention
 def ulysses_attention(q, k, v, axis_name, *, causal: bool = False,
                       sm_scale: Optional[float] = None,
                       attn_fn: Optional[Callable] = None,
-                      block_q: int = 128, block_k: int = 128):
+                      block_q: int = 512, block_k: int = 1024,
+                      bwd_block_q: int = 1024, bwd_block_k: int = 1024):
     """Attention over a sequence sharded on ``axis_name`` via all-to-all.
 
     Must run inside ``shard_map``; ``q``/``k``/``v`` are local sequence
@@ -56,7 +57,8 @@ def ulysses_attention(q, k, v, axis_name, *, causal: bool = False,
     qs, ks, vs = to_seq(q), to_seq(k), to_seq(v)
     if attn_fn is None:
         o = flash_attention(qs, ks, vs, causal=causal, sm_scale=sm_scale,
-                            block_q=block_q, block_k=block_k)
+                            block_q=block_q, block_k=block_k,
+                            bwd_block_q=bwd_block_q, bwd_block_k=bwd_block_k)
     else:
         o = attn_fn(qs, ks, vs, causal=causal, sm_scale=sm_scale)
     return to_heads(o)

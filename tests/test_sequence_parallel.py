@@ -60,7 +60,6 @@ def test_flash_grads_match_reference(causal):
 def test_flash_cross_offsets():
     """Offsets shift the causal mask to global positions."""
     q, k, v = _qkv(2)
-    half = S // 2
     # queries are the second half of a virtual 2S sequence; keys the first.
     o = flash_attention(q, k, v, causal=True, q_offset=S, k_offset=0)
     # every key is in the past -> equivalent to non-causal
