@@ -1,0 +1,192 @@
+"""Transformer model family (flax), TPU-first: BERT-style encoders and
+GPT-style causal decoders.
+
+The reference's BERT acceptance workload is a data-parallel fine-tune whose
+distinguishing traffic is large embedding-table gradients on the allgather/
+sparse path (BASELINE.md config #5; reference sparse handling:
+horovod/tensorflow/__init__.py:64-75 IndexedSlices → allgather). This module
+is a fresh TPU-native implementation, not a port of any reference model
+code (the reference ships no transformer code at all):
+
+* Attention runs through the Pallas flash kernel (ops/pallas/
+  flash_attention.py) — the (seq, seq) score matrix never hits HBM.
+* bfloat16 compute / float32 parameters; matmuls sized for the MXU
+  (head_dim 64-128, hidden multiples of 128).
+* Static shapes; per-layer ``jax.checkpoint`` (remat) optional for long
+  sequences.
+* Sequence parallelism drops in by swapping the attention function for
+  ``ring_attention``/``ulysses_attention`` (parallel/) under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops.pallas.flash_attention import flash_attention
+
+Dtype = Any
+
+
+class SelfAttention(nn.Module):
+    """Multi-head self-attention on the flash kernel.
+
+    ``attention_fn`` takes ``(q, k, v, causal=...)`` over
+    ``(batch, heads, seq, head_dim)`` and defaults to the single-device
+    Pallas kernel; sequence-parallel callers inject a ring/Ulysses closure.
+    """
+
+    num_heads: int
+    causal: bool = False
+    dtype: Dtype = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        if d_model % self.num_heads:
+            raise ValueError("d_model must divide num_heads")
+        head_dim = d_model // self.num_heads
+        dense = partial(nn.DenseGeneral, dtype=self.dtype,
+                        param_dtype=jnp.float32)
+
+        qkv_shape = (self.num_heads, head_dim)
+        q = dense(features=qkv_shape, name="query")(x)
+        k = dense(features=qkv_shape, name="key")(x)
+        v = dense(features=qkv_shape, name="value")(x)
+        # (batch, seq, heads, head_dim) -> (batch, heads, seq, head_dim)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+
+        attn = self.attention_fn or (
+            lambda q, k, v, causal: flash_attention(q, k, v, causal=causal))
+        o = attn(q, k, v, causal=self.causal)
+        o = o.transpose(0, 2, 1, 3)  # back to (batch, seq, heads, head_dim)
+        return dense(features=d_model, axis=(-2, -1), name="out")(o)
+
+
+class Mlp(nn.Module):
+    d_ff: int
+    dtype: Dtype = jnp.bfloat16
+    act: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        h = nn.Dense(self.d_ff, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="wi")(x)
+        h = self.act(h)
+        return nn.Dense(d_model, dtype=self.dtype,
+                        param_dtype=jnp.float32, name="wo")(h)
+
+
+class TransformerLayer(nn.Module):
+    """Pre-LayerNorm block: x + Attn(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    d_ff: int
+    causal: bool = False
+    dtype: Dtype = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        ln = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
+        x = x + SelfAttention(
+            num_heads=self.num_heads, causal=self.causal, dtype=self.dtype,
+            attention_fn=self.attention_fn, name="attention")(ln()(x))
+        x = x + Mlp(d_ff=self.d_ff, dtype=self.dtype, name="mlp")(ln()(x))
+        return x
+
+
+class Transformer(nn.Module):
+    """Shared trunk: embeddings → N layers → final LayerNorm → logits.
+
+    ``causal=True`` makes a GPT-style decoder; ``causal=False`` a BERT-style
+    bidirectional encoder. The output projection ties the token-embedding
+    matrix (standard for both families). Vocab logits are returned in
+    float32 for a numerically stable softmax-cross-entropy.
+    """
+
+    vocab_size: int
+    d_model: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 512
+    causal: bool = False
+    dtype: Dtype = jnp.bfloat16
+    remat: bool = False
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = True):
+        if token_ids.ndim != 2:
+            raise ValueError("expected (batch, seq) int token ids")
+        seq = token_ids.shape[1]
+        embed = nn.Embed(self.vocab_size, self.d_model,
+                         dtype=self.dtype, param_dtype=jnp.float32,
+                         embedding_init=nn.initializers.normal(0.02),
+                         name="token_embed")
+        pos_embed = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_seq, self.d_model), jnp.float32)
+
+        x = embed(token_ids) + pos_embed[None, :seq, :].astype(self.dtype)
+
+        layer = TransformerLayer
+        if self.remat:
+            layer = nn.remat(layer)
+        for i in range(self.num_layers):
+            x = layer(num_heads=self.num_heads, d_ff=self.d_ff,
+                      causal=self.causal, dtype=self.dtype,
+                      attention_fn=self.attention_fn,
+                      name=f"layer_{i}")(x)
+
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="final_norm")(x)
+        logits = embed.attend(x)  # tied output projection
+        return logits.astype(jnp.float32)
+
+
+# BERT family (bidirectional encoders; BERT-Large is BASELINE config #5's
+# shape: 24 layers, hidden 1024, 16 heads).
+BertBase = partial(Transformer, d_model=768, num_layers=12, num_heads=12,
+                   d_ff=3072, causal=False)
+BertLarge = partial(Transformer, d_model=1024, num_layers=24, num_heads=16,
+                    d_ff=4096, causal=False)
+
+# GPT family (causal decoders).
+GPT2Small = partial(Transformer, d_model=768, num_layers=12, num_heads=12,
+                    d_ff=3072, max_seq=1024, causal=True)
+GPT2Medium = partial(Transformer, d_model=1024, num_layers=24, num_heads=16,
+                     d_ff=4096, max_seq=1024, causal=True)
+
+
+def masked_lm_loss(logits, labels, mask):
+    """BERT MLM objective: mean cross-entropy over masked positions only."""
+    loss = optax_softmax(logits, labels)
+    mask = mask.astype(loss.dtype)
+    return (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def causal_lm_loss(logits, token_ids):
+    """Next-token prediction: shift-by-one cross-entropy."""
+    loss = optax_softmax(logits[:, :-1], token_ids[:, 1:])
+    return loss.mean()
+
+
+def optax_softmax(logits, labels):
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def random_tokens(rng: np.random.Generator, batch: int, seq: int,
+                  vocab_size: int) -> np.ndarray:
+    """Synthetic token batch for benchmarks (uniform vocab draw)."""
+    return rng.integers(0, vocab_size, size=(batch, seq), dtype=np.int32)

@@ -41,12 +41,16 @@ class TrainState:
 
 def create_train_state(model, optimizer, input_shape,
                        rng: Optional[jax.Array] = None,
-                       broadcast: bool = True) -> TrainState:
+                       broadcast: bool = True,
+                       input_dtype=jnp.float32) -> TrainState:
     """Initialize model + optimizer state and broadcast from rank 0
-    (the reference's init convention, reference: examples/*.py)."""
+    (the reference's init convention, reference: examples/*.py).
+
+    ``input_dtype=jnp.int32`` initializes token models (transformers)."""
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, jnp.zeros(input_shape), train=False)
+    variables = model.init(rng, jnp.zeros(input_shape, input_dtype),
+                           train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     if broadcast:
@@ -83,7 +87,7 @@ def make_train_step(model, optimizer,
             outputs, updates = model.apply(
                 {"params": params, "batch_stats": batch_stats},
                 images, train=True, mutable=["batch_stats"])
-            return loss_fn(outputs, labels), updates["batch_stats"]
+            return loss_fn(outputs, labels), updates.get("batch_stats", {})
 
         (loss, new_stats), grads = jax.value_and_grad(
             compute, has_aux=True)(params)
