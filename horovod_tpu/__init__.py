@@ -73,6 +73,11 @@ from horovod_tpu.parallel.dp import (
     broadcast_optimizer_state,
     broadcast_object,
 )
+from horovod_tpu.parallel.sparse import (
+    SparseGrad,
+    sparse_allgather,
+    with_sparse_embedding_grad,
+)
 from horovod_tpu.parallel.ring import ring_attention
 from horovod_tpu.parallel.ulysses import ulysses_attention
 from horovod_tpu.ops.pallas import flash_attention
@@ -97,6 +102,8 @@ __all__ = [
     "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "Compression",
+    # sparse/embedding gradients
+    "SparseGrad", "sparse_allgather", "with_sparse_embedding_grad",
     # long-context / sequence parallelism (TPU-first extensions)
     "flash_attention", "ring_attention", "ulysses_attention",
 ]

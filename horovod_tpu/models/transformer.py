@@ -27,6 +27,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from horovod_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -50,7 +51,9 @@ class SelfAttention(nn.Module):
     def __call__(self, x):
         d_model = x.shape[-1]
         if d_model % self.num_heads:
-            raise ValueError("d_model must divide num_heads")
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must divide d_model "
+                f"({d_model})")
         head_dim = d_model // self.num_heads
         dense = partial(nn.DenseGeneral, dtype=self.dtype,
                         param_dtype=jnp.float32)
@@ -128,6 +131,9 @@ class Transformer(nn.Module):
         if token_ids.ndim != 2:
             raise ValueError("expected (batch, seq) int token ids")
         seq = token_ids.shape[1]
+        if seq > self.max_seq:
+            raise ValueError(
+                f"sequence length {seq} exceeds max_seq={self.max_seq}")
         embed = nn.Embed(self.vocab_size, self.d_model,
                          dtype=self.dtype, param_dtype=jnp.float32,
                          embedding_init=nn.initializers.normal(0.02),
@@ -169,21 +175,16 @@ GPT2Medium = partial(Transformer, d_model=1024, num_layers=24, num_heads=16,
 
 def masked_lm_loss(logits, labels, mask):
     """BERT MLM objective: mean cross-entropy over masked positions only."""
-    loss = optax_softmax(logits, labels)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
     mask = mask.astype(loss.dtype)
     return (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
 def causal_lm_loss(logits, token_ids):
     """Next-token prediction: shift-by-one cross-entropy."""
-    loss = optax_softmax(logits[:, :-1], token_ids[:, 1:])
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], token_ids[:, 1:])
     return loss.mean()
-
-
-def optax_softmax(logits, labels):
-    import optax
-
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
 def random_tokens(rng: np.random.Generator, batch: int, seq: int,

@@ -33,6 +33,7 @@ from jax import lax
 from horovod_tpu.compression import Compression
 from horovod_tpu.core import basics, mesh as mesh_mod
 from horovod_tpu.ops import collectives
+from horovod_tpu.parallel import sparse as sparse_mod
 
 
 def _bound_axes(axis_name=None) -> tuple:
@@ -51,9 +52,21 @@ def _bound_axes(axis_name=None) -> tuple:
     return tuple(bound)
 
 
-def _allreduce_leaf(g, average, compression, axis_name):
+def _allreduce_leaf(g, average, compression, axis_name,
+                    sparse_as_dense=False):
     if g is None:
         return None
+    if sparse_mod.is_sparse(g):
+        # Sparse/embedding gradient (reference:
+        # horovod/tensorflow/__init__.py:64-75): exchanged via allgather of
+        # (indices, values) unless sparse_as_dense densifies first
+        # (reference: tensorflow/__init__.py:200-203).
+        if sparse_as_dense:
+            g = sparse_mod.densify_leaf(g)
+        else:
+            return sparse_mod.exchange_sparse_grad(
+                g, average=average, compression=compression,
+                axis_name=axis_name, bound_axes=_bound_axes(axis_name))
     if isinstance(g, jax.core.Tracer):
         axes = _bound_axes(axis_name)
         if not axes:
@@ -69,14 +82,19 @@ def _allreduce_leaf(g, average, compression, axis_name):
 
 
 def allreduce_gradients(grads, *, average: bool = True,
-                        compression=Compression.none, axis_name=None):
+                        compression=Compression.none, axis_name=None,
+                        sparse_as_dense: bool = False):
     """Average a pytree of gradients across all workers.
 
     Functional analogue of ``DistributedGradientTape.gradient`` post-
     processing (reference: horovod/tensorflow/__init__.py:323-376).
+    ``SparseGrad`` leaves ride the allgather path (or are densified first
+    when ``sparse_as_dense``); either way the result is dense.
     """
     return jax.tree_util.tree_map(
-        lambda g: _allreduce_leaf(g, average, compression, axis_name), grads
+        lambda g: _allreduce_leaf(g, average, compression, axis_name,
+                                  sparse_as_dense),
+        grads, is_leaf=sparse_mod.is_sparse,
     )
 
 
@@ -87,6 +105,7 @@ def DistributedOptimizer(
     average: bool = True,
     backward_passes_per_step: int = 1,
     axis_name=None,
+    sparse_as_dense: bool = False,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so gradients are allreduced across workers
     before each update.
@@ -98,7 +117,10 @@ def DistributedOptimizer(
 
     ``compression`` casts gradients to a 16-bit wire type for the
     collective; ``backward_passes_per_step`` accumulates N micro-batches
-    between allreduces (reference: torch/__init__.py:82-143).
+    between allreduces (reference: torch/__init__.py:82-143);
+    ``sparse_as_dense`` densifies ``SparseGrad`` leaves before the
+    exchange instead of allgathering them (reference:
+    tensorflow/__init__.py:200-203).
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
@@ -109,7 +131,7 @@ def DistributedOptimizer(
     def update_fn(grads, opt_state, params=None, **extra):
         reduced = allreduce_gradients(
             grads, average=average, compression=compression,
-            axis_name=axis_name,
+            axis_name=axis_name, sparse_as_dense=sparse_as_dense,
         )
         return optimizer.update(reduced, opt_state, params, **extra)
 
@@ -127,6 +149,7 @@ def DistributedGradientTape(
     average: bool = True,
     axis_name=None,
     returns: str = "grads",
+    sparse_as_dense: bool = False,
 ) -> Callable[..., Any]:
     """Wrap a gradient-producing function so its gradients are allreduced.
 
@@ -151,7 +174,7 @@ def DistributedGradientTape(
     def reduce(grads):
         return allreduce_gradients(
             grads, average=average, compression=compression,
-            axis_name=axis_name)
+            axis_name=axis_name, sparse_as_dense=sparse_as_dense)
 
     def wrapped(*args, **kwargs):
         out = grad_fn(*args, **kwargs)
