@@ -81,6 +81,7 @@ from horovod_tpu.parallel.sparse import (
 from horovod_tpu.parallel.ring import ring_attention
 from horovod_tpu.parallel.ulysses import ulysses_attention
 from horovod_tpu.ops.pallas import flash_attention
+from horovod_tpu import checkpoint
 
 __all__ = [
     "__version__",
@@ -106,4 +107,6 @@ __all__ = [
     "SparseGrad", "sparse_allgather", "with_sparse_embedding_grad",
     # long-context / sequence parallelism (TPU-first extensions)
     "flash_attention", "ring_attention", "ulysses_attention",
+    # checkpoint / resume (rank-0 save + broadcast restore)
+    "checkpoint",
 ]

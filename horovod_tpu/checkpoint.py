@@ -1,0 +1,129 @@
+"""Checkpoint / resume: rank-0 save, broadcast restore.
+
+The reference delegates checkpointing to the frameworks but fixes the
+*convention* (reference: SURVEY.md §5.4, examples/pytorch_imagenet_resnet50.py,
+examples/keras_imagenet_resnet50.py): only rank 0 writes; on resume every
+worker loads and rank 0's values are made authoritative via broadcast
+(``broadcast_parameters`` / ``broadcast_optimizer_state``; resume epoch via a
+0-d broadcast). This module packages that convention for JAX pytrees:
+
+    state = train(...)
+    hvd.checkpoint.save(ckpt_dir, state, step=epoch)       # rank 0 writes
+    ...
+    state, step = hvd.checkpoint.restore_latest(ckpt_dir, target=state)
+
+Serialization is flax msgpack (host-resident, framework-native); files are
+written atomically (tmp + rename) so a killed worker never leaves a torn
+checkpoint — the failure-handling analogue of the reference's launcher
+killing whole jobs on any rank failure (reference: gloo_run.py:256-262).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, Optional, Tuple
+
+import jax
+from flax import serialization
+
+from horovod_tpu.core import basics
+from horovod_tpu.parallel import dp
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+
+
+def _ckpt_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{step}.msgpack")
+
+
+def save(directory: str, state: Any, step: int = 0,
+         keep: Optional[int] = None) -> Optional[str]:
+    """Write ``state`` (any pytree of arrays/scalars) as step ``step``.
+
+    Only rank 0 writes (the reference convention); other ranks return
+    ``None`` immediately. ``keep`` retains only the newest N checkpoints.
+    """
+    st = basics._ensure_init()
+    if st.rank != 0:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    state = jax.device_get(state)
+    data = serialization.to_bytes(state)
+    path = _ckpt_path(directory, step)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic publish
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    if keep is not None:
+        for old_step in all_steps(directory)[:-keep]:
+            os.unlink(_ckpt_path(directory, old_step))
+    return path
+
+
+def all_steps(directory: str) -> list:
+    """Sorted step numbers present in ``directory``."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = _CKPT_RE.match(name)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def restore(path: str, target: Any, broadcast: bool = True) -> Any:
+    """Load a checkpoint file into the structure of ``target``.
+
+    With ``broadcast`` (default), rank 0's loaded values are broadcast so
+    every worker resumes bit-identical state even if their filesystems
+    disagree — the reference's restore-everywhere-via-broadcast convention
+    (reference: torch/__init__.py:255-403). A non-0 rank whose local
+    filesystem lacks the file still participates: it feeds ``target``
+    into the broadcast and receives rank 0's values.
+    """
+    st = basics._ensure_init()
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            state = serialization.from_bytes(target, f.read())
+    elif broadcast and st.rank != 0:
+        state = target  # overwritten by rank 0's broadcast below
+    else:
+        raise FileNotFoundError(path)
+    if broadcast:
+        state = dp.broadcast_parameters(state, root_rank=0)
+    return state
+
+
+def restore_latest(directory: str, target: Any,
+                   broadcast: bool = True) -> Tuple[Any, Optional[int]]:
+    """Restore the newest checkpoint; returns ``(state, step)`` or
+    ``(target, None)`` when no checkpoint exists (fresh start — mirrors
+    the examples' ``resume_from_epoch = 0`` default).
+
+    The resume decision is rank 0's (only rank 0 writes, so on non-shared
+    filesystems only rank 0 can see the files): its latest step is
+    broadcast first, and every rank then takes the same branch — so the
+    broadcast collectives inside :func:`restore` stay aligned across the
+    job (reference: examples/pytorch_imagenet_resnet50.py
+    resume_from_epoch broadcast).
+    """
+    local_step = latest_step(directory)
+    step = dp.broadcast_object(local_step, root_rank=0,
+                               name="ckpt_resume_step")
+    if step is None:
+        return target, None
+    state = restore(_ckpt_path(directory, step), target, broadcast=broadcast)
+    return state, step

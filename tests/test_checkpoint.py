@@ -1,0 +1,83 @@
+"""Checkpoint/resume: rank-0 save + broadcast restore round trips."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu import checkpoint, training
+from horovod_tpu.models.mnist import MnistConvNet
+
+
+class TestCheckpoint:
+    def _state(self, hvd):
+        model = MnistConvNet()
+        opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+        return model, opt, training.create_train_state(
+            model, opt, (1, 28, 28, 1))
+
+    def test_save_restore_roundtrip(self, hvd, tmp_path):
+        _, _, state = self._state(hvd)
+        d = str(tmp_path / "ckpts")
+        path = checkpoint.save(d, {"params": state.params}, step=3)
+        assert path and os.path.exists(path)
+
+        # restore into the true structure
+        target = {"params": state.params}
+        restored = checkpoint.restore(path, target)
+        flat_a = np.concatenate([np.asarray(x).ravel() for x in
+                                 _leaves(restored)])
+        flat_b = np.concatenate([np.asarray(x).ravel() for x in
+                                 _leaves(target)])
+        np.testing.assert_allclose(flat_a, flat_b)
+
+    def test_restore_latest_and_keep(self, hvd, tmp_path):
+        d = str(tmp_path / "ckpts")
+        tree = {"w": jnp.arange(4.0)}
+        for s in (1, 5, 9):
+            checkpoint.save(d, {"w": tree["w"] * s}, step=s, keep=2)
+        assert checkpoint.all_steps(d) == [5, 9]
+
+        restored, step = checkpoint.restore_latest(d, tree)
+        assert step == 9
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.arange(4.0) * 9)
+
+    def test_restore_latest_empty_dir(self, hvd, tmp_path):
+        tree = {"w": jnp.arange(4.0)}
+        restored, step = checkpoint.restore_latest(
+            str(tmp_path / "nope"), tree)
+        assert step is None
+        assert restored is tree
+
+    def test_full_train_resume(self, hvd, tmp_path):
+        """Train, checkpoint, perturb, resume — resumed state matches."""
+        import jax
+
+        model, opt, state = self._state(hvd)
+        step_fn, sh = training.make_train_step(model, opt, donate=False)
+        rng = np.random.RandomState(0)
+        images = jax.device_put(rng.rand(16, 28, 28, 1).astype(np.float32), sh)
+        labels = jax.device_put(rng.randint(0, 10, (16,)).astype(np.int32), sh)
+
+        loss, params, stats, opt_state = step_fn(
+            state.params, state.batch_stats, state.opt_state, images, labels)
+        d = str(tmp_path / "ckpts")
+        tree = {"params": params, "batch_stats": stats,
+                "opt_state": opt_state}
+        checkpoint.save(d, tree, step=1)
+
+        restored, step = checkpoint.restore_latest(d, tree)
+        assert step == 1
+        # one more step from the restored state reproduces the original
+        l1, p1, _, _ = step_fn(restored["params"], restored["batch_stats"],
+                               restored["opt_state"], images, labels)
+        l2, p2, _, _ = step_fn(params, stats, opt_state, images, labels)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
