@@ -1,0 +1,148 @@
+"""Spark integration tests — service-level, no cluster (reference:
+test/test_spark.py runs local-mode happy path + failure modes with stubs;
+here the driver/task protocol is exercised over real TCP without pyspark).
+"""
+
+import os
+import threading
+
+import pytest
+
+from horovod_tpu.run import util
+
+
+@pytest.fixture(autouse=True)
+def _isolate_environ():
+    """The task mapper sets the worker env contract (HOROVOD_RANK/...)
+    in os.environ — correct inside a Spark executor, but it must not leak
+    into later tests in this process."""
+    saved = dict(os.environ)
+    yield
+    os.environ.clear()
+    os.environ.update(saved)
+from horovod_tpu.run.service import ServiceClient
+from horovod_tpu.spark import (
+    RegisterSparkTaskRequest,
+    SparkDriverService,
+    SparkResultRequest,
+    SparkTaskInfoRequest,
+    _make_mapper,
+    run,
+)
+
+
+class TestSparkDriverService:
+    def test_protocol_and_allocation(self):
+        key = util.make_secret_key()
+        driver = SparkDriverService(key, num_proc=4)
+        try:
+            addr = ("127.0.0.1", driver.port)
+
+            # four tasks on two "hosts" register out of order
+            hashes = ["hostB", "hostA", "hostB", "hostA"]
+            for index in (2, 0, 3, 1):
+                c = ServiceClient(addr, key)
+                c.call(RegisterSparkTaskRequest(index, hashes[index],
+                                                "127.0.0.1"))
+            assert driver.all_registered.wait(5)
+
+            # no env before allocation
+            c = ServiceClient(addr, key)
+            assert c.call(SparkTaskInfoRequest(0)).env is None
+
+            index_to_rank = driver.allocate({"EXTRA": "1"})
+            assert sorted(index_to_rank) == [0, 1, 2, 3]
+            assert sorted(index_to_rank.values()) == [0, 1, 2, 3]
+
+            # first-registered host hash hosts rank 0... host order is by
+            # lowest task index: index 0 is hostB -> hostB gets ranks 0,1
+            env0 = c.call(SparkTaskInfoRequest(0)).env
+            assert env0["HOROVOD_RANK"] == str(index_to_rank[0])
+            assert env0["HOROVOD_SIZE"] == "4"
+            assert env0["HOROVOD_LOCAL_SIZE"] == "2"
+            assert env0["EXTRA"] == "1"
+            assert env0["HOROVOD_CONTROLLER"] == "socket"
+            # ranks on the same host hash are contiguous
+            ranks_b = sorted(index_to_rank[i] for i in (0, 2))
+            ranks_a = sorted(index_to_rank[i] for i in (1, 3))
+            assert ranks_b == [0, 1] and ranks_a == [2, 3]
+
+            # results flow
+            for index in range(4):
+                c.call(SparkResultRequest(index, True,
+                                          util.dumps_base64(index * 10)))
+            assert driver.all_results.wait(5)
+            results = driver.results()
+            assert util.loads_base64(results[2][1]) == 20
+        finally:
+            driver.shutdown()
+
+    def test_mapper_end_to_end(self):
+        """The task-side mapper against a live driver service."""
+        key = util.make_secret_key()
+        driver = SparkDriverService(key, num_proc=2)
+        try:
+            addr = ("127.0.0.1", driver.port)
+
+            def fn(x):
+                import os
+
+                return (os.environ["HOROVOD_RANK"], x)
+
+            mapper = _make_mapper([addr], key, fn, (7,), None,
+                                  start_timeout=20.0)
+
+            def task(index):
+                list(mapper(index, iter(())))
+
+            threads = [threading.Thread(target=task, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            assert driver.all_registered.wait(10)
+            index_to_rank = driver.allocate({})
+            assert driver.all_results.wait(10)
+            for t in threads:
+                t.join(5)
+
+            results = driver.results()
+            for index, (ok, payload) in results.items():
+                assert ok
+                rank_str, x = util.loads_base64(payload)
+                assert int(rank_str) == index_to_rank[index]
+                assert x == 7
+        finally:
+            driver.shutdown()
+
+    def test_mapper_reports_failure(self):
+        key = util.make_secret_key()
+        driver = SparkDriverService(key, num_proc=1)
+        try:
+            addr = ("127.0.0.1", driver.port)
+
+            def fn():
+                raise ValueError("boom")
+
+            mapper = _make_mapper([addr], key, fn, (), None,
+                                  start_timeout=20.0)
+
+            def task():
+                with pytest.raises(ValueError):
+                    list(mapper(0, iter(())))
+
+            t = threading.Thread(target=task)
+            t.start()
+            assert driver.all_registered.wait(10)
+            driver.allocate({})
+            assert driver.all_results.wait(10)
+            t.join(5)
+            ok, payload = driver.results()[0]
+            assert not ok and "boom" in payload
+        finally:
+            driver.shutdown()
+
+
+class TestSparkRun:
+    def test_requires_pyspark(self):
+        with pytest.raises(RuntimeError, match="pyspark"):
+            run(lambda: None, num_proc=1)
