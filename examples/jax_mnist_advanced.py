@@ -1,0 +1,80 @@
+"""MNIST with the callback suite: broadcast, metric averaging, LR warmup.
+
+Analogue of the reference's advanced Keras example (reference:
+examples/keras_mnist_advanced.py): BroadcastGlobalVariablesCallback,
+MetricAverageCallback and LearningRateWarmupCallback orchestrated around an
+explicit training loop.
+"""
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import callbacks, training
+from horovod_tpu.models.mnist import MnistConvNet
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--base-lr", type=float, default=0.001)
+    parser.add_argument("--warmup-epochs", type=float, default=1.0)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(1234)
+    images = rng.rand(1024, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, (1024,)).astype(np.int32)
+    global_batch = args.batch_size * hvd.size()
+    steps_per_epoch = len(images) // global_batch
+
+    # in-jit LR schedule version of LearningRateWarmupCallback
+    schedule = callbacks.warmup_scaled_schedule(
+        base_lr=args.base_lr, warmup_epochs=args.warmup_epochs,
+        steps_per_epoch=steps_per_epoch)
+    opt = hvd.DistributedOptimizer(optax.adam(schedule))
+
+    model = MnistConvNet()
+    state = training.create_train_state(model, opt, (1, 28, 28, 1))
+    step, sharding = training.make_train_step(model, opt)
+
+    cbs = [
+        callbacks.BroadcastGlobalVariablesCallback(root_rank=0),
+        callbacks.MetricAverageCallback(),
+    ]
+    train_state = {"params": state.params, "batch_stats": state.batch_stats,
+                   "opt_state": state.opt_state}
+    for cb in cbs:
+        train_state = cb.on_train_begin(train_state)
+    params, stats, opt_state = (train_state["params"],
+                                train_state["batch_stats"],
+                                train_state["opt_state"])
+
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(len(images))
+        losses = []
+        for cb in cbs:
+            train_state = cb.on_epoch_begin(epoch, train_state)
+        for i in range(steps_per_epoch):
+            idx = perm[i * global_batch:(i + 1) * global_batch]
+            xb = jax.device_put(images[idx], sharding)
+            yb = jax.device_put(labels[idx], sharding)
+            loss, params, stats, opt_state = step(params, stats, opt_state,
+                                                  xb, yb)
+            losses.append(float(loss))
+        metrics = {"loss": float(np.mean(losses))}
+        for cb in cbs:
+            train_state, metrics = cb.on_epoch_end(epoch, train_state,
+                                                   metrics)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(metrics['loss']):.4f} "
+                  f"(lr {float(schedule(epoch * steps_per_epoch)):.5f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Synthetic ResNet benchmark — the measurement harness.
+
+TPU-native analogue of the reference's synthetic benchmarks (reference:
+examples/pytorch_synthetic_benchmark.py:37-110,
+examples/tensorflow2_synthetic_benchmark.py:72-132): ResNet fwd+bwd+update
+on synthetic ImageNet-shaped data, 10 warmup batches, then num-iters rounds
+of num-batches-per-iter batches; reports images/sec and images/sec/chip.
+
+    python examples/jax_synthetic_benchmark.py --model ResNet50 --batch-size 128
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import models, training
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="ResNet50",
+                        choices=["ResNet18", "ResNet34", "ResNet50",
+                                 "ResNet101", "ResNet152"])
+    parser.add_argument("--batch-size", type=int, default=128,
+                        help="per-chip batch size")
+    parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--fp16-allreduce", action="store_true",
+                        help="bf16 wire compression for gradient exchange")
+    args = parser.parse_args()
+
+    hvd.init()
+    model = getattr(models, args.model)(num_classes=1000,
+                                        dtype=jnp.bfloat16)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01 * hvd.size(), momentum=0.9), compression=compression)
+
+    state = training.create_train_state(model, opt, (1, 224, 224, 3))
+    step, batch_sharding = training.make_train_step(model, opt)
+
+    global_batch = args.batch_size * hvd.size()
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        rng.rand(global_batch, 224, 224, 3).astype(np.float32),
+        batch_sharding)
+    labels = jax.device_put(
+        rng.randint(0, 1000, (global_batch,)).astype(np.int32),
+        batch_sharding)
+
+    params, stats, opt_state = (state.params, state.batch_stats,
+                                state.opt_state)
+
+    def run_batch():
+        nonlocal params, stats, opt_state
+        loss, params, stats, opt_state = step(params, stats, opt_state,
+                                              images, labels)
+        return loss
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch size {args.batch_size}/chip, "
+              f"{hvd.size()} chips")
+    for _ in range(args.num_warmup_batches):
+        run_batch()
+    jax.block_until_ready(params)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.time()
+        for _ in range(args.num_batches_per_iter):
+            run_batch()
+        jax.block_until_ready(params)
+        dt = time.time() - t0
+        rate = global_batch * args.num_batches_per_iter / dt
+        img_secs.append(rate)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.1f} img/sec total")
+
+    if hvd.rank() == 0:
+        mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec total: {mean:.1f} +- {conf:.1f}")
+        print(f"Img/sec per chip: {mean / hvd.size():.1f}")
+
+
+if __name__ == "__main__":
+    main()

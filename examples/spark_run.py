@@ -1,0 +1,57 @@
+"""Distributed training on Spark executors.
+
+Analogue of the reference's Spark usage (reference:
+horovod/spark/__init__.py:100, examples/keras_spark_rossmann.py): a
+training function handed to ``horovod_tpu.spark.run`` executes once per
+rank inside the Spark executors, with the framework environment set up by
+the driver. Requires a running SparkSession (pyspark).
+
+    spark-submit examples/spark_run.py
+"""
+
+
+def train(epochs: int = 1):
+    import jax
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import training
+    from horovod_tpu.models.mnist import MnistConvNet
+
+    hvd.init()
+    opt = hvd.DistributedOptimizer(optax.adam(0.001 * hvd.size()))
+    model = MnistConvNet()
+    state = training.create_train_state(model, opt, (1, 28, 28, 1))
+    step, sharding = training.make_train_step(model, opt)
+
+    rng = np.random.RandomState(hvd.rank())
+    params, stats, opt_state = (state.params, state.batch_stats,
+                                state.opt_state)
+    loss = None
+    for _ in range(epochs * 4):
+        xb = jax.device_put(rng.rand(32, 28, 28, 1).astype(np.float32),
+                            sharding)
+        yb = jax.device_put(rng.randint(0, 10, (32,)).astype(np.int32),
+                            sharding)
+        loss, params, stats, opt_state = step(params, stats, opt_state,
+                                              xb, yb)
+    return float(loss)
+
+
+def main():
+    from pyspark.sql import SparkSession
+
+    import horovod_tpu.spark as hvd_spark
+
+    spark = (SparkSession.builder.master("local[2]")
+             .appName("horovod_tpu-spark-example").getOrCreate())
+    try:
+        losses = hvd_spark.run(train, args=(1,), num_proc=2)
+        print("per-rank final losses:", losses)
+    finally:
+        spark.stop()
+
+
+if __name__ == "__main__":
+    main()

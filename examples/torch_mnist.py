@@ -1,0 +1,77 @@
+"""PyTorch MNIST through the torch binding.
+
+Direct analogue of the reference's example (reference:
+examples/pytorch_mnist.py): the training script is ordinary PyTorch; the
+framework provides init, LR scaling, the hook-driven DistributedOptimizer,
+and the rank-0 broadcast convention — gradients ride the XLA data plane.
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = Net()
+    # scale LR by world size; wrap with the hook-driven optimizer
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size(), momentum=0.5)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    # broadcast initial parameters + optimizer state from rank 0
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    rng = np.random.RandomState(1234)
+    images = torch.tensor(rng.rand(1024, 1, 28, 28), dtype=torch.float32)
+    labels = torch.tensor(rng.randint(0, 10, (1024,)), dtype=torch.long)
+
+    for epoch in range(args.epochs):
+        model.train()
+        perm = torch.randperm(len(images))
+        losses = []
+        for i in range(0, len(images), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            optimizer.zero_grad()
+            output = model(images[idx])
+            loss = F.nll_loss(output, labels[idx])
+            loss.backward()
+            optimizer.step()
+            losses.append(loss.item())
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
+
+
+if __name__ == "__main__":
+    main()

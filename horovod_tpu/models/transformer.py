@@ -127,7 +127,13 @@ class Transformer(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, token_ids, train: bool = True):
+    def __call__(self, token_ids, train: bool = True, pos_offset=0):
+        """``pos_offset`` is the global position of the first token — under
+        sequence parallelism each device passes its shard's offset (e.g.
+        ``lax.axis_index(axis) * seq_local``) so position embeddings stay
+        global; it may be a traced scalar. ``max_seq`` must cover the
+        GLOBAL sequence (``pos_offset + seq``); with a traced offset this
+        cannot be checked at trace time, so size ``max_seq`` accordingly."""
         if token_ids.ndim != 2:
             raise ValueError("expected (batch, seq) int token ids")
         seq = token_ids.shape[1]
@@ -142,7 +148,21 @@ class Transformer(nn.Module):
             "pos_embed", nn.initializers.normal(0.02),
             (self.max_seq, self.d_model), jnp.float32)
 
-        x = embed(token_ids) + pos_embed[None, :seq, :].astype(self.dtype)
+        if isinstance(pos_offset, int):
+            # static offset: check bounds eagerly — dynamic_slice would
+            # silently clamp and reuse wrong position embeddings.
+            if pos_offset + seq > self.max_seq:
+                raise ValueError(
+                    f"pos_offset {pos_offset} + seq {seq} exceeds "
+                    f"max_seq={self.max_seq}; under sequence parallelism "
+                    f"max_seq must cover the GLOBAL sequence length")
+            pos = jax.lax.dynamic_slice_in_dim(pos_embed, pos_offset, seq,
+                                               axis=0) if pos_offset else \
+                pos_embed[:seq, :]
+        else:
+            pos = jax.lax.dynamic_slice_in_dim(
+                pos_embed, jnp.asarray(pos_offset, jnp.int32), seq, axis=0)
+        x = embed(token_ids) + pos[None, :, :].astype(self.dtype)
 
         layer = TransformerLayer
         if self.remat:
