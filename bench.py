@@ -35,9 +35,9 @@ REFERENCE_IMAGES_PER_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:32-43
 
 BATCH_PER_CHIP = int(os.environ.get("BENCH_BATCH", "128"))
 IMAGE_SIZE = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
-WARMUP_ITERS = int(os.environ.get("BENCH_WARMUP", "10"))
+WARMUP_ITERS = int(os.environ.get("BENCH_WARMUP", "20"))
 TIMED_ROUNDS = int(os.environ.get("BENCH_ROUNDS", "10"))
-BATCHES_PER_ROUND = int(os.environ.get("BENCH_BATCHES_PER_ROUND", "10"))
+BATCHES_PER_ROUND = int(os.environ.get("BENCH_BATCHES_PER_ROUND", "20"))
 
 
 def log(msg):
@@ -56,7 +56,10 @@ def main():
 
     state = training.create_train_state(
         model, optimizer, (1, IMAGE_SIZE, IMAGE_SIZE, 3))
-    step, batch_sharding = training.make_train_step(model, optimizer)
+    # One compiled program per round (lax.scan over the batches) so host
+    # dispatch latency stays out of the steady-state measurement.
+    round_fn, batch_sharding = training.make_train_round(
+        model, optimizer, steps=BATCHES_PER_ROUND)
 
     rng = np.random.RandomState(0)
     images = jax.device_put(
@@ -70,9 +73,10 @@ def main():
 
     log("compiling + warmup...")
     t0 = time.perf_counter()
-    for _ in range(WARMUP_ITERS):
-        loss, params, stats, opt_state = step(params, stats, opt_state,
-                                              images, labels)
+    warmup_rounds = max(1, -(-WARMUP_ITERS // BATCHES_PER_ROUND))
+    for _ in range(warmup_rounds):
+        loss, params, stats, opt_state = round_fn(params, stats, opt_state,
+                                                  images, labels)
     jax.block_until_ready(loss)
     log(f"warmup done in {time.perf_counter() - t0:.1f}s "
         f"(loss={float(loss):.3f})")
@@ -80,8 +84,7 @@ def main():
     rates = []
     for r in range(TIMED_ROUNDS):
         t0 = time.perf_counter()
-        for _ in range(BATCHES_PER_ROUND):
-            loss, params, stats, opt_state = step(params, stats, opt_state,
+        loss, params, stats, opt_state = round_fn(params, stats, opt_state,
                                                   images, labels)
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0

@@ -61,6 +61,39 @@ def create_train_state(model, optimizer, input_shape,
                       opt_state=opt_state)
 
 
+def _default_loss_fn(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def _make_one_step(model, optimizer, loss_fn):
+    """Shared un-jitted train-step body: fwd + grad + optimizer update,
+    tolerating models with or without batch statistics."""
+
+    def one_step(params, batch_stats, opt_state, images, labels):
+        def compute(params):
+            outputs, updates = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            return loss_fn(outputs, labels), updates.get("batch_stats", {})
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            compute, has_aux=True)(params)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), new_stats, \
+            new_opt_state
+
+    return one_step
+
+
+def _shardings():
+    st = basics._ensure_init()
+    mesh = st.mesh
+    batch_sharding = NamedSharding(mesh, P(mesh_mod.GLOBAL_AXES))
+    repl = NamedSharding(mesh, P())
+    return batch_sharding, repl
+
+
 def make_train_step(model, optimizer,
                     loss_fn: Optional[Callable] = None,
                     donate: bool = True):
@@ -72,31 +105,46 @@ def make_train_step(model, optimizer,
     global mesh with inputs batch-sharded; gradient averaging across
     workers falls out of the shardings (see ``parallel/dp.py``).
     """
-    st = basics._ensure_init()
-    mesh = st.mesh
-    batch_sharding = NamedSharding(mesh, P(mesh_mod.GLOBAL_AXES))
-    repl = NamedSharding(mesh, P())
+    batch_sharding, repl = _shardings()
+    one_step = _make_one_step(model, optimizer, loss_fn or _default_loss_fn)
+    return jax.jit(
+        one_step,
+        in_shardings=(repl, repl, repl, batch_sharding, batch_sharding),
+        out_shardings=(repl, repl, repl, repl),
+        donate_argnums=(0, 1, 2) if donate else (),
+    ), batch_sharding
 
-    if loss_fn is None:
-        def loss_fn(logits, labels):
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, labels).mean()
 
-    def step(params, batch_stats, opt_state, images, labels):
-        def compute(params):
-            outputs, updates = model.apply(
-                {"params": params, "batch_stats": batch_stats},
-                images, train=True, mutable=["batch_stats"])
-            return loss_fn(outputs, labels), updates.get("batch_stats", {})
+def make_train_round(model, optimizer,
+                     loss_fn: Optional[Callable] = None,
+                     steps: int = 1,
+                     donate: bool = True):
+    """Like :func:`make_train_step`, but one compiled program runs
+    ``steps`` consecutive train steps via ``lax.scan`` (same batch each
+    step — benchmark workloads), returning the last loss.
 
-        (loss, new_stats), grads = jax.value_and_grad(
-            compute, has_aux=True)(params)
-        updates, new_opt_state = optimizer.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return loss, new_params, new_stats, new_opt_state
+    One dispatch per round keeps host→device launch latency out of
+    steady-state measurements — the same reason the reference times
+    multi-batch rounds (reference:
+    examples/pytorch_synthetic_benchmark.py:92-100), taken to its XLA
+    conclusion: the whole round is a single device program.
+    """
+    batch_sharding, repl = _shardings()
+    one_step = _make_one_step(model, optimizer, loss_fn or _default_loss_fn)
+
+    def round_fn(params, batch_stats, opt_state, images, labels):
+        def body(carry, _):
+            params, stats, opt_state = carry
+            loss, params, stats, opt_state = one_step(
+                params, stats, opt_state, images, labels)
+            return (params, stats, opt_state), loss
+
+        (params, batch_stats, opt_state), losses = jax.lax.scan(
+            body, (params, batch_stats, opt_state), None, length=steps)
+        return losses[-1], params, batch_stats, opt_state
 
     return jax.jit(
-        step,
+        round_fn,
         in_shardings=(repl, repl, repl, batch_sharding, batch_sharding),
         out_shardings=(repl, repl, repl, repl),
         donate_argnums=(0, 1, 2) if donate else (),

@@ -155,3 +155,53 @@ class TestTransformer:
         mask = jnp.array([[1, 1, 0, 0], [0, 0, 0, 0]], jnp.int32)
         loss = masked_lm_loss(logits, labels, mask)
         np.testing.assert_allclose(float(loss), np.log(8), rtol=1e-5)
+
+
+class _TinyBnNet:
+    """Conv+BatchNorm model so the scan carries non-empty batch_stats
+    (the path bench.py's ResNet-50 relies on)."""
+
+    def __new__(cls):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = True):
+                x = nn.Conv(8, (3, 3))(x)
+                x = nn.BatchNorm(use_running_average=not train)(x)
+                x = nn.relu(x).mean(axis=(1, 2))
+                return nn.Dense(10)(x)
+
+        return Net()
+
+
+class TestTrainRound:
+    def test_scanned_round_matches_sequential_steps(self, hvd):
+        """make_train_round(steps=3) == three make_train_step calls,
+        including the BatchNorm running-stats carry."""
+        import optax
+        from horovod_tpu import training
+
+        model = _TinyBnNet()
+        opt = hvd.DistributedOptimizer(optax.sgd(0.05))
+        state = training.create_train_state(model, opt, (1, 28, 28, 1))
+        assert state.batch_stats  # non-empty stats actually carried
+        step, sh = training.make_train_step(model, opt, donate=False)
+        round_fn, _ = training.make_train_round(model, opt, steps=3,
+                                                donate=False)
+
+        rng = np.random.RandomState(0)
+        images = jax.device_put(rng.rand(16, 28, 28, 1).astype(np.float32), sh)
+        labels = jax.device_put(rng.randint(0, 10, (16,)).astype(np.int32), sh)
+
+        p, st, os_ = state.params, state.batch_stats, state.opt_state
+        for _ in range(3):
+            loss_seq, p, st, os_ = step(p, st, os_, images, labels)
+
+        loss_rnd, p2, st2, os2 = round_fn(state.params, state.batch_stats,
+                                          state.opt_state, images, labels)
+        np.testing.assert_allclose(float(loss_rnd), float(loss_seq), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves((p, st)),
+                        jax.tree_util.tree_leaves((p2, st2))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
