@@ -4,8 +4,12 @@
 TPU-native port of the reference's measurement harness (reference:
 examples/pytorch_synthetic_benchmark.py:37-110,
 examples/tensorflow2_synthetic_benchmark.py:72-132): ResNet-50 forward +
-backward + optimizer update on synthetic ImageNet-shaped data; 10 warmup
-batches, then 10 timed iterations of 10 batches each; reports images/sec.
+backward + optimizer update on synthetic ImageNet-shaped data. Each timed
+round is ONE compiled program running BENCH_BATCHES_PER_ROUND (default 20)
+train steps via lax.scan — host dispatch latency is excluded, which is the
+XLA-native reading of the reference's multi-batch rounds. Warmup runs
+ceil(BENCH_WARMUP / BENCH_BATCHES_PER_ROUND) rounds first; reports
+images/sec over BENCH_ROUNDS rounds.
 
 Baseline for ``vs_baseline``: the reference's only published absolute
 number — 1656.82 images/sec on 16 GPUs (ResNet-101, batch 64, 4xP100

@@ -37,11 +37,13 @@ def load_library(build_if_missing: bool = True):
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and build_if_missing:
-            # Simultaneously-launched workers all race to build here; an
-            # fcntl lock serializes them (and the Makefile writes the .so
-            # atomically via tmp+rename) so nobody dlopens a half-written
-            # library.
+        if build_if_missing:
+            # Always invoke make — a fresh build is a no-op, and a stale
+            # .so from before a source file was added would otherwise load
+            # with missing symbols. Simultaneously-launched workers race
+            # here; an fcntl lock serializes them (and the Makefile writes
+            # the .so atomically via tmp+rename) so nobody dlopens a
+            # half-written library.
             try:
                 import fcntl
 
@@ -49,53 +51,73 @@ def load_library(build_if_missing: bool = True):
                 with open(lock_path, "w") as lock_file:
                     fcntl.flock(lock_file, fcntl.LOCK_EX)
                     try:
-                        if not os.path.exists(_LIB_PATH):
-                            subprocess.run(["make", "-C", _CPP_DIR],
-                                           check=True, capture_output=True,
-                                           timeout=120)
+                        subprocess.run(["make", "-C", _CPP_DIR],
+                                       check=True, capture_output=True,
+                                       timeout=120)
                     finally:
                         fcntl.flock(lock_file, fcntl.LOCK_UN)
             except NativeUnavailableError:
                 raise
             except Exception as exc:
-                raise NativeUnavailableError(
-                    f"could not build native transport: {exc}") from exc
+                if not os.path.exists(_LIB_PATH):
+                    raise NativeUnavailableError(
+                        f"could not build native transport: {exc}") from exc
+                # toolchain gone but a previously-built library exists —
+                # fall through and try to load it
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError as exc:
             raise NativeUnavailableError(str(exc)) from exc
 
-        lib.hvdnet_init.restype = ctypes.c_void_p
-        lib.hvdnet_init.argtypes = [ctypes.c_int, ctypes.c_int,
-                                    ctypes.c_char_p, ctypes.c_int,
-                                    ctypes.c_int]
-        lib.hvdnet_finalize.argtypes = [ctypes.c_void_p]
-        lib.hvdnet_rank.argtypes = [ctypes.c_void_p]
-        lib.hvdnet_world.argtypes = [ctypes.c_void_p]
-        lib.hvdnet_barrier.argtypes = [ctypes.c_void_p]
-        lib.hvdnet_bit_and_or.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64)]
-        lib.hvdnet_gatherv.restype = ctypes.c_int64
-        lib.hvdnet_gatherv.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
-            ctypes.c_char_p, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint64)]
-        lib.hvdnet_bcast.restype = ctypes.c_int64
-        lib.hvdnet_bcast.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                     ctypes.c_uint64]
-        for name in ("hvdnet_allreduce_f32", "hvdnet_allreduce_f64",
-                     "hvdnet_allreduce_i32", "hvdnet_allreduce_i64"):
-            fn = getattr(lib, name)
-            fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
-        lib.hvdnet_allgatherv.restype = ctypes.c_int64
-        lib.hvdnet_allgatherv.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
-            ctypes.c_char_p, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint64)]
+        try:
+            _bind_symbols(lib)
+        except AttributeError as exc:
+            # stale library missing newer symbols and no toolchain to
+            # rebuild it
+            raise NativeUnavailableError(
+                f"stale native library {_LIB_PATH}: {exc}") from exc
         _lib = lib
         return _lib
+
+
+def _bind_symbols(lib) -> None:
+    lib.hvdnet_init.restype = ctypes.c_void_p
+    lib.hvdnet_init.argtypes = [ctypes.c_int, ctypes.c_int,
+                                ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_int]
+    lib.hvdnet_finalize.argtypes = [ctypes.c_void_p]
+    lib.hvdnet_rank.argtypes = [ctypes.c_void_p]
+    lib.hvdnet_world.argtypes = [ctypes.c_void_p]
+    lib.hvdnet_barrier.argtypes = [ctypes.c_void_p]
+    lib.hvdnet_bit_and_or.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.hvdnet_gatherv.restype = ctypes.c_int64
+    lib.hvdnet_gatherv.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.hvdnet_bcast.restype = ctypes.c_int64
+    lib.hvdnet_bcast.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint64]
+    for name in ("hvdnet_allreduce_f32", "hvdnet_allreduce_f64",
+                 "hvdnet_allreduce_i32", "hvdnet_allreduce_i64"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+    lib.hvdnet_allgatherv.restype = ctypes.c_int64
+    lib.hvdnet_allgatherv.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64)]
+    # timeline writer (timeline.cc)
+    lib.hvd_tl_open.restype = ctypes.c_void_p
+    lib.hvd_tl_open.argtypes = [ctypes.c_char_p]
+    lib.hvd_tl_emit.restype = ctypes.c_int
+    lib.hvd_tl_emit.argtypes = [
+        ctypes.c_void_p, ctypes.c_char, ctypes.c_int, ctypes.c_double,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.hvd_tl_close.argtypes = [ctypes.c_void_p]
 
 
 def native_built() -> bool:

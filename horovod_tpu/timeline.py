@@ -41,8 +41,38 @@ XLA_COLLECTIVE = "XLA_COLLECTIVE"
 QUEUE = "QUEUE"
 
 
+class _NativeWriter:
+    """Writer backed by the C++ SPSC ring + writer thread (cpp/timeline.cc
+    — the direct analogue of the reference's boost spsc_queue +
+    TimelineWriter, reference: timeline.h:66-75)."""
+
+    def __init__(self, path: str):
+        from horovod_tpu.runtime import native
+
+        self._lib = native.load_library()
+        self._handle = self._lib.hvd_tl_open(path.encode())
+        if not self._handle:
+            raise OSError(f"could not open timeline file {path!r}")
+
+    def emit(self, ph: str, pid: int, ts_us: float,
+             name: Optional[str] = None, args: Optional[dict] = None,
+             s: Optional[str] = None) -> None:
+        if not self._handle:  # closed — drop rather than use-after-free
+            return
+        self._lib.hvd_tl_emit(
+            self._handle, ph.encode(), pid, ts_us,
+            name.encode() if name else None,
+            json.dumps(args).encode() if args else None,
+            s.encode() if s else None)
+
+    def close(self) -> None:
+        if self._handle:
+            handle, self._handle = self._handle, None
+            self._lib.hvd_tl_close(handle)
+
+
 class _Writer:
-    """Background writer thread draining an event queue to the trace file
+    """Pure-Python fallback: background thread draining an event queue
     (reference: TimelineWriter, timeline.cc:28-127)."""
 
     _CLOSE = object()
@@ -57,9 +87,19 @@ class _Writer:
                                         name="hvd-timeline-writer")
         self._thread.start()
 
-    def enqueue(self, event: dict) -> None:
-        if self._healthy:
-            self._q.put(event)
+    def emit(self, ph: str, pid: int, ts_us: float,
+             name: Optional[str] = None, args: Optional[dict] = None,
+             s: Optional[str] = None) -> None:
+        if not self._healthy:
+            return
+        event = {"ph": ph, "pid": pid, "ts": ts_us}
+        if name:
+            event["name"] = name
+        if args:
+            event["args"] = args
+        if s:
+            event["s"] = s
+        self._q.put(event)
 
     def close(self) -> None:
         self._q.put(self._CLOSE)
@@ -80,6 +120,13 @@ class _Writer:
             self._healthy = False
 
 
+def _make_writer(path: str):
+    try:
+        return _NativeWriter(path)
+    except Exception:
+        return _Writer(path)
+
+
 class Timeline:
     """Per-tensor tracing state machine (reference: timeline.h:77-131).
 
@@ -88,7 +135,7 @@ class Timeline:
     """
 
     def __init__(self, path: str, mark_cycles: bool = False):
-        self._writer = _Writer(path)
+        self._writer = _make_writer(path)
         self._mark_cycles = mark_cycles
         self._lock = threading.Lock()
         self._tensor_pids: dict[str, int] = {}
@@ -106,22 +153,15 @@ class Timeline:
             pid = self._next_pid
             self._next_pid += 1
             self._tensor_pids[tensor_name] = pid
-            self._writer.enqueue({
-                "name": "process_name", "ph": "M", "pid": pid,
-                "args": {"name": tensor_name},
-            })
+            self._writer.emit("M", pid, self._ts_us(), name="process_name",
+                              args={"name": tensor_name})
         return pid
 
     def _emit(self, tensor_name: str, ph: str, name: Optional[str] = None,
               **args) -> None:
         with self._lock:
-            event = {"ph": ph, "pid": self._pid(tensor_name),
-                     "ts": self._ts_us()}
-            if name:
-                event["name"] = name
-            if args:
-                event["args"] = args
-            self._writer.enqueue(event)
+            self._writer.emit(ph, self._pid(tensor_name), self._ts_us(),
+                              name=name, args=args or None)
 
     # -- the reference's Timeline API --------------------------------------
     def negotiate_start(self, tensor_name: str, request_type: str) -> None:
@@ -155,10 +195,11 @@ class Timeline:
         if self._mark_cycles:
             with self._lock:
                 self._cycle += 1
-                self._writer.enqueue({
-                    "ph": "i", "pid": 0, "ts": self._ts_us(),
-                    "name": f"CYCLE_{self._cycle}", "s": "g",
-                })
+                self._writer.emit("i", 0, self._ts_us(),
+                                  name=f"CYCLE_{self._cycle}", s="g")
 
     def close(self) -> None:
-        self._writer.close()
+        # under the emit lock: no emitter may race the native writer's
+        # teardown (hvd_tl_close frees the C++ ring)
+        with self._lock:
+            self._writer.close()

@@ -53,3 +53,35 @@ def test_timeline_via_init_env(tmp_path, monkeypatch):
     hvd.shutdown()
     events = json.load(open(path))
     assert isinstance(events, list)
+
+
+def test_native_writer_used_and_escapes(tmp_path):
+    """The C++ SPSC writer (cpp/timeline.cc) is the active backend when
+    the native library builds, and its JSON escaping is correct."""
+    from horovod_tpu.runtime.native import native_built
+    from horovod_tpu.timeline import _make_writer, _NativeWriter
+
+    path = str(tmp_path / "trace.json")
+    tl = Timeline(path)
+    if native_built():
+        assert isinstance(tl._writer, _NativeWriter)
+    tl.start('weird"name\\x', "ALL\"RED\\UCE")
+    tl.end('weird"name\\x')
+    tl.close()
+    events = json.load(open(path))
+    assert any(e.get("name") == "ALL\"RED\\UCE" for e in events)
+
+
+def test_native_writer_stress_many_events(tmp_path):
+    """Thousands of events survive the ring (or are counted as dropped)."""
+    path = str(tmp_path / "trace.json")
+    tl = Timeline(path)
+    for i in range(5000):
+        tl.start(f"t{i % 7}", "ALLREDUCE")
+        tl.end(f"t{i % 7}")
+    tl.close()
+    events = json.load(open(path))
+    dropped = sum(e["args"]["count"] for e in events
+                  if e.get("name") == "dropped_events")
+    starts = sum(1 for e in events if e.get("ph") == "B")
+    assert starts + dropped >= 5000
