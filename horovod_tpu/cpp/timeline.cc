@@ -189,9 +189,14 @@ class TimelineFile {
     uint8_t slen = rec[off++];
     std::string s(reinterpret_cast<const char*>(rec + off), slen);
 
+    // ts is printed as integer-microseconds.fraction by hand: %.3f would
+    // follow LC_NUMERIC and emit a decimal comma under some locales,
+    // producing invalid JSON.
+    long long ts_ns = static_cast<long long>(hdr.ts_us * 1000.0 + 0.5);
     char head[96];
-    snprintf(head, sizeof(head), "{\"ph\":\"%c\",\"pid\":%d,\"ts\":%.3f",
-             hdr.ph, hdr.pid, hdr.ts_us);
+    snprintf(head, sizeof(head),
+             "{\"ph\":\"%c\",\"pid\":%d,\"ts\":%lld.%03lld", hdr.ph,
+             hdr.pid, ts_ns / 1000, ts_ns % 1000);
     line.assign(head);
     if (!name.empty()) {
       line += ",\"name\":\"";

@@ -1,0 +1,225 @@
+"""horovod_tpu.keras — high-level fit/evaluate/predict training surface.
+
+Rebuild of the reference's Keras binding (reference: horovod/keras/
+__init__.py, horovod/_keras/__init__.py:35-126, _keras/callbacks.py): the
+reference wraps a Keras optimizer and drives training through callbacks;
+the TPU-native analogue is a small ``Trainer`` over a flax module that
+packages the same conventions — DistributedOptimizer wrapping, initial
+broadcast, per-epoch metric averaging, LR warmup scheduling, rank-0
+checkpointing with optimizer-rewrapping restore (the reference's
+``load_model``, keras/__init__.py:117-160).
+
+    import horovod_tpu.keras as hvd_keras
+
+    trainer = hvd_keras.Trainer(model, optax.adam(1e-3 * hvd.size()),
+                                input_shape=(1, 28, 28, 1))
+    history = trainer.fit(images, labels, epochs=3, batch_size=64,
+                          callbacks=[hvd_keras.MetricAverageCallback()])
+    trainer.save("ckpts", step=3)
+    trainer = hvd_keras.Trainer.load("ckpts", model, optax.adam(1e-3),
+                                     input_shape=(1, 28, 28, 1))
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu import checkpoint as ckpt_mod
+from horovod_tpu import training
+from horovod_tpu.callbacks import (  # noqa: F401 — reference callback suite
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    average_metrics,
+    warmup_scaled_schedule,
+)
+from horovod_tpu.core import basics
+from horovod_tpu.parallel.dp import DistributedOptimizer
+
+
+class Trainer:
+    """Compact fit/evaluate/predict loop over a flax module with the
+    reference's distributed conventions baked in."""
+
+    def __init__(self, model, optimizer, input_shape,
+                 loss_fn: Optional[Callable] = None,
+                 compression=None,
+                 input_dtype=jnp.float32,
+                 rng: Optional[jax.Array] = None,
+                 _state: Optional[training.TrainState] = None):
+        self.model = model
+        if not _is_distributed(optimizer):
+            kwargs = {"compression": compression} if compression else {}
+            optimizer = DistributedOptimizer(optimizer, **kwargs)
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = input_dtype
+        self.state = _state or training.create_train_state(
+            model, optimizer, input_shape, rng=rng,
+            input_dtype=input_dtype)
+        self._step, self.batch_sharding = training.make_train_step(
+            model, optimizer, loss_fn=loss_fn, donate=False)
+        self._predict_fn = None
+
+    # -- training ---------------------------------------------------------
+
+    def fit(self, inputs, labels, *, epochs: int = 1, batch_size: int = 32,
+            callbacks: Sequence[Callback] = (), initial_epoch: int = 0,
+            shuffle: bool = True, verbose: int = 1) -> dict:
+        """Explicit epoch/batch loop; ``batch_size`` is per worker.
+        Returns a history dict of per-epoch averaged metrics."""
+        inputs = np.asarray(inputs)
+        labels = np.asarray(labels)
+        global_batch = batch_size * basics.size()
+        steps = len(inputs) // global_batch
+        if steps == 0:
+            raise ValueError(
+                f"dataset of {len(inputs)} examples is smaller than one "
+                f"global batch ({global_batch})")
+
+        tree = self._tree()
+        for cb in callbacks:
+            tree = cb.on_train_begin(tree)
+        self._set_tree(tree)
+
+        history: dict = {"loss": []}
+        tree = self._tree()
+        for epoch in range(initial_epoch, epochs):
+            for cb in callbacks:
+                tree = cb.on_epoch_begin(epoch, tree)
+            order = (np.random.RandomState(epoch).permutation(len(inputs))
+                     if shuffle else np.arange(len(inputs)))
+            losses = []
+            for i in range(steps):
+                for cb in callbacks:
+                    tree = cb.on_batch_begin(i, tree)
+                tree = self._apply_callback_lr(tree, callbacks)
+                idx = order[i * global_batch:(i + 1) * global_batch]
+                xb = jax.device_put(inputs[idx], self.batch_sharding)
+                yb = jax.device_put(labels[idx], self.batch_sharding)
+                loss, params, stats, opt_state = self._step(
+                    tree["params"], tree["batch_stats"], tree["opt_state"],
+                    xb, yb)
+                tree = {"params": params, "batch_stats": stats,
+                        "opt_state": opt_state}
+                losses.append(float(loss))
+            metrics = {"loss": float(np.mean(losses))}
+            for cb in callbacks:
+                tree, metrics = cb.on_epoch_end(epoch, tree, metrics)
+            self._set_tree(tree)
+            self.state.step = epoch
+            for k, v in metrics.items():
+                history.setdefault(k, []).append(float(v))
+            if verbose and basics.rank() == 0:
+                shown = ", ".join(f"{k}: {float(v):.4f}"
+                                  for k, v in metrics.items())
+                print(f"Epoch {epoch + 1}/{epochs} - {shown}")
+        return history
+
+    def _apply_callback_lr(self, tree, callbacks):
+        """Honor eager LR callbacks (reference: _keras/callbacks.py sets
+        the Keras optimizer's lr): the last callback exposing ``.lr`` wins,
+        written into the optimizer's injected hyperparams."""
+        lr = None
+        for cb in callbacks:
+            if hasattr(cb, "lr"):
+                lr = float(cb.lr)
+        if lr is None:
+            return tree
+        found = False
+
+        def set_lr(node):
+            nonlocal found
+            hp = getattr(node, "hyperparams", None)
+            if isinstance(hp, dict) and "learning_rate" in hp:
+                found = True
+                hp["learning_rate"] = jnp.asarray(
+                    lr, jnp.asarray(hp["learning_rate"]).dtype)
+            return node
+
+        jax.tree_util.tree_map(
+            set_lr, tree["opt_state"],
+            is_leaf=lambda n: hasattr(n, "hyperparams"))
+        if not found:
+            raise ValueError(
+                "an LR callback is active but the optimizer exposes no "
+                "injected 'learning_rate' hyperparameter; build it with "
+                "optax.inject_hyperparams (e.g. "
+                "optax.inject_hyperparams(optax.sgd)(learning_rate=lr)) "
+                "or use a schedule (warmup_scaled_schedule) instead")
+        return tree
+
+    # -- inference --------------------------------------------------------
+
+    def predict(self, inputs, batch_size: Optional[int] = None):
+        """Forward pass (train=False); returns host logits."""
+        if self._predict_fn is None:
+            self._predict_fn = jax.jit(
+                lambda v, x: self.model.apply(v, x, train=False))
+        variables = {"params": self.state.params}
+        if self.state.batch_stats:
+            variables["batch_stats"] = self.state.batch_stats
+        return np.asarray(self._predict_fn(variables, jnp.asarray(inputs)))
+
+    def evaluate(self, inputs, labels) -> dict:
+        """Loss + accuracy over the given data, averaged across workers
+        (the reference's MetricAverageCallback convention)."""
+        logits = self.predict(inputs)
+        labels = np.asarray(labels)
+        loss = float(np.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                jnp.asarray(logits), jnp.asarray(labels))))
+        acc = float(np.mean(np.argmax(logits, axis=-1) == labels))
+        return {k: float(v) for k, v in
+                average_metrics({"loss": loss, "accuracy": acc}).items()}
+
+    # -- persistence (reference: keras load_model with optimizer rewrap,
+    # keras/__init__.py:117-160) -----------------------------------------
+
+    def save(self, directory: str, step: int = 0,
+             keep: Optional[int] = None):
+        """Rank-0 checkpoint of params/stats/optimizer state."""
+        return ckpt_mod.save(directory, self._tree(), step=step, keep=keep)
+
+    @classmethod
+    def load(cls, directory: str, model, optimizer, input_shape,
+             loss_fn: Optional[Callable] = None,
+             input_dtype=jnp.float32) -> "Trainer":
+        """Rebuild a trainer from the newest checkpoint, rewrapping the
+        (fresh) optimizer in DistributedOptimizer — weights AND optimizer
+        state restore, broadcast from rank 0."""
+        trainer = cls(model, optimizer, input_shape, loss_fn=loss_fn,
+                      input_dtype=input_dtype)
+        tree, step = ckpt_mod.restore_latest(directory, trainer._tree())
+        trainer._set_tree(tree)
+        if step is not None:
+            trainer.state.step = step
+        return trainer
+
+    # -- helpers ----------------------------------------------------------
+
+    def _tree(self) -> dict:
+        return {"params": self.state.params,
+                "batch_stats": self.state.batch_stats,
+                "opt_state": self.state.opt_state}
+
+    def _set_tree(self, tree: dict) -> None:
+        self.state = training.TrainState(
+            tree["params"], tree["batch_stats"], tree["opt_state"],
+            step=self.state.step)
+
+
+def _is_distributed(optimizer) -> bool:
+    # DistributedOptimizer returns a GradientTransformationExtraArgs whose
+    # update closure lives in parallel/dp.py
+    update = getattr(optimizer, "update", None)
+    code = getattr(update, "__code__", None)
+    return bool(code and "dp.py" in code.co_filename)
