@@ -1,0 +1,31 @@
+"""Launcher binary end-to-end: `bin/tpurun -np 2` as a real subprocess
+(the delta over test_run.py's in-process run_commandline coverage), with
+tests/mp_worker.py as the 2-rank workload (reference: the Docker test
+images bake `mpirun -np 2 -H localhost:2` as the canonical integration
+drive, Dockerfile.test.cpu:53-83)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runtime.native import native_built
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "mp_worker.py")
+
+pytestmark = pytest.mark.skipif(
+    not native_built(), reason="native transport not built")
+
+
+@pytest.mark.parametrize("extra_args", [["--no-jax-distributed"], []],
+                         ids=["socket-controller", "jax-distributed"])
+def test_tpurun_binary_two_ranks(extra_args):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", *extra_args, sys.executable, WORKER, "collectives"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
