@@ -25,7 +25,8 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="ResNet50",
                         choices=["ResNet18", "ResNet34", "ResNet50",
-                                 "ResNet101", "ResNet152"])
+                                 "ResNet101", "ResNet152",
+                                 "VGG16", "InceptionV3"])
     parser.add_argument("--batch-size", type=int, default=128,
                         help="per-chip batch size")
     parser.add_argument("--num-warmup-batches", type=int, default=10)
@@ -38,18 +39,20 @@ def main():
     hvd.init()
     model = getattr(models, args.model)(num_classes=1000,
                                         dtype=jnp.bfloat16)
+    image_size = 299 if args.model == "InceptionV3" else 224
     compression = (hvd.Compression.fp16 if args.fp16_allreduce
                    else hvd.Compression.none)
     opt = hvd.DistributedOptimizer(
         optax.sgd(0.01 * hvd.size(), momentum=0.9), compression=compression)
 
-    state = training.create_train_state(model, opt, (1, 224, 224, 3))
+    state = training.create_train_state(
+        model, opt, (1, image_size, image_size, 3))
     step, batch_sharding = training.make_train_step(model, opt)
 
     global_batch = args.batch_size * hvd.size()
     rng = np.random.RandomState(0)
     images = jax.device_put(
-        rng.rand(global_batch, 224, 224, 3).astype(np.float32),
+        rng.rand(global_batch, image_size, image_size, 3).astype(np.float32),
         batch_sharding)
     labels = jax.device_put(
         rng.randint(0, 1000, (global_batch,)).astype(np.int32),
@@ -67,16 +70,18 @@ def main():
     if hvd.rank() == 0:
         print(f"Model: {args.model}, batch size {args.batch_size}/chip, "
               f"{hvd.size()} chips")
+    loss = run_batch()  # compile
     for _ in range(args.num_warmup_batches):
-        run_batch()
-    jax.block_until_ready(params)
+        loss = run_batch()
+    float(loss)  # host sync — block_until_ready alone can be a no-op on
+    # remote-dispatch platforms
 
     img_secs = []
     for i in range(args.num_iters):
         t0 = time.time()
-        for _ in range(args.num_batches_per_iter):
-            run_batch()
-        jax.block_until_ready(params)
+        for _ in range(max(args.num_batches_per_iter, 1)):
+            loss = run_batch()
+        float(loss)
         dt = time.time() - t0
         rate = global_batch * args.num_batches_per_iter / dt
         img_secs.append(rate)

@@ -1,0 +1,64 @@
+"""VGG family (flax), TPU-first.
+
+VGG-16 is one of the reference's three headline scaling-benchmark models
+(reference: docs/benchmarks.rst:13-14 — 68% efficiency at 512 GPUs; its
+huge dense layers stress gradient-exchange bandwidth, which is exactly why
+the reference reports it). Fresh implementation: NHWC, bf16 compute /
+f32 params, static shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGG(nn.Module):
+    """Classic VGG: conv stages + 2x4096 dense head.
+
+    ``stage_sizes`` gives convs per stage; channels double per stage from
+    64 up to 512. ``batch_norm`` selects the BN variant (vgg*_bn).
+    """
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    batch_norm: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), dtype=self.dtype,
+                       param_dtype=jnp.float32,
+                       kernel_init=nn.initializers.he_normal())
+        x = x.astype(self.dtype)
+        channels = 64
+        for stage, n_convs in enumerate(self.stage_sizes):
+            for i in range(n_convs):
+                x = conv(features=channels, name=f"conv{stage}_{i}")(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=self.dtype,
+                                     param_dtype=jnp.float32,
+                                     name=f"bn{stage}_{i}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            channels = min(channels * 2, 512)
+
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=jnp.float32, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=jnp.float32, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = partial(VGG, stage_sizes=[1, 1, 2, 2, 2])
+VGG13 = partial(VGG, stage_sizes=[2, 2, 2, 2, 2])
+VGG16 = partial(VGG, stage_sizes=[2, 2, 3, 3, 3])
+VGG19 = partial(VGG, stage_sizes=[2, 2, 4, 4, 4])

@@ -205,3 +205,55 @@ class TestTrainRound:
                         jax.tree_util.tree_leaves((p2, st2))):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
+
+
+class TestVggInception:
+    def test_vgg16_param_count(self, hvd_flat):
+        from horovod_tpu.models.vgg import VGG16
+
+        model = VGG16(num_classes=1000)
+        tokens = jnp.zeros((1, 224, 224, 3))
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), tokens, train=False))
+        n = sum(int(np.prod(x.shape)) for x in
+                jax.tree_util.tree_leaves(variables["params"]))
+        # canonical VGG-16 ImageNet size: ~138.4M params
+        assert 137_000_000 < n < 140_000_000
+
+    def test_vgg16_forward(self, hvd_flat):
+        from horovod_tpu.models.vgg import VGG16
+
+        model = VGG16(num_classes=10, dtype=jnp.float32)
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 10) and out.dtype == jnp.float32
+
+    def test_inception_v3_param_count(self, hvd_flat):
+        from horovod_tpu.models.inception import InceptionV3
+
+        model = InceptionV3(num_classes=1000)
+        x = jnp.zeros((1, 299, 299, 3))
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), x, train=False))
+        n = sum(int(np.prod(x.shape)) for x in
+                jax.tree_util.tree_leaves(variables["params"]))
+        # canonical Inception-V3 (no aux head): ~23.8M params
+        assert 22_000_000 < n < 25_000_000
+
+    def test_inception_v3_trains(self, hvd):
+        import optax
+        from horovod_tpu import training
+        from horovod_tpu.models.inception import InceptionV3
+
+        model = InceptionV3(num_classes=10, dtype=jnp.float32)
+        opt = hvd.DistributedOptimizer(optax.sgd(0.01))
+        state = training.create_train_state(model, opt, (1, 128, 128, 3))
+        step, sh = training.make_train_step(model, opt)
+        rng = np.random.RandomState(0)
+        images = jax.device_put(rng.rand(8, 128, 128, 3).astype(np.float32), sh)
+        labels = jax.device_put(rng.randint(0, 10, (8,)).astype(np.int32), sh)
+        loss, p, st, os_ = step(state.params, state.batch_stats,
+                                state.opt_state, images, labels)
+        loss2, *_ = step(p, st, os_, images, labels)
+        assert float(loss2) < float(loss)
