@@ -191,6 +191,40 @@ def main():
             while time.time() < deadline and rt._thread.is_alive():
                 time.sleep(0.1)
             assert not rt._thread.is_alive(), "shutdown did not propagate"
+    elif scenario == "fusion_stress":
+        # Many named tensors of mixed sizes/dtypes in flight per cycle —
+        # the fusion bin-packer and response cache under load (reference:
+        # test_tensorflow.py:152 fused many-small-tensors coverage). Ranks
+        # submit in different orders; the negotiation must still converge
+        # and every result must unfuse to the right buffer.
+        # x64 on, so the float64 specs genuinely exercise a distinct
+        # element size in the bin-packer rather than downcasting to f32.
+        jax.config.update("jax_enable_x64", True)
+        rng = np.random.RandomState(7)  # same on all ranks
+        specs = []
+        for t in range(60):
+            dt = [np.float32, np.float64, np.int32][t % 3]
+            shape = (int(rng.randint(1, 2000)),)
+            specs.append((f"fs/{t}", dt, shape))
+        for rounds in range(3):
+            order = list(range(len(specs)))
+            # rank-dependent submission order (reference: grads arrive in
+            # different orders per rank)
+            if rank % 2:
+                order = order[::-1]
+            handles = {}
+            for t in order:
+                name, dt, shape = specs[t]
+                handles[t] = hvd.allreduce_async(
+                    np.full(shape, float(rank + t), dt), name=name,
+                    op=hvd.Sum)
+            for t, h in handles.items():
+                name, dt, shape = specs[t]
+                out = np.asarray(hvd.synchronize(h))
+                assert out.dtype == dt, (name, out.dtype, dt)
+                expect = sum(float(r + t) for r in range(world))
+                np.testing.assert_allclose(out, np.full(shape, expect),
+                                           rtol=1e-6)
     elif scenario == "torch":
         # The torch binding end-to-end under a real multi-process world
         # (reference: test/test_torch.py run under mpirun): hook-driven

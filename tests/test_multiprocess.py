@@ -103,3 +103,12 @@ def test_stall_triggers_global_shutdown():
         })
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_fusion_stress_mixed_tensors(world):
+    """60 mixed-size/dtype named tensors per cycle, submitted in different
+    orders per rank, across cache-warm rounds."""
+    procs, outs = _launch("fusion_stress", world, timeout=150)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
