@@ -80,6 +80,12 @@ from horovod_tpu.parallel.sparse import (
 )
 from horovod_tpu.parallel.ring import ring_attention
 from horovod_tpu.parallel.ulysses import ulysses_attention
+from horovod_tpu.parallel.tp import (
+    params_shardings,
+    tp_train_step,
+    transformer_tp_rules,
+    xla_attention,
+)
 from horovod_tpu.ops.pallas import flash_attention
 from horovod_tpu import checkpoint
 
@@ -107,6 +113,9 @@ __all__ = [
     "SparseGrad", "sparse_allgather", "with_sparse_embedding_grad",
     # long-context / sequence parallelism (TPU-first extensions)
     "flash_attention", "ring_attention", "ulysses_attention",
+    # tensor parallelism (TPU-first extension)
+    "transformer_tp_rules", "params_shardings", "tp_train_step",
+    "xla_attention",
     # checkpoint / resume (rank-0 save + broadcast restore)
     "checkpoint",
 ]

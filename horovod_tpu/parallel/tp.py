@@ -1,0 +1,129 @@
+"""Tensor parallelism: Megatron-style sharded transformer layers, the
+GSPMD way.
+
+TPU-first extension beyond the reference's DP-only model (SURVEY.md §2.4
+notes TP is absent there). On TPU, tensor parallelism is *sharding
+annotations*, not hand-written collectives: attention heads and the MLP
+hidden dimension are sharded over a mesh axis, parameters and activations
+carry `NamedSharding`s, and XLA inserts the all-reduces the Megatron
+recipe would place by hand (column-parallel in, row-parallel out). This is
+the "pick a mesh, annotate shardings, let XLA insert collectives" design
+the scaling playbook prescribes.
+
+Composes with data parallelism: shard params over one axis (default
+``local`` — TP collectives ride ICI every layer), batch over the other
+(``cross``).
+
+Usage::
+
+    params = model.init(...)["params"]
+    placed, step, batch_sharding = tp_train_step(
+        model, opt, params, transformer_tp_rules(axis="local"),
+        loss_fn=causal_lm_loss, batch_axis="cross")
+    opt_state = opt.init(placed)  # inherits the TP layout
+    loss, placed, _, opt_state = step(placed, {}, opt_state, xb, xb)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.core import basics, mesh as mesh_mod
+
+
+def xla_attention(q, k, v, causal):
+    """GSPMD-partitionable attention for TP models.
+
+    Pallas kernels do not auto-partition under pjit, so TP models swap the
+    flash kernel for this einsum formulation: XLA shards it over the heads
+    axis for free (scores are (batch, heads/N, seq, seq) per device). For
+    long sequences combine TP with shard_map sequence parallelism (ring /
+    Ulysses) instead, where the flash kernel applies per-shard.
+    """
+    from horovod_tpu.ops.pallas.flash_attention import attention_reference
+
+    return attention_reference(q, k, v, causal=causal)
+
+
+def transformer_tp_rules(axis: str = mesh_mod.LOCAL_AXIS):
+    """(regex, PartitionSpec) rules for the models.transformer family:
+    q/k/v projections and the MLP input are column-parallel (output
+    features sharded over ``axis``), the attention output projection and
+    MLP output are row-parallel (input features sharded) — one XLA
+    all-reduce per block half, exactly the Megatron layout."""
+    return [
+        # attention: kernel (d_model, heads, head_dim) — shard heads
+        (r".*attention/(query|key|value)/kernel", P(None, axis, None)),
+        (r".*attention/(query|key|value)/bias", P(axis, None)),
+        # out projection: kernel (heads, head_dim, d_model) — shard heads
+        (r".*attention/out/kernel", P(axis, None, None)),
+        # mlp: wi (d_model, d_ff) column-parallel; wo (d_ff, d_model)
+        # row-parallel
+        (r".*mlp/wi/kernel", P(None, axis)),
+        (r".*mlp/wi/bias", P(axis)),
+        (r".*mlp/wo/kernel", P(axis, None)),
+        # token embedding (vocab, d_model): shard the vocab rows; the tied
+        # output projection contracts over d_model so logits come out
+        # vocab-sharded and XLA gathers where needed
+        (r".*token_embed/embedding", P(axis, None)),
+    ]
+
+
+def params_shardings(params, mesh, rules, default=P()):
+    """Build a NamedSharding pytree for ``params``: first rule whose regex
+    matches the '/'-joined param path wins; everything else replicates."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(path_key, leaf):
+        path = "/".join(
+            getattr(k, "key", getattr(k, "name", str(k))) for k in path_key)
+        for pat, spec in compiled:
+            if pat.fullmatch(path):
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, default)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def tp_train_step(model, optimizer, params, rules,
+                  loss_fn: Optional[Callable] = None,
+                  batch_axis: Optional[str] = mesh_mod.CROSS_AXIS,
+                  donate: bool = True):
+    """Jitted train step with Megatron-sharded parameters.
+
+    ``params`` (an unsharded init tree) is placed per ``rules`` over the
+    TP axis; optimizer state initialized from the placed params inherits
+    the same layout. The batch is sharded over ``batch_axis`` (data
+    parallelism on the other mesh axis; ``None`` replicates it). Returns
+    ``(placed_params, step, batch_sharding)`` with ``step`` having the
+    make_train_step signature ``(params, batch_stats, opt_state, x, y) ->
+    (loss, params, batch_stats, opt_state)``.
+    """
+    from horovod_tpu import training
+
+    st = basics._ensure_init()
+    mesh = st.mesh
+    batch_sharding = NamedSharding(
+        mesh, P(batch_axis) if batch_axis else P())
+    repl = NamedSharding(mesh, P())
+
+    one_step = training._make_one_step(
+        model, optimizer, loss_fn or training._default_loss_fn)
+
+    shardings = params_shardings(params, mesh, rules)
+    placed = jax.device_put(params, shardings)
+    step = jax.jit(
+        one_step,
+        # opt_state/batch_stats shardings (None) follow the arguments' own
+        # placement — optimizer.init(placed_params) inherits the layout
+        in_shardings=(shardings, repl, None, batch_sharding,
+                      batch_sharding),
+        out_shardings=(repl, shardings, repl, None),
+        donate_argnums=(0, 1, 2) if donate else (),
+    )
+    return placed, step, batch_sharding

@@ -1,0 +1,103 @@
+"""Tensor parallelism: Megatron-sharded transformer training via GSPMD.
+
+TPU-first extension (the reference is DP-only); correctness bar: TP
+training must be numerically identical to unsharded training.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.models.transformer import Transformer, causal_lm_loss
+
+
+def _model(hvd):
+    return Transformer(vocab_size=64, d_model=32, num_layers=2, num_heads=4,
+                       d_ff=64, max_seq=16, causal=True, dtype=jnp.float32,
+                       attention_fn=hvd.xla_attention)
+
+
+class TestTensorParallel:
+    def test_rules_shard_expected_params(self, hvd):
+        model = _model(hvd)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 16), jnp.int32),
+                            train=False)["params"]
+        sh = hvd.params_shardings(params, hvd.mesh(),
+                                  hvd.transformer_tp_rules("local"))
+        flat = {jax.tree_util.keystr(p): s for p, s in
+                jax.tree_util.tree_flatten_with_path(sh)[0]}
+
+        def spec_of(key):
+            (k,) = [v for kk, v in flat.items() if key in kk]
+            return k.spec
+
+        assert spec_of("layer_0']['attention']['query']['kernel") == \
+            P(None, "local", None)
+        assert spec_of("layer_0']['mlp']['wi']['kernel") == P(None, "local")
+        assert spec_of("layer_0']['mlp']['wo']['kernel") == P("local", None)
+        assert spec_of("token_embed") == P("local", None)
+        # non-matching params replicate
+        assert spec_of("final_norm']['scale") == P()
+
+    def test_tp_training_matches_unsharded(self, hvd):
+        """Two training steps under TP(local) x DP(cross) == two steps
+        unsharded — GSPMD sharding must not change the math."""
+        model = _model(hvd)
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), tokens,
+                            train=False)["params"]
+        # sgd: updates are linear in gradients, so sharded-reduction-order
+        # noise stays O(eps) instead of being amplified like adam's
+        # g/sqrt(v) at tiny v
+        opt = optax.sgd(0.1, momentum=0.9)
+
+        # --- unsharded reference ---
+        ref_params = params
+        ref_opt = opt.init(ref_params)
+
+        @jax.jit
+        def ref_step(p, s, x):
+            loss, grads = jax.value_and_grad(lambda p: causal_lm_loss(
+                model.apply({"params": p}, x, train=True), x))(p)
+            updates, s = opt.update(grads, s, p)
+            return loss, optax.apply_updates(p, updates), s
+
+        # --- TP x DP ---
+        placed, step, batch_sharding = hvd.tp_train_step(
+            model, opt, params, hvd.transformer_tp_rules("local"),
+            loss_fn=causal_lm_loss, batch_axis="cross", donate=False)
+        tp_opt = opt.init(placed)
+        xb = jax.device_put(tokens, batch_sharding)
+
+        ref_losses, tp_losses = [], []
+        tp_params, tp_state = placed, tp_opt
+        for _ in range(2):
+            rl, ref_params, ref_opt = ref_step(ref_params, ref_opt, tokens)
+            tl, tp_params, _, tp_state = step(tp_params, {}, tp_state,
+                                              xb, xb)
+            ref_losses.append(float(rl))
+            tp_losses.append(float(tl))
+        np.testing.assert_allclose(tp_losses, ref_losses, rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(ref_params),
+                        jax.tree_util.tree_leaves(tp_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_tp_params_actually_distributed(self, hvd):
+        """Sharded leaves occupy 1/N of each device's memory."""
+        model = _model(hvd)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 16), jnp.int32),
+                            train=False)["params"]
+        opt = optax.sgd(0.1)
+        placed, step, _ = hvd.tp_train_step(
+            model, opt, params, hvd.transformer_tp_rules("local"),
+            loss_fn=causal_lm_loss, donate=False)
+        wi = placed["layer_0"]["mlp"]["wi"]["kernel"]
+        n_local = hvd.mesh().shape["local"]
+        shard = wi.addressable_shards[0]
+        assert shard.data.shape == (32, 64 // n_local)
