@@ -86,6 +86,11 @@ from horovod_tpu.parallel.tp import (
     transformer_tp_rules,
     xla_attention,
 )
+from horovod_tpu.parallel.pp import (
+    last_stage_value,
+    pipeline_apply,
+    stack_stage_params,
+)
 from horovod_tpu.ops.pallas import flash_attention
 from horovod_tpu import checkpoint
 
@@ -116,6 +121,8 @@ __all__ = [
     # tensor parallelism (TPU-first extension)
     "transformer_tp_rules", "params_shardings", "tp_train_step",
     "xla_attention",
+    # pipeline parallelism (TPU-first extension)
+    "pipeline_apply", "last_stage_value", "stack_stage_params",
     # checkpoint / resume (rank-0 save + broadcast restore)
     "checkpoint",
 ]
