@@ -91,6 +91,11 @@ from horovod_tpu.parallel.pp import (
     pipeline_apply,
     stack_stage_params,
 )
+from horovod_tpu.parallel.ep import (
+    default_capacity,
+    load_balance_loss,
+    switch_moe,
+)
 from horovod_tpu.ops.pallas import flash_attention
 from horovod_tpu import checkpoint
 
@@ -123,6 +128,8 @@ __all__ = [
     "xla_attention",
     # pipeline parallelism (TPU-first extension)
     "pipeline_apply", "last_stage_value", "stack_stage_params",
+    # expert parallelism / MoE (TPU-first extension)
+    "switch_moe", "load_balance_loss", "default_capacity",
     # checkpoint / resume (rank-0 save + broadcast restore)
     "checkpoint",
 ]
