@@ -1,0 +1,20 @@
+"""Shared helpers for the parallelism strategy modules."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def consume_stage_axis(tree):
+    """Drop the length-1 leading axis shard_map leaves carry when a
+    (n_stages, ...) stack is sharded with in_specs P(axis, ...) — used by
+    the pipeline and expert-parallel dispatchers."""
+    return jax.tree_util.tree_map(lambda a: jnp.squeeze(a, axis=0), tree)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage param pytrees along a new leading axis
+    (shard it over the pipeline/expert mesh axis with P('axis', ...))."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params)

@@ -16,11 +16,14 @@ Switch auxiliary loss that keeps routing uniform.
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from horovod_tpu.parallel._util import consume_stage_axis
 
 
 def switch_moe(x, gate_logits, expert_fn: Callable, expert_params,
@@ -36,14 +39,13 @@ def switch_moe(x, gate_logits, expert_fn: Callable, expert_params,
     Returns ``(y, router_probs)`` where dropped tokens contribute zeros.
     """
     n_exp = lax.axis_size(axis_name)
-    tokens, d = x.shape
+    d = x.shape[-1]
     if gate_logits.shape[-1] != n_exp:
         raise ValueError(
             f"router has {gate_logits.shape[-1]} experts but axis "
             f"'{axis_name}' has {n_exp} devices; expert parallelism needs "
             "one expert per device on the axis")
-    expert_params = jax.tree_util.tree_map(
-        lambda a: jnp.squeeze(a, axis=0), expert_params)
+    expert_params = consume_stage_axis(expert_params)
 
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
@@ -99,6 +101,4 @@ def default_capacity(tokens_per_device: int, n_experts: int,
     """Per-(device, expert) buffer size: even-split load times the safety
     factor, rounded up so the factor's headroom survives small ratios
     (the Switch convention)."""
-    import math
-
     return max(1, math.ceil(tokens_per_device * capacity_factor / n_experts))

@@ -26,6 +26,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.parallel._util import (  # noqa: F401 — re-exported API
+    consume_stage_axis,
+    stack_stage_params,
+)
+
 
 def pipeline_apply(stage_fn: Callable, stage_params, x,
                    axis_name: str):
@@ -42,10 +47,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     idx = lax.axis_index(axis_name)
     n_micro = x.shape[0]
     ticks = n_micro + n_stages - 1
-    # Under shard_map with in_specs P(axis_name, ...), each device sees its
-    # stage slice with a leading axis of length 1 — consume it.
-    stage_params = jax.tree_util.tree_map(
-        lambda a: jnp.squeeze(a, axis=0), stage_params)
+    stage_params = consume_stage_axis(stage_params)
     # send to the NEXT stage: device i's output becomes i+1's input
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -89,8 +91,3 @@ def last_stage_value(value, axis_name: str):
     return collectives.broadcast(value, n_stages - 1, axis_name=axis_name)
 
 
-def stack_stage_params(per_stage_params):
-    """Stack a list of per-stage param pytrees along a new leading axis
-    (shard it over the pipeline mesh axis with P('axis', ...))."""
-    return jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params)

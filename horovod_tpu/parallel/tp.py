@@ -27,10 +27,9 @@ Usage::
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
-import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.core import basics, mesh as mesh_mod
