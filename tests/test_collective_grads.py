@@ -1,0 +1,91 @@
+"""Gradient correctness through each collective op.
+
+Mirrors the reference's gradient registrations and their tests (reference:
+horovod/tensorflow/mpi_ops.py:89-180 — grad(allreduce)=allreduce,
+grad(allgather)=slice of the allreduced grad, grad(broadcast)=allreduce
+zeroed off-root; tested at test/test_tensorflow.py:385-460,684-977). In
+the TPU build these identities must hold for differentiation through the
+in-jit collectives.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+K = 4  # elements per device
+
+
+def _x(hvd, rng):
+    n = hvd.size()
+    return jnp.asarray(rng.randn(n, K).astype(np.float32))
+
+
+def _weights(hvd, rng, shape):
+    """Per-device cotangent weights, distinct per rank."""
+    n = hvd.size()
+    return jnp.asarray(rng.randn(n, *shape).astype(np.float32))
+
+
+def _grad_of(hvd, fn, x, w):
+    """d/dx of sum over devices of <fn(x_local), w_local>."""
+
+    def loss(x):
+        def inner(x, w):
+            val = jnp.sum(fn(x[0]) * w[0])
+            return jax.lax.psum(val, hvd.GLOBAL_AXES)
+
+        return jax.shard_map(
+            inner, mesh=hvd.mesh(),
+            in_specs=(P(hvd.GLOBAL_AXES), P(hvd.GLOBAL_AXES)),
+            out_specs=P(), check_vma=False)(x, w)
+
+    return np.asarray(jax.jit(jax.grad(loss))(x))
+
+
+class TestCollectiveGradients:
+    def test_allreduce_grad_is_allreduced(self, hvd):
+        """y = mean_j x_j  =>  dL/dx_j = (1/N) sum_i w_i (reference:
+        grad(allreduce) = allreduce of the upstream grad)."""
+        rng = np.random.RandomState(0)
+        x, w = _x(hvd, rng), _weights(hvd, rng, (K,))
+        g = _grad_of(hvd, lambda xl: hvd.allreduce(xl, average=True), x, w)
+        expect = np.tile(np.asarray(w).sum(0) / hvd.size(), (hvd.size(), 1))
+        np.testing.assert_allclose(g, expect, atol=1e-6)
+
+    def test_allgather_grad_is_slice_of_reduced(self, hvd):
+        """y_i = concat_j x_j  =>  dL/dx_j = sum_i w_i[slice j]
+        (reference: grad(allgather) = this rank's slice of the allreduced
+        grad, mpi_ops.py:120-131)."""
+        rng = np.random.RandomState(1)
+        n = hvd.size()
+        x = _x(hvd, rng)
+        w = _weights(hvd, rng, (n * K,))
+        g = _grad_of(hvd, lambda xl: hvd.allgather(xl), x, w)
+        summed = np.asarray(w).sum(0)  # (n*K,)
+        expect = summed.reshape(n, K)
+        np.testing.assert_allclose(g, expect, atol=1e-6)
+
+    def test_broadcast_grad_zeroed_off_root(self, hvd):
+        """y_i = x_root  =>  dL/dx_root = sum_i w_i, zero elsewhere
+        (reference: grad(broadcast) = allreduce with non-root zeroed,
+        mpi_ops.py:162-180)."""
+        rng = np.random.RandomState(2)
+        root = 1
+        x, w = _x(hvd, rng), _weights(hvd, rng, (K,))
+        g = _grad_of(
+            hvd, lambda xl: hvd.broadcast(xl, root), x, w)
+        expect = np.zeros_like(g)
+        expect[root] = np.asarray(w).sum(0)
+        np.testing.assert_allclose(g, expect, atol=1e-6)
+
+    def test_reducescatter_grad(self, hvd):
+        """y_i = (sum_j x_j)[slice i]  =>  dL/dx_j = concat_i w_i."""
+        rng = np.random.RandomState(3)
+        n = hvd.size()
+        x = jnp.asarray(rng.randn(n, n * 2).astype(np.float32))
+        w = _weights(hvd, rng, (2,))
+        g = _grad_of(
+            hvd, lambda xl: hvd.reducescatter(xl, op=hvd.Sum), x, w)
+        expect = np.tile(np.asarray(w).reshape(-1), (n, 1))
+        np.testing.assert_allclose(g, expect, atol=1e-6)
