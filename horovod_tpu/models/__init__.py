@@ -15,6 +15,7 @@ from horovod_tpu.models.resnet import (
 )
 from horovod_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
 from horovod_tpu.models.inception import InceptionV3
+from horovod_tpu.models import moe
 from horovod_tpu.models.transformer import (
     BertBase,
     BertLarge,
@@ -29,7 +30,7 @@ from horovod_tpu.models.transformer import (
 __all__ = [
     "MnistConvNet",
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
-    "VGG", "VGG11", "VGG13", "VGG16", "VGG19", "InceptionV3",
+    "VGG", "VGG11", "VGG13", "VGG16", "VGG19", "InceptionV3", "moe",
     "Transformer", "BertBase", "BertLarge", "GPT2Small", "GPT2Medium",
     "causal_lm_loss", "masked_lm_loss", "random_tokens",
 ]

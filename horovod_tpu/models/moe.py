@@ -1,0 +1,130 @@
+"""Mixture-of-experts language model (Switch-style), expert-parallel.
+
+Acceptance workload for the EP extension (the reference has no MoE or
+expert parallelism — SURVEY.md §2.4): a causal transformer LM whose MLP
+blocks are Switch MoE layers with experts sharded one-per-device over a
+mesh axis. Runs inside ``shard_map``: the EP axis doubles as the data
+axis (each device holds its own token batch); attention/embedding params
+are replicated (their gradients arrive pre-averaged through the pmean'd
+loss), expert weights are per-device (each device trains only its own
+expert — no cross-device averaging of expert gradients).
+
+Functional-style (plain param pytrees, pure apply) because the expert
+leading axis is a shard_map in_spec, which flax module trees don't
+express naturally.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu.ops.pallas.flash_attention import flash_attention
+from horovod_tpu.parallel import ep as ep_mod
+from horovod_tpu.parallel._util import stack_stage_params
+
+
+def init_moe_lm(rng: np.random.RandomState, *, vocab_size: int,
+                d_model: int, num_layers: int, num_heads: int, d_ff: int,
+                n_experts: int, max_seq: int) -> dict:
+    """Parameter pytree. ``experts`` subtrees carry a leading
+    ``n_experts`` axis — shard it over the EP mesh axis with
+    ``P(axis)``; everything in ``shared`` replicates (``P()``)."""
+
+    def dense(n_in, n_out, scale=None):
+        scale = scale or 1.0 / math.sqrt(n_in)
+        return jnp.asarray(rng.randn(n_in, n_out).astype(np.float32)
+                           * scale)
+
+    shared = {
+        "token_embed": jnp.asarray(
+            rng.randn(vocab_size, d_model).astype(np.float32) * 0.02),
+        "pos_embed": jnp.asarray(
+            rng.randn(max_seq, d_model).astype(np.float32) * 0.02),
+        "layers": [],
+    }
+    experts = {"layers": []}
+    for _ in range(num_layers):
+        shared["layers"].append({
+            "ln1": {"scale": jnp.ones((d_model,)),
+                    "bias": jnp.zeros((d_model,))},
+            "ln2": {"scale": jnp.ones((d_model,)),
+                    "bias": jnp.zeros((d_model,))},
+            "wq": dense(d_model, d_model),
+            "wk": dense(d_model, d_model),
+            "wv": dense(d_model, d_model),
+            "wo": dense(d_model, d_model),
+            "gate": dense(d_model, n_experts, scale=0.02),
+        })
+        experts["layers"].append(stack_stage_params([
+            {"wi": dense(d_model, d_ff), "wo": dense(d_ff, d_model)}
+            for _ in range(n_experts)]))
+    shared["final_ln"] = {"scale": jnp.ones((d_model,)),
+                          "bias": jnp.zeros((d_model,))}
+    return {"shared": shared, "experts": experts}
+
+
+def _layer_norm(p, x):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-6) * p["scale"] + p["bias"]
+
+
+def _attention(lp, x, num_heads):
+    b, s, d = x.shape
+    hd = d // num_heads
+
+    def heads(w):
+        return (x @ w).reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)
+
+    o = flash_attention(heads(lp["wq"]), heads(lp["wk"]), heads(lp["wv"]),
+                        causal=True)
+    return o.transpose(0, 2, 1, 3).reshape(b, s, d) @ lp["wo"]
+
+
+def _expert_fn(p, h):
+    return jax.nn.gelu(h @ p["wi"]) @ p["wo"]
+
+
+def apply_moe_lm(params: dict, tokens, axis_name: str, capacity: int,
+                 *, num_heads: int) -> Tuple[jax.Array, jax.Array]:
+    """Forward pass inside ``shard_map``; ``tokens`` is this device's
+    (batch, seq) shard, ``num_heads`` the static head count used at init.
+    Returns (logits, mean auxiliary load-balance loss)."""
+    shared = params["shared"]
+    b, s = tokens.shape
+    x = shared["token_embed"][tokens] + shared["pos_embed"][None, :s, :]
+
+    aux_total = 0.0
+    for lp, xp in zip(shared["layers"], params["experts"]["layers"]):
+        x = x + _attention(lp, _layer_norm(lp["ln1"], x), num_heads)
+        h = _layer_norm(lp["ln2"], x)
+        flat = h.reshape(b * s, -1)
+        y, probs = ep_mod.switch_moe(
+            flat, flat @ lp["gate"], _expert_fn, xp, axis_name, capacity)
+        aux_total = aux_total + ep_mod.load_balance_loss(
+            probs, axis_name=axis_name)
+        x = x + y.reshape(b, s, -1)
+
+    x = _layer_norm(shared["final_ln"], x)
+    logits = x @ shared["token_embed"].T
+    n_layers = len(shared["layers"])
+    return logits, aux_total / n_layers
+
+
+def moe_lm_loss(params, tokens, axis_name: str, capacity: int, *,
+                num_heads: int, aux_weight: float = 0.01):
+    """Next-token loss + auxiliary balance loss, averaged over the EP/data
+    axis (inside shard_map)."""
+    import optax
+
+    logits, aux = apply_moe_lm(params, tokens, axis_name, capacity,
+                               num_heads=num_heads)
+    lm = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]).mean()
+    return lax.pmean(lm, axis_name) + aux_weight * aux
