@@ -1,10 +1,12 @@
-"""BERT masked-LM pretraining — the allgather/sparse acceptance workload.
+"""BERT masked-LM pretraining (BASELINE config #5's model shape).
 
-BASELINE config #5 (BERT-Large-style allgather/sparse): the embedding-table
-gradient rides the sparse allgather path (hvd.SparseGrad) while the
-transformer body gradients allreduce densely. The reference's analogue is a
-TF BERT fine-tune where the embedding grad is an IndexedSlices (reference:
-horovod/tensorflow/__init__.py:64-75).
+Dense-gradient BERT MLM: the tied-embedding transformer differentiates
+through both the lookup and the output projection, so the table gradient
+is inherently dense here and rides the ordinary allreduce. For the
+*sparse* allgather embedding-gradient path the reference's IndexedSlices
+machinery maps to (reference: horovod/tensorflow/__init__.py:64-75), see
+``examples/jax_sparse_embedding.py`` — that workload uses an untied table
+through ``hvd.with_sparse_embedding_grad``.
 
     python examples/jax_bert_mlm.py --model base --seq 128
 """

@@ -137,8 +137,20 @@ def DistributedOptimizer(
 
     tx = optax.GradientTransformationExtraArgs(init_fn, update_fn)
     if backward_passes_per_step > 1:
-        tx = optax.MultiSteps(tx, every_k_schedule=backward_passes_per_step)
-        return optax.GradientTransformationExtraArgs(tx.init, tx.update)
+        multi = optax.MultiSteps(tx, every_k_schedule=backward_passes_per_step)
+
+        def accum_update(grads, opt_state, params=None, **extra):
+            # MultiSteps accumulates into a dense zeros_like(params) tree,
+            # so SparseGrad leaves must densify before accumulation (the
+            # sparse wire saving doesn't combine with accumulate-then-
+            # exchange; correctness first).
+            grads = jax.tree_util.tree_map(
+                lambda g: sparse_mod.densify_leaf(g)
+                if sparse_mod.is_sparse(g) else g,
+                grads, is_leaf=sparse_mod.is_sparse)
+            return multi.update(grads, opt_state, params, **extra)
+
+        return optax.GradientTransformationExtraArgs(multi.init, accum_update)
     return tx
 
 

@@ -33,6 +33,7 @@ from horovod_tpu.run import util
 from horovod_tpu.run.rendezvous import RendezvousServer
 from horovod_tpu.run.service import (
     BasicService,
+    ErrorResponse,
     OkResponse,
     ServiceClient,
 )
@@ -45,6 +46,9 @@ class RegisterSparkTaskRequest:
     index: int
     host_hash: str
     ip: str
+    # a free TCP port probed on the TASK's host — the coordinator must
+    # bind on rank 0's machine, so the driver cannot probe it
+    coord_port: int = 0
 
 
 @dataclasses.dataclass
@@ -71,9 +75,11 @@ class SparkDriverService(BasicService):
     def __init__(self, key: bytes, num_proc: int):
         super().__init__(key)
         self._num_proc = num_proc
-        self._registered: Dict[int, Tuple[str, str]] = {}  # idx -> (hash, ip)
+        # idx -> (host_hash, ip, coord_port)
+        self._registered: Dict[int, Tuple[str, str, int]] = {}
         self._task_env: Dict[int, Dict[str, str]] = {}
         self._results: Dict[int, Tuple[bool, str]] = {}
+        self._frozen = False  # set once ranks are allocated
         self._lock = threading.Lock()
         self.all_registered = threading.Event()
         self.all_results = threading.Event()
@@ -81,7 +87,17 @@ class SparkDriverService(BasicService):
     def _handle(self, req):
         if isinstance(req, RegisterSparkTaskRequest):
             with self._lock:
-                self._registered[req.index] = (req.host_hash, req.ip)
+                if self._frozen or req.index in self._registered:
+                    # A Spark task retry (speculation / executor loss)
+                    # arriving after allocation would silently join with a
+                    # stale environment and corrupt the rank layout —
+                    # fail it (and thereby the job) loudly instead.
+                    return ErrorResponse(
+                        f"task index {req.index} re-registered after the "
+                        "rank allocation was fixed; Spark retried a "
+                        "failed task — the whole job must be restarted")
+                self._registered[req.index] = (req.host_hash, req.ip,
+                                               req.coord_port)
                 if len(self._registered) == self._num_proc:
                     self.all_registered.set()
             return OkResponse()
@@ -105,11 +121,12 @@ class SparkDriverService(BasicService):
         spark/__init__.py:180-188)."""
         with self._lock:
             registered = dict(self._registered)
+            self._frozen = True
 
         by_host: Dict[str, List[int]] = {}
         host_order: List[str] = []
         for index in sorted(registered):
-            h, _ = registered[index]
+            h, _, _ = registered[index]
             if h not in by_host:
                 by_host[h] = []
                 host_order.append(h)
@@ -118,11 +135,12 @@ class SparkDriverService(BasicService):
         infos = [hosts_mod.HostInfo(h, len(by_host[h])) for h in host_order]
         slots = hosts_mod.allocate(infos, sum(i.slots for i in infos))
 
-        # rank 0's routable IP hosts the socket coordinator
+        # rank 0's routable IP hosts the socket coordinator, on a port the
+        # rank-0 TASK probed free on its own machine
         first_host = slots[0].hostname
         rank0_index = by_host[first_host][0]
         coord_ip = registered[rank0_index][1]
-        coord_port = _free_port_hint()
+        coord_port = registered[rank0_index][2] or _free_port_hint()
 
         index_to_rank: Dict[int, int] = {}
         taken: Dict[str, int] = {h: 0 for h in by_host}
@@ -174,7 +192,8 @@ def _make_mapper(driver_addrs, key, fn, args, kwargs, start_timeout):
     def _mapper(index, _iterator):
         client = ServiceClient(driver_addrs[0], key)
         client.call(RegisterSparkTaskRequest(
-            index, util.host_hash(), _my_ip(driver_addrs[0])))
+            index, util.host_hash(), _my_ip(driver_addrs[0]),
+            _free_port_hint()))
         timeout = util.Timeout(start_timeout,
                                "spark task waiting for allocation")
         while True:

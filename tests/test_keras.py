@@ -138,3 +138,15 @@ class TestLrCallbacks:
         with _pytest.raises(ValueError, match="inject_hyperparams"):
             trainer.fit(images, labels, epochs=1, batch_size=8, verbose=0,
                         callbacks=[warmup])
+
+
+def test_accumulating_distributed_optimizer_not_double_wrapped(hvd):
+    """DistributedOptimizer(backward_passes_per_step>1) must be detected
+    as already-distributed (its update closure lives in dp.py)."""
+    import optax
+    from horovod_tpu.keras import _is_distributed
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                   backward_passes_per_step=2)
+    assert _is_distributed(opt)
+    assert not _is_distributed(optax.sgd(0.1))

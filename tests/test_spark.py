@@ -146,3 +146,57 @@ class TestSparkRun:
     def test_requires_pyspark(self):
         with pytest.raises(RuntimeError, match="pyspark"):
             run(lambda: None, num_proc=1)
+
+
+class TestSparkRetrySafety:
+    def test_reregistration_after_allocation_rejected(self):
+        """A Spark task retry arriving after ranks are fixed must fail the
+        job loudly, not silently rejoin with a stale environment."""
+        key = util.make_secret_key()
+        driver = SparkDriverService(key, num_proc=2)
+        try:
+            addr = ("127.0.0.1", driver.port)
+            for index in range(2):
+                ServiceClient(addr, key).call(
+                    RegisterSparkTaskRequest(index, f"h{index}",
+                                             "127.0.0.1", 30000 + index))
+            assert driver.all_registered.wait(5)
+            driver.allocate({})
+            with pytest.raises(RuntimeError, match="re-registered"):
+                ServiceClient(addr, key).call(
+                    RegisterSparkTaskRequest(0, "h0", "127.0.0.1", 30000))
+        finally:
+            driver.shutdown()
+
+    def test_duplicate_registration_rejected(self):
+        key = util.make_secret_key()
+        driver = SparkDriverService(key, num_proc=2)
+        try:
+            addr = ("127.0.0.1", driver.port)
+            ServiceClient(addr, key).call(
+                RegisterSparkTaskRequest(0, "h0", "127.0.0.1", 30000))
+            with pytest.raises(RuntimeError, match="re-registered"):
+                ServiceClient(addr, key).call(
+                    RegisterSparkTaskRequest(0, "h0-retry", "127.0.0.1",
+                                             30001))
+        finally:
+            driver.shutdown()
+
+    def test_coord_port_comes_from_rank0_task(self):
+        key = util.make_secret_key()
+        driver = SparkDriverService(key, num_proc=2)
+        try:
+            addr = ("127.0.0.1", driver.port)
+            ServiceClient(addr, key).call(
+                RegisterSparkTaskRequest(0, "hA", "10.0.0.5", 41234))
+            ServiceClient(addr, key).call(
+                RegisterSparkTaskRequest(1, "hB", "10.0.0.6", 45678))
+            assert driver.all_registered.wait(5)
+            driver.allocate({})
+            env0 = ServiceClient(addr, key).call(SparkTaskInfoRequest(0)).env
+            # rank 0 lives on the first-registered host; its own probed
+            # port (and its routable IP) become the coordinator address
+            assert env0["HOROVOD_GLOO_RENDEZVOUS_PORT"] == "41234"
+            assert env0["HOROVOD_GLOO_RENDEZVOUS_ADDR"] == "10.0.0.5"
+        finally:
+            driver.shutdown()

@@ -169,3 +169,31 @@ class TestSparseGrad:
         np.testing.assert_allclose(np.asarray(f(table, ids, labels)),
                                    np.asarray(jax.grad(dense_loss)(table)),
                                    atol=1e-6)
+
+    def test_sparse_with_gradient_accumulation(self, hvd):
+        """backward_passes_per_step > 1 densifies SparseGrad leaves before
+        MultiSteps accumulation; two accumulated sparse micro-steps equal
+        one dense step on the summed gradient."""
+        rng = np.random.RandomState(5)
+        table0 = jnp.asarray(rng.rand(VOCAB, DIM).astype(np.float32))
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                       backward_passes_per_step=2)
+
+        def micro(table, opt_state, ids, labels):
+            _, sg = hvd.with_sparse_embedding_grad(_loss)(table, ids, labels)
+            updates, opt_state = opt.update(sg, opt_state, table)
+            return optax.apply_updates(table, updates), opt_state
+
+        step = jax.jit(jax.shard_map(
+            micro, mesh=hvd.mesh(),
+            in_specs=(P(), P(), P(hvd.GLOBAL_AXES), P(hvd.GLOBAL_AXES)),
+            out_specs=(P(), P()), check_vma=False))
+
+        ids1, labels1 = _batch(rng, 16)
+        ids2, labels2 = _batch(rng, 16)
+        table, opt_state = table0, opt.init(table0)
+        table, opt_state = step(table, opt_state, ids1, labels1)
+        np.testing.assert_allclose(np.asarray(table), np.asarray(table0),
+                                   atol=1e-7)  # first micro-step: no update
+        table, opt_state = step(table, opt_state, ids2, labels2)
+        assert np.abs(np.asarray(table) - np.asarray(table0)).max() > 1e-6
