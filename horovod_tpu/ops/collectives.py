@@ -145,7 +145,28 @@ def clear_compiled_cache() -> None:
 
 
 def _replicated(mesh):
-    return NamedSharding(mesh, P())
+    return mesh_mod.replicated_sharding(mesh)
+
+
+_noname_counters: dict = {}
+
+
+def _auto_name(kind: str) -> str:
+    """Call-order names for unnamed eager ops in multi-process mode —
+    ranks match tensors by identical call sequence, exactly the
+    reference's unnamed-op convention (reference: torch/mpi_ops.py
+    'allreduce.noname.<handle>' naming)."""
+    n = _noname_counters.get(kind, 0) + 1
+    _noname_counters[kind] = n
+    return f"{kind}.noname.{n}"
+
+
+def _socket_world(st) -> bool:
+    """True when this process is one rank of a multi-process world whose
+    data plane is the enqueue runtime (the world is larger than the local
+    mesh and jax.distributed isn't forming a global mesh) — a plain local
+    array must NOT be treated as replicated there."""
+    return st.size > st.mesh.size and jax.process_count() == 1
 
 
 def _reduce_stacked_fn(mesh, op: int):
@@ -353,20 +374,33 @@ def allreduce(
         elif red_op == Max:
             out = lax.pmax(tensor_c, axes)
         elif red_op == Product:
-            # Sign/zero-correct log-sum-exp product: exp(psum(log|x|))
-            # NaN-poisons on negatives and mishandles zeros, so track sign
-            # parity and zero presence through separate psums (all outputs
-            # statically replicated, unlike a gather+prod).
-            xf = tensor_c.astype(jnp.float32) if jnp.issubdtype(
-                tensor_c.dtype, jnp.integer) else tensor_c
-            magnitude = jnp.exp(lax.psum(
-                jnp.log(jnp.where(xf == 0, 1.0, jnp.abs(xf))), axes))
-            neg_parity = lax.psum((xf < 0).astype(jnp.int32), axes) % 2
-            any_zero = lax.psum((xf == 0).astype(jnp.int32), axes) > 0
-            signed = jnp.where(neg_parity == 1, -magnitude, magnitude)
-            out = jnp.where(any_zero, jnp.zeros_like(signed), signed)
             if jnp.issubdtype(tensor_c.dtype, jnp.integer):
-                out = jnp.round(out).astype(tensor_c.dtype)
+                # exact integer product: gather then multiply — the fp32
+                # log-sum-exp round trip is off by whole units once the
+                # product exceeds 2^24 (MPI_PROD is exact). The gathered
+                # result is device-varying to shard_map's replication
+                # checker, so re-broadcast it with a masked psum (device
+                # 0's exact value) to make replication static.
+                axes_t = tuple(axes) if isinstance(axes, (tuple, list)) \
+                    else (axes,)
+                gathered = lax.all_gather(tensor_c, axes_t)
+                prod = jnp.prod(gathered, axis=0)
+                flat_index = lax.axis_index(axes_t)
+                out = lax.psum(
+                    jnp.where(flat_index == 0, prod, jnp.zeros_like(prod)),
+                    axes_t)
+            else:
+                # Sign/zero-correct log-sum-exp product: exp(psum(log|x|))
+                # NaN-poisons on negatives and mishandles zeros, so track
+                # sign parity and zero presence through separate psums
+                # (all outputs statically replicated, unlike gather+prod).
+                xf = tensor_c
+                magnitude = jnp.exp(lax.psum(
+                    jnp.log(jnp.where(xf == 0, 1.0, jnp.abs(xf))), axes))
+                neg_parity = lax.psum((xf < 0).astype(jnp.int32), axes) % 2
+                any_zero = lax.psum((xf == 0).astype(jnp.int32), axes) > 0
+                signed = jnp.where(neg_parity == 1, -magnitude, magnitude)
+                out = jnp.where(any_zero, jnp.zeros_like(signed), signed)
         else:
             raise ValueError(f"unknown op {red_op}")
         return compression.decompress(out, ctx)
@@ -379,6 +413,18 @@ def allreduce(
             out = _hierarchical_reduce_stacked_fn(st.mesh, red_op)(x)
         else:
             out = _reduce_stacked_fn(st.mesh, red_op)(x)
+    elif _socket_world(st):
+        # Multi-process world with a plain local array: the data lives
+        # per-rank, so "replicated" math would silently return a
+        # local-only result — route through the named enqueue runtime
+        # (auto call-order name, like the reference's unnamed torch ops).
+        if red_op not in (Average, Sum):
+            raise NotImplementedError(
+                "multi-process allreduce over the host data plane supports "
+                "sum/average only")
+        return synchronize(allreduce_async(
+            tensor, average=average, op=op, compression=compression,
+            name=name or _auto_name("allreduce")))
     else:
         # Replicated: every worker holds the same value.
         if red_op in (Average, Min, Max):
@@ -400,14 +446,63 @@ def grouped_allreduce(
     compression=Compression.none,
     axis_name=None,
 ):
-    """Allreduce a list of tensors as one logical operation. Eager grouped
-    calls share one dispatch; in-jit, XLA fuses the psums. (Analogue of the
-    reference's tensor fusion for explicitly grouped calls.)"""
-    return [
-        allreduce(t, average=average, op=op, compression=compression,
-                  axis_name=axis_name)
-        for t in tensors
-    ]
+    """Allreduce a list of tensors as one logical operation (the analogue
+    of the reference's explicitly grouped fusion).
+
+    In-jit, XLA fuses the psums. Eager worker-stacked inputs of the same
+    dtype genuinely share one dispatch: they are flattened, concatenated
+    and reduced as one program, then split back. Everything else (plain
+    arrays, mixed cases) falls through to individual allreduce — in the
+    multi-process socket world those ride the runtime, whose tensor
+    fusion batches them anyway."""
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    if _is_tracer(tensors[0]):
+        return [allreduce(t, average=average, op=op, compression=compression,
+                          axis_name=axis_name) for t in tensors]
+
+    st = basics._ensure_init()
+    arrays = [t if isinstance(t, jax.Array) else jnp.asarray(t)
+              for t in tensors]
+    out: list = [None] * len(arrays)
+    groups: dict = {}
+    plain: list = []
+    for i, a in enumerate(arrays):
+        if _is_worker_stacked(a) and a.ndim >= 1:
+            groups.setdefault(str(a.dtype), []).append(i)
+        else:
+            plain.append(i)
+    if plain and _socket_world(st):
+        # multi-process: enqueue every plain tensor first so they are all
+        # in flight in the same cycle — the runtime's tensor fusion then
+        # batches them, matching the reference's grouped guarantee
+        handles = [(i, allreduce_async(
+            tensors[i], average=average, op=op, compression=compression,
+            name=_auto_name("grouped_allreduce"))) for i in plain]
+        for i, h in handles:
+            out[i] = synchronize(h)
+    else:
+        for i in plain:
+            out[i] = allreduce(tensors[i], average=average, op=op,
+                               compression=compression, axis_name=axis_name)
+    for idxs in groups.values():
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = allreduce(arrays[i], average=average, op=op,
+                               compression=compression, axis_name=axis_name)
+            continue
+        world = arrays[idxs[0]].shape[0]
+        flat = [arrays[i].reshape(world, -1) for i in idxs]
+        fused = allreduce(jnp.concatenate(flat, axis=1), average=average,
+                          op=op, compression=compression,
+                          axis_name=axis_name)
+        offset = 0
+        for i, f in zip(idxs, flat):
+            n = f.shape[1]
+            out[i] = fused[offset:offset + n].reshape(arrays[i].shape[1:])
+            offset += n
+    return out
 
 
 def allgather(tensor, name: Optional[str] = None, axis_name=None):
@@ -452,9 +547,14 @@ def allgather(tensor, name: Optional[str] = None, axis_name=None):
                 and _hierarchical_enabled(st)):
             return _hierarchical_gather_stacked_fn(st.mesh)(x)
         return _gather_stacked_fn(st.mesh)(x)
-    # Replicated: every worker contributes the same tensor.
     if x.ndim < 1:
         raise ValueError("allgather requires tensors of rank >= 1")
+    if _socket_world(st):
+        # Multi-process world: each rank holds its own tensor — ride the
+        # enqueue runtime rather than faking the concat locally.
+        return synchronize(allgather_async(
+            tensor, name=name or _auto_name("allgather")))
+    # Replicated: every worker contributes the same tensor.
     return jnp.concatenate([x] * st.size, axis=0)
 
 
@@ -473,7 +573,9 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None, axis_name=None
         flat_index = lax.axis_index(tuple(axes))
         masked = jnp.where(flat_index == root_rank, tensor,
                            jnp.zeros_like(tensor))
-        return lax.psum(masked, tuple(axes))
+        # psum promotes bool to int32 — restore the input dtype so
+        # jit/eager agree
+        return lax.psum(masked, tuple(axes)).astype(tensor.dtype)
 
     st = basics._ensure_init()
     if not 0 <= root_rank < st.size:
@@ -481,6 +583,10 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None, axis_name=None
     x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
     if _is_worker_stacked(x):
         return _bcast_stacked_fn(st.mesh, root_rank)(x)
+    if _socket_world(st):
+        # Multi-process world: the root's value must actually travel.
+        return synchronize(broadcast_async(
+            tensor, root_rank, name=name or _auto_name("broadcast")))
     if jax.process_count() > 1 and not (
             isinstance(x, jax.Array) and x.sharding.is_fully_replicated
             and len(x.sharding.device_set) == st.size):

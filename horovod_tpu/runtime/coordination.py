@@ -9,12 +9,18 @@ establishes: a key-value store shared by every process in the job.
 
 from __future__ import annotations
 
+import collections
 import pickle
 from typing import Optional
 
 import jax
 
 _counter = [0]
+# per-name sequence numbers: the KV store forbids overwriting a key, so a
+# reused broadcast name (e.g. checkpoint's resume-step broadcast every
+# restore) gets a fresh key each call — all processes increment in the
+# same call order, so the sequenced keys agree job-wide
+_name_seq: collections.defaultdict = collections.defaultdict(int)
 
 
 def _kv_client():
@@ -38,7 +44,8 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
     if name is None:
         _counter[0] += 1
         name = f"_hvd_bcast_{_counter[0]}"
-    key = f"horovod_tpu/{name}"
+    _name_seq[name] += 1
+    key = f"horovod_tpu/{name}.{_name_seq[name]}"
     from horovod_tpu.core import state as state_mod
 
     st = state_mod.global_state()

@@ -72,7 +72,9 @@ class Executor:
                 self._proc_mesh = Mesh(_np.array(firsts), ("proc",))
 
     def _replicated(self):
-        return NamedSharding(self.mesh, P())
+        from horovod_tpu.core import mesh as mesh_mod
+
+        return mesh_mod.replicated_sharding(self.mesh)
 
     def _fused_allreduce_program(self, shapes, dtype, average: bool,
                                  hierarchical: bool = False):
@@ -141,10 +143,9 @@ class Executor:
         collective_operations.cc:202-205).
         """
         name0 = entries[0].name if entries else "?"
-        if timeline is not None:
-            timeline.start(name0, response.response_type)
-
         try:
+            if timeline is not None:
+                timeline.start(name0, response.response_type)
             if response.response_type == types.ERROR:
                 status = types.Status.PreconditionError(response.error_message)
                 for e in entries:
@@ -320,8 +321,10 @@ class Executor:
                 blob, dtype=local.dtype).reshape(local.shape)
 
     def _execute_allreduce(self, response, entries, timeline=None) -> None:
-        stacked = [e for e in entries if collectives._is_worker_stacked(e.tensor)]
-        replicated = [e for e in entries if e not in stacked]
+        stacked, replicated = [], []
+        for e in entries:
+            (stacked if collectives._is_worker_stacked(e.tensor)
+             else replicated).append(e)
 
         # Replicated inputs need no collective: every worker already holds
         # the same value (single-controller invariant).

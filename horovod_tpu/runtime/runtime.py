@@ -62,6 +62,16 @@ class RuntimeHandle:
         return self._output
 
 
+def _fail_incomplete_entries(entries) -> None:
+    status = types.Status.Aborted("background cycle failed; see runtime log")
+    for e in entries:
+        cb = e.callback
+        handle = getattr(cb, "__self__", None)
+        done = handle.poll() if hasattr(handle, "poll") else False
+        if cb is not None and not done:
+            cb(status, None)
+
+
 class Runtime:
     """Owns the cycle thread, queue, controller and executor."""
 
@@ -199,6 +209,10 @@ class Runtime:
                 keep_going = getattr(self.controller, "net", None) is None
             if not keep_going:
                 break
+        # Every exit path (peer shutdown bit, transport failure, stop())
+        # must gate future enqueues — otherwise a framework thread can
+        # queue into the dead loop and hang forever in synchronize().
+        self._stop.set()
         self._finalize()
 
     def run_cycle(self) -> bool:
@@ -216,7 +230,21 @@ class Runtime:
         if not requests and getattr(self.controller, "net", None) is None \
                 and not self.controller._should_shut_down:
             return True
-        cycle_t0 = time.monotonic()
+        try:
+            return self._run_cycle_body(requests, cycle_t0=time.monotonic())
+        except Exception:
+            # The popped requests' entries would otherwise be stranded in
+            # the table with their handles never completing (and the names
+            # permanently poisoned for re-enqueue) — fail them loudly.
+            status = types.Status.Aborted(
+                "background cycle failed; see runtime log")
+            for e in self.queue.get_entries(
+                    [r.tensor_name for r in requests]):
+                if e.callback is not None:
+                    e.callback(status, None)
+            raise
+
+    def _run_cycle_body(self, requests, cycle_t0: float) -> bool:
         responses, shut_down = self.controller.compute_response_list(
             requests, self._st.config.fusion_threshold_bytes,
             timeline=self.timeline, stall_inspector=self.stall_inspector)
@@ -224,17 +252,26 @@ class Runtime:
         for response in responses:
             entries = self.queue.get_entries(response.tensor_names)
             if entries:
-                self.executor.execute(response, entries,
-                                      timeline=self.timeline)
-                if self._autotune_active:
-                    # JAX dispatch is async: block so the score measures
-                    # the collective itself, not host dispatch latency
-                    # (the reference scores completed-op wall time)
-                    jax.block_until_ready(
-                        [e.output for e in entries
-                         if e.output is not None])
-                    for e in entries:
-                        cycle_bytes += types.entry_nbytes(e)
+                try:
+                    self.executor.execute(response, entries,
+                                          timeline=self.timeline)
+                    if self._autotune_active:
+                        # JAX dispatch is async: block so the score
+                        # measures the collective itself, not host
+                        # dispatch latency (the reference scores
+                        # completed-op wall time)
+                        jax.block_until_ready(
+                            [e.output for e in entries
+                             if e.output is not None])
+                        for e in entries:
+                            cycle_bytes += types.entry_nbytes(e)
+                except Exception:
+                    # these entries left the table already — complete any
+                    # whose handle hasn't fired so callers error instead
+                    # of hanging (execute() handles its own failures; this
+                    # covers everything around it)
+                    _fail_incomplete_entries(entries)
+                    raise
         if self._autotune_active:
             self._autotune_sync(cycle_bytes, time.monotonic() - cycle_t0)
         return not shut_down

@@ -87,7 +87,7 @@ class SparkDriverService(BasicService):
     def _handle(self, req):
         if isinstance(req, RegisterSparkTaskRequest):
             with self._lock:
-                if self._frozen or req.index in self._registered:
+                if self._frozen:
                     # A Spark task retry (speculation / executor loss)
                     # arriving after allocation would silently join with a
                     # stale environment and corrupt the rank layout —
@@ -96,6 +96,8 @@ class SparkDriverService(BasicService):
                         f"task index {req.index} re-registered after the "
                         "rank allocation was fixed; Spark retried a "
                         "failed task — the whole job must be restarted")
+                # before allocation a retry may harmlessly re-register
+                # (last registration wins — its host is the real one)
                 self._registered[req.index] = (req.host_hash, req.ip,
                                                req.coord_port)
                 if len(self._registered) == self._num_proc:

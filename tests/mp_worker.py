@@ -191,6 +191,33 @@ def main():
             while time.time() < deadline and rt._thread.is_alive():
                 time.sleep(0.1)
             assert not rt._thread.is_alive(), "shutdown did not propagate"
+    elif scenario == "unnamed_eager":
+        # Unnamed eager collectives must really communicate in a
+        # multi-process world (auto call-order names through the runtime,
+        # like the reference's unnamed torch ops) — NOT return local-only
+        # "replicated" math.
+        out = hvd.allreduce(np.full((4,), float(rank), np.float32))
+        np.testing.assert_allclose(
+            np.asarray(out), np.mean(np.arange(world, dtype=np.float32)))
+        out = hvd.allreduce(np.full((4,), float(rank), np.float32),
+                            op=hvd.Sum)
+        np.testing.assert_allclose(
+            np.asarray(out), np.sum(np.arange(world, dtype=np.float32)))
+        g = hvd.allgather(np.array([float(rank)], np.float32))
+        np.testing.assert_allclose(
+            np.asarray(g), np.arange(world, dtype=np.float32))
+        b = hvd.broadcast(np.full((3,), float(rank), np.float32),
+                          root_rank=1)
+        np.testing.assert_allclose(np.asarray(b), 1.0)
+        # grouped: all tensors enqueue before any synchronize, so the
+        # runtime fuses them within one cycle
+        group = hvd.grouped_allreduce(
+            [np.full((k + 1,), float(rank), np.float32) for k in range(4)],
+            op=hvd.Sum)
+        for k, g in enumerate(group):
+            assert g.shape == (k + 1,)
+            np.testing.assert_allclose(
+                np.asarray(g), np.sum(np.arange(world, dtype=np.float32)))
     elif scenario == "fusion_stress":
         # Many named tensors of mixed sizes/dtypes in flight per cycle —
         # the fusion bin-packer and response cache under load (reference:

@@ -312,3 +312,58 @@ class TestAsyncHandles:
             np.testing.assert_allclose(
                 np.asarray(hvd.synchronize(h)),
                 sum(i * k for i in range(hvd.size())))
+
+
+class TestReviewRegressions:
+    def test_int_product_exact_in_jit(self, hvd_flat):
+        """Integer Product must be exact past 2^24 (fp32 log-sum-exp
+        rounds; the reference's MPI_PROD is exact)."""
+        from jax.sharding import PartitionSpec as P
+
+        vals = np.ones((8,), np.int32)
+        vals[0], vals[1] = 5003, 4999
+
+        def per_device(x):
+            return hvd_flat.allreduce(x[0], op=hvd_flat.Product)
+
+        # check_vma on: the result must be statically replicated
+        out = jax.jit(jax.shard_map(
+            per_device, mesh=hvd_flat.mesh(),
+            in_specs=P("local"), out_specs=P()))(jnp.asarray(vals))
+        assert int(out) == 5003 * 4999
+
+    def test_bool_broadcast_preserves_dtype_in_jit(self, hvd_flat):
+        from jax.sharding import PartitionSpec as P
+
+        masks = np.zeros((8, 4), bool)
+        masks[2] = [True, False, True, True]
+
+        def per_device(x):
+            return hvd_flat.broadcast(x[0], root_rank=2)
+
+        out = jax.jit(jax.shard_map(
+            per_device, mesh=hvd_flat.mesh(),
+            in_specs=P("local"), out_specs=P(), check_vma=False))(
+            jnp.asarray(masks))
+        assert out.dtype == jnp.bool_
+        np.testing.assert_array_equal(np.asarray(out), masks[2])
+
+    def test_grouped_allreduce_fused_matches_individual(self, hvd_flat):
+        n = hvd_flat.size()
+        rng = np.random.RandomState(0)
+        tensors = [
+            hvd_flat.stack_per_worker(
+                [rng.rand(3, 2).astype(np.float32) for _ in range(n)]),
+            hvd_flat.stack_per_worker(
+                [rng.rand(5).astype(np.float32) for _ in range(n)]),
+            hvd_flat.stack_per_worker(
+                [rng.randint(0, 9, (4,)).astype(np.int32)
+                 for _ in range(n)]),
+        ]
+        grouped = hvd_flat.grouped_allreduce(tensors, op=hvd_flat.Sum)
+        individual = [hvd_flat.allreduce(t, op=hvd_flat.Sum)
+                      for t in tensors]
+        for g, ind in zip(grouped, individual):
+            assert g.shape == ind.shape and g.dtype == ind.dtype
+            np.testing.assert_allclose(np.asarray(g), np.asarray(ind),
+                                       rtol=1e-6)

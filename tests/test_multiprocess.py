@@ -112,3 +112,13 @@ def test_fusion_stress_mixed_tensors(world):
     procs, outs = _launch("fusion_stress", world, timeout=150)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_unnamed_eager_collectives_communicate(world):
+    """Plain hvd.allreduce/allgather/broadcast (no name) in a
+    multi-process world must exchange data, not silently return local
+    values."""
+    procs, outs = _launch("unnamed_eager", world)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out

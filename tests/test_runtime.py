@@ -351,3 +351,41 @@ class TestStallInspector:
 
         insp = StallInspector(enabled=False, warning_time_seconds=0.0)
         assert insp.check(MessageTable()) is False
+
+
+class TestCycleFailureHandling:
+    def test_cycle_exception_fails_popped_entries(self, hvd_flat):
+        """An exception mid-cycle must complete the claimed handles with
+        an error, not strand them (reference: any rank failure surfaces,
+        never hangs)."""
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        rt = get_runtime()
+        original = rt.executor.execute
+        try:
+            def boom(*a, **k):
+                raise RuntimeError("injected executor failure")
+
+            rt.executor.execute = boom
+            h = rt.enqueue_allreduce("cycfail/x",
+                                     jnp.ones((4,), jnp.float32))
+            with pytest.raises(RuntimeError):
+                h.wait()
+            # the name is free again (not poisoned by a stranded entry)
+            rt.executor.execute = original
+            h2 = rt.enqueue_allreduce("cycfail/x",
+                                      jnp.ones((4,), jnp.float32))
+            out = h2.wait()
+            np.testing.assert_allclose(np.asarray(out), 1.0)
+        finally:
+            rt.executor.execute = original
+
+    def test_enqueue_after_loop_exit_raises(self, hvd_flat):
+        """Once the background loop exits (any path), new enqueues raise
+        SHUT_DOWN_ERROR instead of queueing into a dead loop."""
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        rt = get_runtime()
+        rt.stop()
+        with pytest.raises(RuntimeError):
+            rt.enqueue_allreduce("dead/x", jnp.ones((2,), jnp.float32))

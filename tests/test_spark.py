@@ -168,17 +168,23 @@ class TestSparkRetrySafety:
         finally:
             driver.shutdown()
 
-    def test_duplicate_registration_rejected(self):
+    def test_preallocation_retry_overwrites(self):
+        """Before ranks are allocated, a Spark retry may harmlessly
+        re-register — the latest registration (its real host) wins."""
         key = util.make_secret_key()
         driver = SparkDriverService(key, num_proc=2)
         try:
             addr = ("127.0.0.1", driver.port)
             ServiceClient(addr, key).call(
                 RegisterSparkTaskRequest(0, "h0", "127.0.0.1", 30000))
-            with pytest.raises(RuntimeError, match="re-registered"):
-                ServiceClient(addr, key).call(
-                    RegisterSparkTaskRequest(0, "h0-retry", "127.0.0.1",
-                                             30001))
+            ServiceClient(addr, key).call(
+                RegisterSparkTaskRequest(0, "h0-retry", "127.0.0.1", 30001))
+            ServiceClient(addr, key).call(
+                RegisterSparkTaskRequest(1, "h1", "127.0.0.1", 30002))
+            assert driver.all_registered.wait(5)
+            driver.allocate({})
+            env0 = ServiceClient(addr, key).call(SparkTaskInfoRequest(0)).env
+            assert env0["HOROVOD_HOSTNAME"] == "h0-retry"
         finally:
             driver.shutdown()
 
