@@ -1,0 +1,287 @@
+"""horovod_tpu.mxnet — MXNet-shaped binding for the TPU-native framework.
+
+Rebuild of the reference's MXNet API (reference: horovod/mxnet/__init__.py
+:40-125, horovod/mxnet/mpi_ops.py:53-232): ``DistributedOptimizer`` folds
+the world-size average into ``rescale_grad`` and allreduces gradients with
+per-index names and priority hints; ``DistributedTrainer`` does the same for
+Gluon; ``broadcast_parameters`` syncs a parameter dict from the root. The
+reference pushes async ops into the MXNet engine with write-var
+dependencies and a ``priority`` ordering hint — here the ops ride the same
+data plane as every other binding (XLA collectives / the dynamic enqueue
+runtime), and ``priority`` orders tensors within a runtime cycle.
+
+MXNet itself is EOL and not part of the TPU stack, so the binding is
+duck-typed: ops accept ``mx.nd.NDArray`` when MXNet is importable and any
+numpy-convertible mutable array otherwise, and ``DistributedOptimizer``
+wraps any object with MXNet's optimizer protocol (``rescale_grad``,
+``update(index, weight, grad, state)``). ``DistributedTrainer`` requires
+real Gluon and raises ``ImportError`` without it.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.core.basics import (  # noqa: F401 — re-exported lifecycle
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mesh,
+    is_homogeneous,
+    mpi_built,
+    gloo_built,
+    nccl_built,
+    ddl_built,
+    mlsl_built,
+    xla_built,
+    mpi_enabled,
+    mpi_threads_supported,
+)
+from horovod_tpu.core import basics
+from horovod_tpu.ops import collectives as _coll
+
+try:  # pragma: no cover — mxnet absent from the TPU image
+    import mxnet as _mx
+except ImportError:
+    _mx = None
+
+
+def _is_mx(tensor) -> bool:
+    return _mx is not None and isinstance(tensor, _mx.nd.NDArray)
+
+
+def _to_device(tensor):
+    if _is_mx(tensor):  # pragma: no cover — mxnet absent
+        return jnp.asarray(tensor.asnumpy())
+    return jnp.asarray(np.asarray(tensor))
+
+
+def _run_async(kind: str, tensor, *, average: bool = True,
+               root_rank: int = 0, name=None, priority: int = 0):
+    """Dispatch one collective on the shared data plane, returning a handle
+    for :func:`_coll.synchronize`. In a multi-process world the op enters
+    the enqueue runtime (negotiation + fusion + priority ordering) WITHOUT
+    blocking — callers that enqueue several tensors before synchronizing
+    get them negotiated and fused in the same cycle, the engine-async
+    behavior of the reference's MXNet ops. Single-controller worlds use
+    the eager XLA path where the replicated/stacked semantics already
+    hold (dispatch is still async — the result is a future-backed array).
+    """
+    st = basics._ensure_init()
+    x = _to_device(tensor)
+    if _coll._socket_world(st):
+        if kind == "allreduce":
+            return _coll.allreduce_async(
+                x, average=average,
+                name=name or _coll._auto_name("mx.allreduce"),
+                priority=priority)
+        if kind == "allgather":
+            return _coll.allgather_async(
+                x, name=name or _coll._auto_name("mx.allgather"),
+                priority=priority)
+        return _coll.broadcast_async(
+            x, root_rank, name=name or _coll._auto_name("mx.broadcast"),
+            priority=priority)
+    if kind == "allreduce":
+        return _coll.Handle(_coll.allreduce(x, average=average))
+    if kind == "allgather":
+        return _coll.Handle(_coll.allgather(x))
+    return _coll.Handle(_coll.broadcast(x, root_rank))
+
+
+def _run(kind: str, tensor, *, average: bool = True, root_rank: int = 0,
+         name=None, priority: int = 0):
+    return _coll.synchronize(_run_async(
+        kind, tensor, average=average, root_rank=root_rank, name=name,
+        priority=priority))
+
+
+def _check_mutable(tensor) -> None:
+    """Fail fast on misuse BEFORE the collective runs — an in-place op on
+    an immutable input would otherwise waste a full negotiation + dispatch
+    on every rank just to raise on write-back."""
+    if not (_is_mx(tensor) or isinstance(tensor, np.ndarray)):
+        raise TypeError(
+            "in-place collectives need a mutable array (numpy or "
+            f"mx.nd.NDArray), got {type(tensor)}")
+
+
+def _write_back(tensor, result) -> None:
+    if _is_mx(tensor):  # pragma: no cover — mxnet absent
+        tensor[:] = _mx.nd.array(np.asarray(result), dtype=tensor.dtype)
+        return
+    # output dtype == input dtype, as in the reference (the device compute
+    # may run narrower, e.g. f64 -> f32 with jax's default x64-off)
+    tensor[...] = np.asarray(result).astype(tensor.dtype).reshape(
+        tensor.shape)
+
+
+def _like(tensor, result):
+    out = np.asarray(result)
+    if _is_mx(tensor):  # pragma: no cover — mxnet absent
+        return _mx.nd.array(out, dtype=tensor.dtype)
+    return out.astype(np.asarray(tensor).dtype)
+
+
+def allreduce(tensor, average=True, name=None, priority=0):
+    """Average/sum ``tensor`` over all workers; input unmodified
+    (reference: horovod/mxnet/mpi_ops.py:53-93)."""
+    return _like(tensor, _run("allreduce", tensor, average=average,
+                              name=name, priority=priority))
+
+
+def allreduce_(tensor, average=True, name=None, priority=0):
+    """In-place allreduce (reference: horovod/mxnet/mpi_ops.py:95-127)."""
+    _check_mutable(tensor)
+    _write_back(tensor, _run("allreduce", tensor, average=average,
+                             name=name, priority=priority))
+    return tensor
+
+
+def allgather(tensor, name=None, priority=0):
+    """Concatenate each worker's tensor along dim 0 (reference:
+    horovod/mxnet/mpi_ops.py:129-166)."""
+    return _like(tensor, _run("allgather", tensor, name=name,
+                              priority=priority))
+
+
+def broadcast(tensor, root_rank, name=None, priority=0):
+    """Out-of-place broadcast from ``root_rank`` (reference:
+    horovod/mxnet/mpi_ops.py:168-206)."""
+    return _like(tensor, _run("broadcast", tensor, root_rank=root_rank,
+                              name=name, priority=priority))
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0):
+    """In-place broadcast (reference: horovod/mxnet/mpi_ops.py:208-232)."""
+    _check_mutable(tensor)
+    _write_back(tensor, _run("broadcast", tensor, root_rank=root_rank,
+                             name=name, priority=priority))
+    return tensor
+
+
+class DistributedOptimizer:
+    """Optimizer wrapper: allreduce gradients inside ``update`` with the
+    average folded into ``rescale_grad`` (reference:
+    horovod/mxnet/__init__.py:40-77 — "normalizing rescale_grad by size
+    is equivalent to performing average in allreduce").
+
+    Wraps any object with MXNet's optimizer protocol: a mutable
+    ``rescale_grad`` attribute and ``update(index, weight, grad, state)``.
+    """
+
+    def __init__(self, optimizer):
+        if isinstance(optimizer, DistributedOptimizer):
+            raise ValueError("optimizer is already a DistributedOptimizer")
+        self._optimizer = optimizer
+        self._optimizer.rescale_grad /= size()
+
+    def __getattr__(self, item):
+        if item == "_optimizer":  # not yet in __dict__ (e.g. unpickling)
+            raise AttributeError(item)
+        return getattr(self._optimizer, item)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_allreduce(self, index, grad):
+        if isinstance(index, (tuple, list)):
+            # Enqueue every gradient BEFORE synchronizing any, so in
+            # multi-process mode they all land in the same runtime cycle —
+            # negotiated together, priority-ordered, and fused (the
+            # reference gets this from MXNet's async engine push).
+            for g in grad:
+                _check_mutable(g)
+            handles = [
+                _run_async("allreduce", grad[i], average=False,
+                           name=str(index[i]), priority=-i)
+                for i in range(len(index))]
+            for g, h in zip(grad, handles):
+                _write_back(g, _coll.synchronize(h))
+        else:
+            allreduce_(grad, average=False, name=str(index))
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+if _mx is not None:  # pragma: no cover — mxnet absent from the TPU image
+
+    class DistributedTrainer(_mx.gluon.Trainer):
+        """Gluon trainer doing gradient exchange through the framework's
+        allreduce instead of kvstore push/pull (reference:
+        horovod/mxnet/__init__.py:85-107)."""
+
+        def __init__(self, params, optimizer, optimizer_params=None):
+            if isinstance(optimizer, DistributedOptimizer):
+                optimizer = optimizer._optimizer
+                warnings.warn(
+                    "DistributedTrainer does not take DistributedOptimizer "
+                    "as its optimizer. We have unwrapped it for you.")
+            super().__init__(params, optimizer,
+                             optimizer_params=optimizer_params, kvstore=None)
+            self._scale /= size()
+
+        def _allreduce_grads(self):
+            for i, param in enumerate(
+                    sorted(self._params, key=lambda p: p.name)):
+                if param.grad_req != "null":
+                    allreduce_(param.list_grad()[0], average=False,
+                               name=str(i), priority=-i)
+
+else:
+
+    class DistributedTrainer:  # type: ignore[no-redef]
+        """Placeholder: Gluon's Trainer needs real MXNet (reference:
+        horovod/mxnet/__init__.py:85-107). The optimizer-protocol surface
+        is covered by :class:`DistributedOptimizer`."""
+
+        def __init__(self, *args, **kwargs):
+            raise ImportError(
+                "DistributedTrainer requires mxnet, which is not "
+                "installed; use DistributedOptimizer (any MXNet-protocol "
+                "optimizer) or the jax/torch surfaces instead")
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast a parameter dict (name → array) in place from
+    ``root_rank`` (reference: horovod/mxnet/__init__.py:118-125; the
+    reference also hooks Gluon ``Parameter._init_impl`` — with real MXNet,
+    pass ``Block.collect_params()`` and each parameter's data is synced).
+    """
+    if _mx is not None and hasattr(params, "items") and all(
+            hasattr(p, "list_data") for p in
+            params.values()):  # pragma: no cover — ParameterDict w/ mxnet
+        tensors = {name: p.data() for name, p in params.items()}
+        for name, t in sorted(tensors.items()):
+            broadcast_(t, root_rank=root_rank, name=name)
+        return
+    if not hasattr(params, "items"):
+        raise ValueError(f"invalid params of type: {type(params)}")
+    for name, t in sorted(params.items()):
+        if t is None:
+            continue
+        broadcast_(t, root_rank=root_rank, name=name)

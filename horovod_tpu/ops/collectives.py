@@ -698,12 +698,14 @@ class Handle:
 
 
 def allreduce_async(tensor, average=None, name=None, op=None,
-                    compression=Compression.none):
+                    compression=Compression.none, priority=0):
     """Async allreduce. With a ``name``, the tensor enters the dynamic
     enqueue runtime — per-tensor negotiation, response cache and tensor
     fusion, the reference's core execution model (reference:
     operations.cc:736-768 EnqueueTensorAllreduce). Unnamed tensors dispatch
-    immediately (XLA's async dispatch already overlaps)."""
+    immediately (XLA's async dispatch already overlaps). ``priority``
+    orders runtime tensors within a cycle, highest first (reference:
+    horovod/mxnet/mpi_ops.py:52)."""
     if name is not None:
         red_op = _resolve_op(average, op)
         if red_op not in (Average, Sum):
@@ -714,30 +716,30 @@ def allreduce_async(tensor, average=None, name=None, op=None,
         x, ctx = compression.compress(
             tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor))
         handle = get_runtime().enqueue_allreduce(
-            name, x, average=(red_op == Average))
+            name, x, average=(red_op == Average), priority=priority)
         handle._decompress = (compression, ctx)  # applied in synchronize()
         return handle
     return Handle(allreduce(tensor, average=average, op=op,
                             compression=compression))
 
 
-def allgather_async(tensor, name=None):
+def allgather_async(tensor, name=None, priority=0):
     if name is not None:
         from horovod_tpu.runtime.runtime import get_runtime
 
         return get_runtime().enqueue_allgather(
             name, tensor if isinstance(tensor, jax.Array)
-            else jnp.asarray(tensor))
+            else jnp.asarray(tensor), priority=priority)
     return Handle(allgather(tensor))
 
 
-def broadcast_async(tensor, root_rank, name=None):
+def broadcast_async(tensor, root_rank, name=None, priority=0):
     if name is not None:
         from horovod_tpu.runtime.runtime import get_runtime
 
         return get_runtime().enqueue_broadcast(
             name, tensor if isinstance(tensor, jax.Array)
-            else jnp.asarray(tensor), root_rank)
+            else jnp.asarray(tensor), root_rank, priority=priority)
     return Handle(broadcast(tensor, root_rank))
 
 

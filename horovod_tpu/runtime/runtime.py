@@ -153,7 +153,8 @@ class Runtime:
 
     # -- enqueue APIs (reference: operations.cc:736-843) -------------------
     def _enqueue(self, request_type: str, name: str, tensor,
-                 root_rank: int = 0, average: bool = True) -> RuntimeHandle:
+                 root_rank: int = 0, average: bool = True,
+                 priority: int = 0) -> RuntimeHandle:
         if self._stop.is_set():
             raise RuntimeError(types.SHUT_DOWN_ERROR)
         handle = RuntimeHandle(name)
@@ -162,7 +163,7 @@ class Runtime:
             root_rank=root_rank, average=average,
             callback=handle._complete,
             dtype=str(tensor.dtype), shape=tuple(tensor.shape),
-            enqueue_time=time.monotonic())
+            enqueue_time=time.monotonic(), priority=priority)
         # The announced shape is the PER-WORKER tensor shape — for a
         # worker-stacked array that is shape[1:] (the wire protocol matches
         # what each process would announce in multi-process mode, and
@@ -180,17 +181,20 @@ class Runtime:
         self._woken.set()  # don't wait out the full cycle for new work
         return handle
 
-    def enqueue_allreduce(self, name: str, tensor,
-                          average: bool = True) -> RuntimeHandle:
-        return self._enqueue(types.ALLREDUCE, name, tensor, average=average)
+    def enqueue_allreduce(self, name: str, tensor, average: bool = True,
+                          priority: int = 0) -> RuntimeHandle:
+        return self._enqueue(types.ALLREDUCE, name, tensor, average=average,
+                             priority=priority)
 
-    def enqueue_allgather(self, name: str, tensor) -> RuntimeHandle:
-        return self._enqueue(types.ALLGATHER, name, tensor)
+    def enqueue_allgather(self, name: str, tensor,
+                          priority: int = 0) -> RuntimeHandle:
+        return self._enqueue(types.ALLGATHER, name, tensor,
+                             priority=priority)
 
-    def enqueue_broadcast(self, name: str, tensor,
-                          root_rank: int) -> RuntimeHandle:
+    def enqueue_broadcast(self, name: str, tensor, root_rank: int,
+                          priority: int = 0) -> RuntimeHandle:
         return self._enqueue(types.BROADCAST, name, tensor,
-                             root_rank=root_rank)
+                             root_rank=root_rank, priority=priority)
 
     # -- cycle loop (reference: RunLoopOnce, operations.cc:500-550) --------
     def _run_loop(self) -> None:

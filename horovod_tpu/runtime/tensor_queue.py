@@ -25,7 +25,8 @@ class TensorQueue:
     def __init__(self):
         self._lock = threading.Lock()
         self._table: Dict[str, types.TensorTableEntry] = {}
-        self._pending: List[msg.Request] = []
+        self._pending: List[tuple] = []  # (-priority, seq, request)
+        self._seq = 0
 
     def add(self, entry: types.TensorTableEntry, request: msg.Request) -> None:
         """reference: TensorQueue::AddToTensorQueue (tensor_queue.cc:18-36)."""
@@ -35,14 +36,18 @@ class TensorQueue:
                     types.DUPLICATE_NAME_ERROR_FMT.format(
                         op=entry.request_type.lower()))
             self._table[entry.name] = entry
-            self._pending.append(request)
+            self._pending.append((-entry.priority, self._seq, request))
+            self._seq += 1
 
     def pop_requests(self) -> List[msg.Request]:
-        """Drain pending negotiation messages for this cycle (reference:
-        PopMessagesFromQueue, controller.cc:68)."""
+        """Drain pending negotiation messages for this cycle, highest
+        priority first, enqueue order within a priority level (reference:
+        PopMessagesFromQueue, controller.cc:68; priority hint from the
+        mxnet binding's engine-ordering semantics,
+        horovod/mxnet/mpi_ops.py:52)."""
         with self._lock:
-            out, self._pending = self._pending, []
-            return out
+            pending, self._pending = self._pending, []
+        return [r for _, _, r in sorted(pending)]
 
     def get_entries(self, names: List[str]) -> List[types.TensorTableEntry]:
         """Remove and return entries for a (fused) response (reference:

@@ -101,6 +101,10 @@ class TensorTableEntry:
     dtype: Any = None
     shape: tuple = ()
     enqueue_time: float = 0.0
+    # execution-order hint: higher-priority tensors enter negotiation (and
+    # thus fusion) first within a cycle (reference: mxnet ops pass priority
+    # to the MXNet engine, horovod/mxnet/mpi_ops.py:52)
+    priority: int = 0
 
 
 def entry_nbytes(entry: "TensorTableEntry") -> int:

@@ -1,0 +1,169 @@
+"""MXNet-shaped binding tests — ops, optimizer wrapper, broadcasts.
+
+Mirrors the reference's mxnet binding semantics (reference:
+test/test_mxnet.py + horovod/mxnet/__init__.py:40-125): ops accept
+mutable arrays (numpy stands in for mx.nd.NDArray — mxnet is absent from
+the TPU stack by design), ``DistributedOptimizer`` folds the average into
+``rescale_grad`` and allreduces with per-index names and priorities.
+
+World model: single-controller 8-device mesh = 8 workers holding
+replicated values (average is identity, sum multiplies by world size).
+Priority *ordering* through the runtime is exercised in
+test_runtime.py; here the hints are exercised through the public API.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu.mxnet as hvd
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _world():
+    hvd.shutdown()
+    hvd.init(mesh_shape=(1, WORLD))
+    yield
+    hvd.shutdown()
+
+
+class TestOps:
+    def test_allreduce_average_identity(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = hvd.allreduce(x)
+        assert isinstance(out, np.ndarray)
+        assert out is not x
+        np.testing.assert_allclose(out, x)
+
+    def test_allreduce_sum(self):
+        x = np.ones((3, 2), np.float32)
+        out = hvd.allreduce(x, average=False, priority=5)
+        np.testing.assert_allclose(out, x * WORLD)
+
+    def test_allreduce_inplace_mutates(self):
+        x = np.ones(4, np.float32)
+        out = hvd.allreduce_(x, average=False)
+        assert out is x
+        np.testing.assert_allclose(x, np.full(4, WORLD, np.float32))
+
+    def test_allreduce_inplace_rejects_immutable(self):
+        with pytest.raises(TypeError):
+            hvd.allreduce_([1.0, 2.0])
+
+    def test_allgather(self):
+        x = np.arange(4, dtype=np.float32).reshape(2, 2)
+        out = hvd.allgather(x)
+        assert out.shape == (2 * WORLD, 2)
+        np.testing.assert_allclose(out[:2], x)
+
+    def test_broadcast_out_of_place(self):
+        x = np.arange(5, dtype=np.float32)
+        out = hvd.broadcast(x, root_rank=0)
+        assert out is not x
+        np.testing.assert_allclose(out, x)
+
+    def test_broadcast_inplace(self):
+        x = np.arange(5, dtype=np.float32)
+        out = hvd.broadcast_(x, root_rank=0, name="bp")
+        assert out is x
+
+    def test_broadcast_bad_root(self):
+        with pytest.raises(ValueError):
+            hvd.broadcast(np.ones(2, np.float32), root_rank=WORLD + 3)
+
+    def test_dtypes(self):
+        for dtype in [np.float32, np.float64, np.float16, np.int32,
+                      np.int64, np.uint8]:
+            x = np.ones(5, dtype=dtype)
+            out = hvd.allreduce(x, average=False)
+            assert out.dtype == dtype, dtype
+            np.testing.assert_array_equal(out, x * WORLD)
+
+
+class _FakeSGD:
+    """Minimal MXNet-optimizer-protocol object (rescale_grad + update)."""
+
+    def __init__(self, lr=0.1, rescale_grad=1.0):
+        self.lr = lr
+        self.rescale_grad = rescale_grad
+        self.updates = []
+
+    def update(self, index, weight, grad, state):
+        self.updates.append(index)
+        if isinstance(index, (tuple, list)):
+            # real MXNet optimizers accept list indices (mx.optimizer
+            # .Optimizer.update's multi-index form)
+            for w, g in zip(weight, grad):
+                w -= self.lr * self.rescale_grad * g
+        else:
+            weight -= self.lr * self.rescale_grad * grad
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+
+class TestDistributedOptimizer:
+    def test_rescale_grad_folds_average(self):
+        opt = hvd.DistributedOptimizer(_FakeSGD(rescale_grad=1.0))
+        assert opt.rescale_grad == pytest.approx(1.0 / WORLD)
+
+    def test_update_allreduces_and_applies(self):
+        """allreduce(sum) x rescale_grad/size == the distributed average,
+        exactly the reference's equivalence (horovod/mxnet/__init__.py:
+        44-46)."""
+        base = _FakeSGD(lr=1.0, rescale_grad=1.0)
+        opt = hvd.DistributedOptimizer(base)
+        w = np.full(3, 10.0, np.float32)
+        g = np.ones(3, np.float32)
+        opt.update(0, w, g, None)
+        # replicated world: summed grad = g * WORLD; update subtracts
+        # lr * (1/WORLD) * (g*WORLD) = g
+        np.testing.assert_allclose(w, np.full(3, 9.0, np.float32))
+        assert base.updates == [0]
+
+    def test_update_list_indices_named_by_index(self):
+        base = _FakeSGD(lr=1.0, rescale_grad=1.0)
+        opt = hvd.DistributedOptimizer(base)
+        ws = [np.full(2, 5.0, np.float32), np.full(2, 7.0, np.float32)]
+        gs = [np.ones(2, np.float32), 2 * np.ones(2, np.float32)]
+        opt.update_multi_precision([3, 4], ws, gs, [None, None])
+        np.testing.assert_allclose(ws[0], np.full(2, 4.0, np.float32))
+        np.testing.assert_allclose(ws[1], np.full(2, 5.0, np.float32))
+        assert base.updates == [[3, 4]]
+
+    def test_double_wrap_rejected(self):
+        opt = hvd.DistributedOptimizer(_FakeSGD())
+        with pytest.raises(ValueError):
+            hvd.DistributedOptimizer(opt)
+
+    def test_delegation(self):
+        opt = hvd.DistributedOptimizer(_FakeSGD(lr=0.5))
+        assert opt.lr == 0.5
+        opt.set_learning_rate(0.25)
+        assert opt._optimizer.lr == 0.25
+        assert opt.create_state_multi_precision(0, None) is None
+
+
+class TestTrainerAndBroadcast:
+    def test_trainer_needs_mxnet(self):
+        with pytest.raises(ImportError):
+            hvd.DistributedTrainer({}, _FakeSGD())
+
+    def test_broadcast_parameters_dict(self):
+        params = {"b": np.arange(3, dtype=np.float32),
+                  "a": np.ones((2, 2), np.float32),
+                  "skip": None}
+        hvd.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(params["b"],
+                                   np.arange(3, dtype=np.float32))
+
+    def test_broadcast_parameters_bad_type(self):
+        with pytest.raises(ValueError):
+            hvd.broadcast_parameters([np.ones(2)])

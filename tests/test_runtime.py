@@ -56,6 +56,19 @@ class TestTensorQueue:
         with pytest.raises(DuplicateNameError, match="same name"):
             q.add(types.TensorTableEntry(name="t", tensor=None), _req("t"))
 
+    def test_priority_orders_popped_requests(self):
+        """Higher priority drains first; enqueue order breaks ties
+        (reference: mxnet ops' engine priority hint,
+        horovod/mxnet/mpi_ops.py:52)."""
+        q = TensorQueue()
+        for name, prio in [("low", -1), ("first0", 0), ("high", 5),
+                           ("second0", 0)]:
+            q.add(types.TensorTableEntry(name=name, tensor=None,
+                                         priority=prio), _req(name))
+        assert [r.tensor_name for r in q.pop_requests()] == \
+            ["high", "first0", "second0", "low"]
+        assert q.pop_requests() == []
+
     def test_finalize_fires_callbacks(self):
         q = TensorQueue()
         statuses = []
