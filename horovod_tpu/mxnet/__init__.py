@@ -109,7 +109,9 @@ def _check_mutable(tensor) -> None:
     """Fail fast on misuse BEFORE the collective runs — an in-place op on
     an immutable input would otherwise waste a full negotiation + dispatch
     on every rank just to raise on write-back."""
-    if not (_is_mx(tensor) or isinstance(tensor, np.ndarray)):
+    if _is_mx(tensor):  # pragma: no cover — mxnet absent
+        return
+    if not (isinstance(tensor, np.ndarray) and tensor.flags.writeable):
         raise TypeError(
             "in-place collectives need a mutable array (numpy or "
             f"mx.nd.NDArray), got {type(tensor)}")
@@ -188,10 +190,9 @@ class DistributedOptimizer:
     def __getattr__(self, item):
         if item == "_optimizer":  # not yet in __dict__ (e.g. unpickling)
             raise AttributeError(item)
+        # delegates everything the wrapper doesn't override —
+        # create_state*, set_learning_rate, set_lr_mult, set_wd_mult, ...
         return getattr(self._optimizer, item)
-
-    def create_state_multi_precision(self, index, weight):
-        return self._optimizer.create_state_multi_precision(index, weight)
 
     def _do_allreduce(self, index, grad):
         if isinstance(index, (tuple, list)):
@@ -217,15 +218,6 @@ class DistributedOptimizer:
     def update_multi_precision(self, index, weight, grad, state):
         self._do_allreduce(index, grad)
         self._optimizer.update_multi_precision(index, weight, grad, state)
-
-    def set_learning_rate(self, lr):
-        self._optimizer.set_learning_rate(lr)
-
-    def set_lr_mult(self, args_lr_mult):
-        self._optimizer.set_lr_mult(args_lr_mult)
-
-    def set_wd_mult(self, args_wd_mult):
-        self._optimizer.set_wd_mult(args_wd_mult)
 
 
 if _mx is not None:  # pragma: no cover — mxnet absent from the TPU image
