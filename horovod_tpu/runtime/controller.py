@@ -28,7 +28,7 @@ from horovod_tpu.runtime import fusion
 from horovod_tpu.runtime import message as msg
 from horovod_tpu.runtime import types
 from horovod_tpu.runtime.response_cache import (CacheCoordinator, CacheState,
-                                                ResponseCache)
+                                                make_response_cache)
 from horovod_tpu.utils import logging as log
 
 
@@ -133,7 +133,7 @@ class Controller:
     def __init__(self, rank: int, world: int, cache_capacity: int = 1024):
         self.rank = rank
         self.world = world
-        self.cache = ResponseCache(cache_capacity)
+        self.cache = make_response_cache(cache_capacity)
         # Autotunable (reference: parameter_manager.h:225-228 tunes
         # cache_enabled). Toggled only via the synchronized parameter
         # broadcast so every worker flips at the same cycle boundary.

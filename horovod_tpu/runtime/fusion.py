@@ -16,7 +16,7 @@ XLA lays out in HBM itself; what survives from the reference is the
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -53,9 +53,9 @@ def _fusable(a: msg.Response, b: msg.Response,
     return (ra.dtype == rb.dtype and ra.average == rb.average)
 
 
-def fuse_responses(responses: List[msg.Response],
-                   request_by_name: Dict[str, msg.Request],
-                   threshold_bytes: int) -> List[msg.Response]:
+def fuse_responses_py(responses: List[msg.Response],
+                      request_by_name: Dict[str, msg.Request],
+                      threshold_bytes: int) -> List[msg.Response]:
     """Greedy bin-packing with look-ahead (reference: controller.cc:551-672).
 
     Walk the response list; accumulate joinable responses into the current
@@ -85,3 +85,68 @@ def fuse_responses(responses: List[msg.Response],
         remaining = skipped
         fused.append(msg.Response(types.ALLREDUCE, acc_names))
     return fused
+
+
+def fuse_responses_native(responses: List[msg.Response],
+                          request_by_name: Dict[str, msg.Request],
+                          threshold_bytes: int
+                          ) -> Optional[List[msg.Response]]:
+    """Same bin-packing executed by the C++ engine (cpp/cycle.cc hvc_fuse;
+    the reference keeps FuseResponses native). Returns None if the native
+    library is unavailable. Python precomputes per-response join keys
+    (dtype + reduction params) and byte counts; C++ returns index groups.
+    """
+    import ctypes
+
+    from horovod_tpu.runtime import native
+
+    try:
+        lib = native.load_library()
+    except native.NativeUnavailableError:
+        return None
+    n = len(responses)
+    is_ar = (ctypes.c_uint8 * n)()
+    key_id = (ctypes.c_int64 * n)()
+    nbytes = (ctypes.c_int64 * n)()
+    key_ids: Dict[tuple, int] = {}
+    for i, r in enumerate(responses):
+        if r.response_type == types.ALLREDUCE:
+            is_ar[i] = 1
+            req = request_by_name[r.tensor_names[0]]
+            key = (req.dtype, req.average)
+            key_id[i] = key_ids.setdefault(key, len(key_ids))
+            nbytes[i] = response_bytes(r, request_by_name)
+    cap = 2 * n
+    out = (ctypes.c_int32 * cap)()
+    w = lib.hvc_fuse(n, is_ar, key_id, nbytes, threshold_bytes, out, cap)
+    if w < 0:
+        return None
+    fused: List[msg.Response] = []
+    pos = 0
+    while pos < w:
+        count = out[pos]
+        idxs = [out[pos + 1 + j] for j in range(count)]
+        pos += 1 + count
+        if is_ar[idxs[0]]:
+            names: List[str] = []
+            for i in idxs:
+                names.extend(responses[i].tensor_names)
+            fused.append(msg.Response(types.ALLREDUCE, names))
+        else:
+            fused.append(responses[idxs[0]])
+    return fused
+
+
+def fuse_responses(responses: List[msg.Response],
+                   request_by_name: Dict[str, msg.Request],
+                   threshold_bytes: int) -> List[msg.Response]:
+    """Native bin-packing when available, Python otherwise (semantics are
+    identical — tests/test_native_cycle.py asserts it differentially)."""
+    from horovod_tpu.runtime.response_cache import native_cycle_enabled
+
+    if responses and native_cycle_enabled():
+        fused = fuse_responses_native(responses, request_by_name,
+                                      threshold_bytes)
+        if fused is not None:
+            return fused
+    return fuse_responses_py(responses, request_by_name, threshold_bytes)

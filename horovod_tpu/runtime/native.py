@@ -30,54 +30,67 @@ class NativeUnavailableError(RuntimeError):
     pass
 
 
+_lib_error: Optional[str] = None
+
+
 def load_library(build_if_missing: bool = True):
     """Load (building if needed) the native library; raises
-    NativeUnavailableError if no toolchain is available."""
-    global _lib
+    NativeUnavailableError if no toolchain is available. Failure is cached:
+    callers on the hot cycle path fall back to Python without re-running
+    make/dlopen every cycle."""
+    global _lib, _lib_error
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if build_if_missing:
-            # Always invoke make — a fresh build is a no-op, and a stale
-            # .so from before a source file was added would otherwise load
-            # with missing symbols. Simultaneously-launched workers race
-            # here; an fcntl lock serializes them (and the Makefile writes
-            # the .so atomically via tmp+rename) so nobody dlopens a
-            # half-written library.
-            try:
-                import fcntl
-
-                lock_path = os.path.join(_CPP_DIR, ".build_lock")
-                with open(lock_path, "w") as lock_file:
-                    fcntl.flock(lock_file, fcntl.LOCK_EX)
-                    try:
-                        subprocess.run(["make", "-C", _CPP_DIR],
-                                       check=True, capture_output=True,
-                                       timeout=120)
-                    finally:
-                        fcntl.flock(lock_file, fcntl.LOCK_UN)
-            except NativeUnavailableError:
-                raise
-            except Exception as exc:
-                if not os.path.exists(_LIB_PATH):
-                    raise NativeUnavailableError(
-                        f"could not build native transport: {exc}") from exc
-                # toolchain gone but a previously-built library exists —
-                # fall through and try to load it
+        if _lib_error is not None:
+            raise NativeUnavailableError(_lib_error)
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError as exc:
-            raise NativeUnavailableError(str(exc)) from exc
-
-        try:
-            _bind_symbols(lib)
-        except AttributeError as exc:
-            # stale library missing newer symbols and no toolchain to
-            # rebuild it
-            raise NativeUnavailableError(
-                f"stale native library {_LIB_PATH}: {exc}") from exc
-        _lib = lib
+            _lib = _load_locked(build_if_missing)
+        except NativeUnavailableError as exc:
+            _lib_error = str(exc)
+            raise
         return _lib
+
+
+def _load_locked(build_if_missing: bool):
+    if build_if_missing:
+        # Always invoke make — a fresh build is a no-op, and a stale
+        # .so from before a source file was added would otherwise load
+        # with missing symbols. Simultaneously-launched workers race
+        # here; an fcntl lock serializes them (and the Makefile writes
+        # the .so atomically via tmp+rename) so nobody dlopens a
+        # half-written library.
+        try:
+            import fcntl
+
+            lock_path = os.path.join(_CPP_DIR, ".build_lock")
+            with open(lock_path, "w") as lock_file:
+                fcntl.flock(lock_file, fcntl.LOCK_EX)
+                try:
+                    subprocess.run(["make", "-C", _CPP_DIR],
+                                   check=True, capture_output=True,
+                                   timeout=120)
+                finally:
+                    fcntl.flock(lock_file, fcntl.LOCK_UN)
+        except Exception as exc:
+            if not os.path.exists(_LIB_PATH):
+                raise NativeUnavailableError(
+                    f"could not build native transport: {exc}") from exc
+            # toolchain gone but a previously-built library exists —
+            # fall through and try to load it
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as exc:
+        raise NativeUnavailableError(str(exc)) from exc
+
+    try:
+        _bind_symbols(lib)
+    except AttributeError as exc:
+        # stale library missing newer symbols and no toolchain to
+        # rebuild it
+        raise NativeUnavailableError(
+            f"stale native library {_LIB_PATH}: {exc}") from exc
+    return lib
 
 
 def _bind_symbols(lib) -> None:
@@ -118,6 +131,32 @@ def _bind_symbols(lib) -> None:
         ctypes.c_void_p, ctypes.c_char, ctypes.c_int, ctypes.c_double,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
     lib.hvd_tl_close.argtypes = [ctypes.c_void_p]
+    # cycle engine: response cache + fusion (cycle.cc)
+    lib.hvc_cache_new.restype = ctypes.c_void_p
+    lib.hvc_cache_new.argtypes = [ctypes.c_int64]
+    lib.hvc_cache_free.argtypes = [ctypes.c_void_p]
+    lib.hvc_cache_cached.restype = ctypes.c_int
+    lib.hvc_cache_cached.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_int64]
+    lib.hvc_cache_put.restype = ctypes.c_int64
+    lib.hvc_cache_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_char_p, ctypes.c_int64]
+    lib.hvc_cache_bit_for_name.restype = ctypes.c_int64
+    lib.hvc_cache_bit_for_name.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.hvc_cache_get_len.restype = ctypes.c_int64
+    lib.hvc_cache_get_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.hvc_cache_get.restype = ctypes.c_int64
+    lib.hvc_cache_get.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                  ctypes.c_char_p, ctypes.c_int64]
+    lib.hvc_cache_invalidate.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.hvc_cache_size.restype = ctypes.c_int64
+    lib.hvc_cache_size.argtypes = [ctypes.c_void_p]
+    lib.hvc_fuse.restype = ctypes.c_int64
+    lib.hvc_fuse.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
 
 
 def native_built() -> bool:

@@ -15,8 +15,10 @@ takes the fast path, exactly like jit tracing caches a step program.
 
 from __future__ import annotations
 
+import ctypes
 import enum
 import heapq
+import os
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -80,7 +82,14 @@ class ResponseCache:
     def put(self, response: msg.Response, request: msg.Request) -> int:
         """Insert (or refresh) a single-tensor response; evicts LRU at
         capacity (reference: response_cache.cc:144-230). No-op at
-        capacity 0 (cache disabled via HOROVOD_CACHE_CAPACITY=0)."""
+        capacity 0 (cache disabled via HOROVOD_CACHE_CAPACITY=0).
+
+        Single-tensor responses only (fusion happens after cache replay,
+        never before) — enforced so the native engine, whose eviction
+        unmaps exactly one name per entry, stays in lockstep."""
+        if len(response.tensor_names) != 1:
+            raise ValueError(
+                "response cache stores single-tensor responses only")
         if self.capacity <= 0:
             return -1
         name = request.tensor_name
@@ -118,6 +127,95 @@ class ResponseCache:
 
     def __len__(self) -> int:
         return len(self._entries)
+
+
+def _pack_params_key(request: msg.Request) -> bytes:
+    """Deterministic byte form of the cache key for the native cache's
+    opaque comparison — derived from ``ResponseCache._params_key`` so the
+    two implementations can never disagree on what makes a key."""
+    return repr(ResponseCache._params_key(request)).encode()
+
+
+class NativeResponseCache:
+    """Same interface and exact semantics as :class:`ResponseCache`,
+    executed by the C++ engine (cpp/cycle.cc) — the reference keeps this
+    per-cycle path native (reference: response_cache.cc). Responses cross
+    the ABI as packed wire bytes (runtime/message.py), so the C++ side
+    stays schema-free. Differential parity with the Python implementation
+    is asserted by tests/test_native_cycle.py."""
+
+    def __init__(self, capacity: int = 1024):
+        from horovod_tpu.runtime import native
+
+        self.capacity = capacity
+        self._lib = native.load_library()
+        self._h = self._lib.hvc_cache_new(capacity)
+        if not self._h:
+            raise native.NativeUnavailableError("hvc_cache_new failed")
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.hvc_cache_free(h)
+
+    def cached(self, request: msg.Request) -> CacheState:
+        key = _pack_params_key(request)
+        state = self._lib.hvc_cache_cached(
+            self._h, request.tensor_name.encode(), key, len(key))
+        return CacheState(state)
+
+    def put(self, response: msg.Response, request: msg.Request) -> int:
+        if len(response.tensor_names) != 1:
+            raise ValueError(
+                "response cache stores single-tensor responses only")
+        if self.capacity <= 0:
+            return -1
+        key = _pack_params_key(request)
+        blob = response.pack()
+        return self._lib.hvc_cache_put(
+            self._h, request.tensor_name.encode(), key, len(key),
+            blob, len(blob))
+
+    def get_by_bit(self, bit: int) -> Optional[msg.Response]:
+        n = self._lib.hvc_cache_get_len(self._h, bit)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(n)
+        if self._lib.hvc_cache_get(self._h, bit, buf, n) < 0:
+            return None
+        return msg.Response.unpack(buf.raw)[0]
+
+    def bit_for_name(self, name: str) -> Optional[int]:
+        bit = self._lib.hvc_cache_bit_for_name(self._h, name.encode())
+        return None if bit < 0 else bit
+
+    def invalidate(self, name: str) -> None:
+        self._lib.hvc_cache_invalidate(self._h, name.encode())
+
+    def __len__(self) -> int:
+        return int(self._lib.hvc_cache_size(self._h))
+
+
+def native_cycle_enabled() -> bool:
+    """Native per-cycle engine knob: ``HOROVOD_NATIVE_CYCLE=0`` forces the
+    Python implementations (mirrors how the reference selects op backends
+    via env, utils/env_parser.cc)."""
+    return os.environ.get("HOROVOD_NATIVE_CYCLE", "1").lower() not in (
+        "0", "false", "off")
+
+
+def make_response_cache(capacity: int = 1024):
+    """Native cache when the library is available (built on demand), the
+    Python implementation otherwise. Only genuine unavailability falls
+    back — a bug in the native path must surface, not be masked."""
+    if native_cycle_enabled():
+        from horovod_tpu.runtime import native
+
+        try:
+            return NativeResponseCache(capacity)
+        except native.NativeUnavailableError:
+            pass
+    return ResponseCache(capacity)
 
 
 class CacheCoordinator:

@@ -1,0 +1,177 @@
+"""Differential tests: native cycle engine (cpp/cycle.cc) vs the Python
+reference implementations.
+
+The reference keeps the per-cycle hot path native (reference:
+horovod/common/response_cache.cc, controller.cc:551-672 FuseResponses);
+here the Python implementations define the semantics and the C++ engine
+must match them operation-for-operation — randomized sequences assert
+equal observable state (return values, bit numbering, LRU eviction order,
+fused groupings) at every step.
+"""
+
+import random
+
+import pytest
+
+from horovod_tpu.runtime import fusion, message as msg, types
+from horovod_tpu.runtime.native import native_built
+from horovod_tpu.runtime.response_cache import (CacheState,
+                                                NativeResponseCache,
+                                                ResponseCache)
+
+pytestmark = pytest.mark.skipif(not native_built(),
+                                reason="native library unavailable")
+
+
+def _req(name, rtype=types.ALLREDUCE, dtype="float32", shape=(4,), root=0,
+         average=True, rank=0):
+    return msg.Request(rank, rtype, name, dtype, shape, root, average)
+
+
+def _resp(req):
+    return msg.Response(req.request_type, [req.tensor_name])
+
+
+class TestCacheDifferential:
+    def _pair(self, capacity):
+        return ResponseCache(capacity), NativeResponseCache(capacity)
+
+    def test_basic_roundtrip(self):
+        py, nat = self._pair(4)
+        r = _req("a")
+        for c in (py, nat):
+            assert c.cached(r) == CacheState.MISS
+            bit = c.put(_resp(r), r)
+            assert bit == 0
+            assert c.cached(r) == CacheState.HIT
+            assert c.bit_for_name("a") == 0
+            got = c.get_by_bit(0)
+            assert got is not None and got.tensor_names == ["a"]
+            assert c.get_by_bit(7) is None
+            assert len(c) == 1
+
+    def test_params_change_is_invalid(self):
+        py, nat = self._pair(4)
+        r = _req("a", shape=(4,))
+        r2 = _req("a", shape=(8,))
+        for c in (py, nat):
+            c.put(_resp(r), r)
+            assert c.cached(r2) == CacheState.INVALID
+
+    def test_capacity_zero_disabled(self):
+        py, nat = self._pair(0)
+        r = _req("a")
+        for c in (py, nat):
+            assert c.put(_resp(r), r) == -1
+            assert c.cached(r) == CacheState.MISS
+            assert len(c) == 0
+
+    def test_randomized_sequences_agree(self):
+        rng = random.Random(0)
+        names = [f"t{i}" for i in range(12)]
+        dtypes = ["float32", "bfloat16"]
+        for trial in range(30):
+            py, nat = self._pair(capacity=rng.choice([1, 2, 3, 5, 8]))
+            for step in range(rng.randint(10, 60)):
+                op = rng.choice(["put", "cached", "get", "invalidate",
+                                 "bit", "len"])
+                name = rng.choice(names)
+                r = _req(name, dtype=rng.choice(dtypes),
+                         shape=(rng.choice([2, 4]),))
+                ctx = f"trial {trial} step {step} op {op} name {name}"
+                if op == "put":
+                    assert py.put(_resp(r), r) == nat.put(_resp(r), r), ctx
+                elif op == "cached":
+                    assert py.cached(r) == nat.cached(r), ctx
+                elif op == "get":
+                    bit = rng.randint(0, 8)
+                    a, b = py.get_by_bit(bit), nat.get_by_bit(bit)
+                    assert (a is None) == (b is None), ctx
+                    if a is not None:
+                        assert a.tensor_names == b.tensor_names, ctx
+                elif op == "invalidate":
+                    py.invalidate(name)
+                    nat.invalidate(name)
+                elif op == "bit":
+                    assert py.bit_for_name(name) == nat.bit_for_name(name), \
+                        ctx
+                else:
+                    assert len(py) == len(nat), ctx
+
+    def test_eviction_and_bit_reuse_order(self):
+        """Fill past capacity; the evicted (LRU) entry's bit must be
+        recycled lowest-first, identically on both sides."""
+        py, nat = self._pair(2)
+        for c in (py, nat):
+            assert c.put(_resp(_req("a")), _req("a")) == 0
+            assert c.put(_resp(_req("b")), _req("b")) == 1
+            # touch "a" so "b" is LRU
+            assert c.get_by_bit(0).tensor_names == ["a"]
+            assert c.put(_resp(_req("c")), _req("c")) == 1  # evicts b, bit 1
+            assert c.bit_for_name("b") is None
+            c.invalidate("a")
+            assert c.put(_resp(_req("d")), _req("d")) == 0  # reuses bit 0
+
+
+class TestFusionDifferential:
+    def _random_case(self, rng):
+        n = rng.randint(0, 14)
+        responses, reqs = [], {}
+        for i in range(n):
+            name = f"t{i}"
+            kind = rng.choice([types.ALLREDUCE, types.ALLREDUCE,
+                               types.ALLGATHER, types.BROADCAST,
+                               types.ERROR])
+            dtype = rng.choice(["float32", "bfloat16", "int32"])
+            shape = (rng.choice([1, 8, 64, 1024]),)
+            reqs[name] = _req(name, rtype=kind if kind != types.ERROR
+                              else types.ALLREDUCE, dtype=dtype, shape=shape,
+                              average=rng.choice([True, False]))
+            if kind == types.ERROR:
+                responses.append(msg.Response(types.ERROR, [name], "boom"))
+            elif kind == types.ALLGATHER:
+                responses.append(msg.Response(types.ALLGATHER, [name],
+                                              tensor_sizes=[1, 2]))
+            else:
+                responses.append(msg.Response(kind, [name]))
+        threshold = rng.choice([0, 64, 4096, 1 << 20])
+        return responses, reqs, threshold
+
+    def _assert_equal(self, a, b):
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            assert ra.response_type == rb.response_type
+            assert ra.tensor_names == rb.tensor_names
+            assert ra.error_message == rb.error_message
+            assert ra.tensor_sizes == rb.tensor_sizes
+
+    def test_randomized_agree(self):
+        rng = random.Random(1)
+        for _ in range(200):
+            responses, reqs, threshold = self._random_case(rng)
+            py = fusion.fuse_responses_py(list(responses), reqs, threshold)
+            nat = fusion.fuse_responses_native(list(responses), reqs,
+                                               threshold)
+            assert nat is not None
+            self._assert_equal(py, nat)
+
+    def test_lookahead_preserved(self):
+        """A stray non-joinable response between joinable ones must not
+        break the bin (the reference's look-ahead, controller.cc:595-650)."""
+        reqs = {
+            "a": _req("a", dtype="bfloat16", shape=(8,)),
+            "x": _req("x", dtype="float32", shape=(8,)),
+            "b": _req("b", dtype="bfloat16", shape=(8,)),
+        }
+        responses = [msg.Response(types.ALLREDUCE, [n]) for n in "axb"]
+        out = fusion.fuse_responses_native(responses, reqs, 1 << 20)
+        assert [r.tensor_names for r in out] == [["a", "b"], ["x"]]
+
+
+class TestControllerUsesNative:
+    def test_factory_prefers_native(self, monkeypatch):
+        from horovod_tpu.runtime.response_cache import make_response_cache
+
+        assert isinstance(make_response_cache(4), NativeResponseCache)
+        monkeypatch.setenv("HOROVOD_NATIVE_CYCLE", "0")
+        assert isinstance(make_response_cache(4), ResponseCache)
