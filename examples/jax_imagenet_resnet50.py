@@ -67,15 +67,18 @@ def main():
     params, stats, opt_state = (tree["params"], tree["batch_stats"],
                                 tree["opt_state"])
 
+    def synthetic_batches(n):
+        for _ in range(n):
+            yield (rng.rand(global_batch, 224, 224, 3).astype(np.float32),
+                   rng.randint(0, 1000, (global_batch,)).astype(np.int32))
+
     for epoch in range(start_epoch, args.epochs):
         losses = []
-        for _ in range(args.steps_per_epoch):
-            images = jax.device_put(
-                rng.rand(global_batch, 224, 224, 3).astype(np.float32),
-                sharding)
-            labels = jax.device_put(
-                rng.randint(0, 1000, (global_batch,)).astype(np.int32),
-                sharding)
+        # host batches stream to HBM a couple of steps ahead (the loader-
+        # worker overlap the reference gets from framework data loaders)
+        for images, labels in hvd.data.prefetch_to_device(
+                synthetic_batches(args.steps_per_epoch), size=2,
+                sharding=sharding):
             loss, params, stats, opt_state = step(
                 params, stats, opt_state, images, labels)
             losses.append(float(loss))

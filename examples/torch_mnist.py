@@ -7,6 +7,7 @@ and the rank-0 broadcast convention — gradients ride the XLA data plane.
 """
 
 import argparse
+import os
 
 import numpy as np
 import torch
@@ -57,15 +58,29 @@ def main():
     images = torch.tensor(rng.rand(1024, 1, 28, 28), dtype=torch.float32)
     labels = torch.tensor(rng.randint(0, 10, (1024,)), dtype=torch.long)
 
+    # Shard the dataset by rank, the reference's input convention
+    # (reference: examples/pytorch_mnist.py DistributedSampler with
+    # num_replicas=hvd.size(), rank=hvd.rank()). Torch data parallelism
+    # here is one worker per LAUNCHED PROCESS (tpurun); a single process
+    # — whatever its device count — is one data-parallel worker, so don't
+    # shard by device count in that case.
+    multiproc = os.environ.get("HOROVOD_RANK") is not None
+    data_world = hvd.size() if multiproc else 1
+    data_rank = hvd.rank() if multiproc else 0
+    dataset = torch.utils.data.TensorDataset(images, labels)
+    sampler = torch.utils.data.distributed.DistributedSampler(
+        dataset, num_replicas=data_world, rank=data_rank)
+    loader = torch.utils.data.DataLoader(
+        dataset, batch_size=args.batch_size, sampler=sampler)
+
     for epoch in range(args.epochs):
         model.train()
-        perm = torch.randperm(len(images))
+        sampler.set_epoch(epoch)
         losses = []
-        for i in range(0, len(images), args.batch_size):
-            idx = perm[i:i + args.batch_size]
+        for xb, yb in loader:
             optimizer.zero_grad()
-            output = model(images[idx])
-            loss = F.nll_loss(output, labels[idx])
+            output = model(xb)
+            loss = F.nll_loss(output, yb)
             loss.backward()
             optimizer.step()
             losses.append(loss.item())

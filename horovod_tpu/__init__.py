@@ -98,6 +98,7 @@ from horovod_tpu.parallel.ep import (
 )
 from horovod_tpu.ops.pallas import flash_attention
 from horovod_tpu import checkpoint
+from horovod_tpu import data
 
 __all__ = [
     "__version__",
@@ -132,4 +133,5 @@ __all__ = [
     "switch_moe", "load_balance_loss", "default_capacity",
     # checkpoint / resume (rank-0 save + broadcast restore)
     "checkpoint",
+    "data",
 ]

@@ -1,0 +1,171 @@
+"""Data module tests: rank-sharded sampling + device prefetch.
+
+The reference fixes the input convention in its examples
+(DistributedSampler with num_replicas=hvd.size(), rank=hvd.rank();
+reference: examples/pytorch_mnist.py) — ShardedSampler reproduces those
+semantics framework-free, and the torch integration is pinned against
+torch's own DistributedSampler.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.data import ShardedSampler, prefetch_to_device
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _world():
+    hvd.shutdown()
+    hvd.init(mesh_shape=(1, WORLD))
+    yield
+    hvd.shutdown()
+
+
+class TestShardedSampler:
+    def test_disjoint_and_complete(self):
+        n = 103  # not divisible by 8 — padding kicks in
+        shards = [list(ShardedSampler(n, WORLD, r, seed=3))
+                  for r in range(WORLD)]
+        lengths = {len(s) for s in shards}
+        assert lengths == {-(-n // WORLD)}  # equal ceil(n/world) everywhere
+        seen = [i for s in shards for i in s]
+        # padded by wrap-around: union covers the dataset exactly, with
+        # total_size - n duplicates
+        assert set(seen) == set(range(n))
+        assert len(seen) == -(-n // WORLD) * WORLD
+
+    def test_epoch_reshuffles_consistently(self):
+        s0 = ShardedSampler(64, WORLD, 0, seed=1)
+        s0b = ShardedSampler(64, WORLD, 0, seed=1)
+        e0 = list(s0)
+        s0.set_epoch(1)
+        assert list(s0) != e0  # reshuffled
+        s0b.set_epoch(1)
+        assert list(s0) == list(s0b)  # deterministic across workers
+
+    def test_no_shuffle_is_strided(self):
+        s = ShardedSampler(16, 4, 1, shuffle=False)
+        assert list(s) == [1, 5, 9, 13]
+
+    def test_defaults_from_world(self):
+        s = ShardedSampler(32)
+        assert s.num_replicas == WORLD and s.rank == hvd.rank()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ShardedSampler(10, 4, 4)
+        with pytest.raises(ValueError):
+            ShardedSampler(0)
+
+    def test_matches_torch_distributed_sampler_semantics(self):
+        """Shard lengths/coverage equal torch's DistributedSampler with the
+        reference's num_replicas/rank wiring (examples/pytorch_mnist.py)."""
+        torch = pytest.importorskip("torch")
+        from torch.utils.data.distributed import DistributedSampler
+
+        n = 50
+        dataset = list(range(n))
+        for r in range(4):
+            ts = DistributedSampler(dataset, num_replicas=4, rank=r,
+                                    shuffle=True, seed=9)
+            ts.set_epoch(2)
+            ours = ShardedSampler(n, 4, r, seed=9)
+            ours.set_epoch(2)
+            t_idx, o_idx = list(ts), list(ours)
+            assert len(t_idx) == len(o_idx)
+            assert set(t_idx) <= set(range(n))
+            assert set(o_idx) <= set(range(n))
+        # both cover the dataset across ranks
+        t_all = {i for r in range(4) for i in DistributedSampler(
+            dataset, num_replicas=4, rank=r, shuffle=True, seed=9)}
+        o_all = {i for r in range(4) for i in ShardedSampler(n, 4, r, seed=9)}
+        assert t_all == o_all == set(range(n))
+
+
+class TestPrefetch:
+    def test_order_and_values(self):
+        batches = [{"x": np.full((2,), i, np.float32)} for i in range(7)]
+        out = list(prefetch_to_device(iter(batches), size=3))
+        assert len(out) == 7
+        for i, b in enumerate(out):
+            import jax
+
+            assert isinstance(b["x"], jax.Array)
+            np.testing.assert_allclose(np.asarray(b["x"]), batches[i]["x"])
+
+    def test_sharded_placement(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(hvd.mesh(), P(hvd.GLOBAL_AXES))
+        batches = (np.arange(16, dtype=np.float32) + i for i in range(3))
+        out = list(prefetch_to_device(batches, size=2, sharding=sharding))
+        assert len(out) == 3
+        assert out[0].sharding == sharding
+
+    def test_source_error_propagates(self):
+        def bad():
+            yield np.zeros(2)
+            raise RuntimeError("boom")
+
+        it = prefetch_to_device(bad(), size=2)
+        next(it)
+        with pytest.raises(RuntimeError, match="boom"):
+            next(it)
+
+    def test_early_close_stops_worker(self):
+        import threading
+
+        produced = []
+
+        def src():
+            for i in range(1000):
+                produced.append(i)
+                yield np.zeros(1)
+
+        it = prefetch_to_device(src(), size=2)
+        next(it)
+        it.close()
+        n_after = len(produced)
+        import time
+
+        time.sleep(0.1)
+        # worker stopped: at most one more batch was in flight
+        assert len(produced) <= n_after + 1
+        assert threading.active_count() < 50
+
+    def test_train_loop_end_to_end(self):
+        """Sampler + prefetch feeding the global-batch train step."""
+        import jax.numpy as jnp
+        import optax
+
+        from horovod_tpu import training
+        from horovod_tpu.models.mnist import MnistConvNet
+
+        model = MnistConvNet()
+        opt = hvd.DistributedOptimizer(optax.sgd(0.05))
+        state = training.create_train_state(model, opt, (1, 28, 28, 1))
+        step, batch_sharding = training.make_train_step(model, opt)
+
+        rng = np.random.RandomState(0)
+        images = rng.rand(64, 28, 28, 1).astype(np.float32)
+        labels = rng.randint(0, 10, 64).astype(np.int32)
+        sampler = ShardedSampler(64, 1, 0, seed=0)  # global-batch: one view
+
+        def batches():
+            idx = list(sampler)
+            for i in range(0, len(idx), 16):
+                take = idx[i:i + 16]
+                yield images[take], labels[take]
+
+        p, s, o = state.params, state.batch_stats, state.opt_state
+        losses = []
+        for xb, yb in prefetch_to_device(batches(), size=2,
+                                         sharding=batch_sharding):
+            loss, p, s, o = step(p, s, o, xb, yb)
+            losses.append(float(loss))
+        assert len(losses) == 4
+        assert np.isfinite(losses).all()
