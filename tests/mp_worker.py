@@ -191,6 +191,30 @@ def main():
             while time.time() < deadline and rt._thread.is_alive():
                 time.sleep(0.1)
             assert not rt._thread.is_alive(), "shutdown did not propagate"
+    elif scenario == "peer_death":
+        # A rank dying mid-training must fail the survivors' pending work
+        # loudly, never hang (reference: any rank failure aborts the job —
+        # gloo_run.py:256-262 at the launcher, SHUT_DOWN_ERROR to pending
+        # callbacks at the runtime, operations.cc:480-486).
+        h = hvd.allreduce_async(np.ones((4,), np.float32), name="pd/warm")
+        hvd.synchronize(h)  # world is healthy once
+        if rank == 1:
+            os._exit(17)  # abrupt death: no shutdown handshake, no atexit
+        import time
+
+        deadline = time.time() + 60
+        got_error = None
+        while time.time() < deadline and got_error is None:
+            try:
+                h = hvd.allreduce_async(
+                    np.ones((4,), np.float32), name=f"pd/{time.time_ns()}")
+                hvd.synchronize(h)
+                time.sleep(0.2)  # peer may not have died yet; retry
+            except (RuntimeError, TimeoutError) as e:
+                got_error = e
+        assert got_error is not None, \
+            "survivor never observed the peer's death"
+
     elif scenario == "unnamed_eager":
         # Unnamed eager collectives must really communicate in a
         # multi-process world (auto call-order names through the runtime,

@@ -105,6 +105,15 @@ def test_stall_triggers_global_shutdown():
         assert p.returncode == 0, out
 
 
+def test_peer_death_fails_survivors():
+    """An abruptly killed rank must surface as an error on the survivors,
+    not a hang (reference: launcher kills the job on any rank failure,
+    gloo_run.py:256-262; pending callbacks get SHUT_DOWN_ERROR)."""
+    procs, outs = _launch("peer_death", 2, timeout=120)
+    assert procs[1].returncode == 17, outs[1]  # the planted death
+    assert procs[0].returncode == 0, outs[0]   # survivor observed an error
+
+
 @pytest.mark.parametrize("world", [2, 3])
 def test_fusion_stress_mixed_tensors(world):
     """60 mixed-size/dtype named tensors per cycle, submitted in different
