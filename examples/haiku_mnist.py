@@ -1,0 +1,95 @@
+"""MNIST with dm-haiku — the framework-agnostic JAX surface.
+
+The reference binds each framework separately (TF/torch/MXNet/Keras);
+here the primary surface is JAX itself, so any JAX model library works
+unmodified. This example drives a haiku ``transform`` through the same
+canonical pattern as every other example (reference: SURVEY.md §2.8):
+init → scale LR by size → wrap the optimizer → broadcast initial
+parameters → shard the batch → train.
+
+Run single-host:     python examples/haiku_mnist.py
+Run under tpurun:    tpurun -np 4 python examples/haiku_mnist.py
+"""
+
+import argparse
+
+import haiku as hk
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+
+
+def net_fn(images):
+    x = images.reshape((images.shape[0], -1))
+    return hk.Sequential([
+        hk.Linear(256), jax.nn.relu,
+        hk.Linear(128), jax.nn.relu,
+        hk.Linear(10),
+    ])(x)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=64,
+                        help="per-worker batch size")
+    parser.add_argument("--lr", type=float, default=0.001)
+    args = parser.parse_args()
+
+    hvd.init()
+    net = hk.without_apply_rng(hk.transform(net_fn))
+    opt = hvd.DistributedOptimizer(optax.adam(args.lr * hvd.size()))
+
+    rng = np.random.RandomState(1234)
+    images = rng.rand(2048, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, (2048,)).astype(np.int32)
+
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt_state = opt.init(params)
+
+    mesh = hvd.mesh()
+    batch_sharding = NamedSharding(mesh, P(hvd.GLOBAL_AXES))
+    repl = NamedSharding(mesh, P())
+
+    def train_step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = net.apply(p, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    step = jax.jit(train_step,
+                   in_shardings=(repl, repl, batch_sharding, batch_sharding),
+                   out_shardings=(repl, repl, repl),
+                   donate_argnums=(0, 1))
+
+    global_batch = args.batch_size * hvd.size()
+    sampler = hvd.data.ShardedSampler(len(images), 1, 0, seed=0)
+    for epoch in range(args.epochs):
+        sampler.set_epoch(epoch)
+        idx = np.asarray(list(sampler))
+        losses = []
+
+        def batches():
+            for i in range(0, len(idx) - global_batch + 1, global_batch):
+                take = idx[i:i + global_batch]
+                yield images[take], labels[take]
+
+        for xb, yb in hvd.data.prefetch_to_device(
+                batches(), size=2, sharding=batch_sharding):
+            loss, params, opt_state = step(params, opt_state, xb, yb)
+            losses.append(float(loss))
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
+
+
+if __name__ == "__main__":
+    main()
