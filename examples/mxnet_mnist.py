@@ -1,0 +1,118 @@
+"""MNIST through the MXNet-shaped binding.
+
+Maps the reference's mxnet example (reference: examples/mxnet_mnist.py:
+DistributedOptimizer wrapping an MXNet optimizer, rescale_grad folding,
+broadcast_parameters after init) onto the TPU-native stack. With real
+MXNet installed the ops take mx.nd.NDArrays; without it (the TPU image)
+the same API runs on mutable numpy arrays — this example uses the
+protocol form so it runs anywhere.
+
+Run single-host:     python examples/mxnet_mnist.py
+Run under tpurun:    tpurun -np 4 python examples/mxnet_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu.mxnet as hvd
+
+
+class SGD:
+    """MXNet optimizer protocol: rescale_grad + update(index, w, g, state)
+    (what mx.optimizer.SGD exposes; DistributedOptimizer folds the world
+    average into rescale_grad, reference: horovod/mxnet/__init__.py:44-46).
+    """
+
+    def __init__(self, learning_rate, rescale_grad=1.0):
+        self.lr = learning_rate
+        self.rescale_grad = rescale_grad
+
+    def update(self, index, weight, grad, state):
+        if isinstance(index, (tuple, list)):
+            for w, g in zip(weight, grad):
+                w -= self.lr * self.rescale_grad * g
+        else:
+            weight -= self.lr * self.rescale_grad * grad
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+
+def softmax(z):
+    z = z - z.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(1234)
+    images = rng.rand(1024, 784).astype(np.float32)
+    labels = rng.randint(0, 10, (1024,))
+
+    # two-layer MLP held as plain mutable arrays (the NDArray stand-in)
+    params = {
+        "w1": (rng.randn(784, 128) * 0.05).astype(np.float32),
+        "b1": np.zeros(128, np.float32),
+        "w2": (rng.randn(128, 10) * 0.05).astype(np.float32),
+        "b2": np.zeros(10, np.float32),
+    }
+    hvd.broadcast_parameters(params, root_rank=0)
+
+    # reference pattern: scale LR by size, wrap, let rescale_grad average
+    opt = hvd.DistributedOptimizer(SGD(args.lr * hvd.size()))
+
+    from horovod_tpu.data import ShardedSampler
+
+    sampler = ShardedSampler(len(images), seed=0)
+    names = sorted(params)
+    for epoch in range(args.epochs):
+        sampler.set_epoch(epoch)
+        idx = np.asarray(list(sampler))
+        losses = []
+        for i in range(0, len(idx), args.batch_size):
+            take = idx[i:i + args.batch_size]
+            x, y = images[take], labels[take]
+            # forward
+            h_pre = x @ params["w1"] + params["b1"]
+            h = np.maximum(h_pre, 0.0)
+            logits = h @ params["w2"] + params["b2"]
+            p = softmax(logits)
+            onehot = np.eye(10, dtype=np.float32)[y]
+            losses.append(-np.log(p[np.arange(len(y)), y] + 1e-9).mean())
+            # backward
+            dlogits = (p - onehot) / len(y)
+            grads = {
+                "w2": h.T @ dlogits,
+                "b2": dlogits.sum(0),
+            }
+            dh = (dlogits @ params["w2"].T) * (h_pre > 0)
+            grads["w1"] = x.T @ dh
+            grads["b1"] = dh.sum(0)
+            # one update call with list indices: gradients are enqueued
+            # together, negotiated + fused in one runtime cycle
+            opt.update_multi_precision(
+                list(range(len(names))),
+                [params[n] for n in names],
+                [grads[n] for n in names],
+                [None] * len(names))
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
+
+
+if __name__ == "__main__":
+    main()
