@@ -242,6 +242,36 @@ def main():
             assert g.shape == (k + 1,)
             np.testing.assert_allclose(
                 np.asarray(g), np.sum(np.arange(world, dtype=np.float32)))
+    elif scenario == "cache_churn":
+        # Tiny cache capacity + periodically changing shapes: constant
+        # evictions (LRU bit recycling) and synchronized invalidations
+        # while ranks submit in different orders. Any cross-worker
+        # cache-bit misalignment — the invariant the native cache
+        # (cpp/cycle.cc) must uphold — corrupts results immediately
+        # (reference: response_cache.cc:232+ bit redistribution).
+        rng_order = np.random.RandomState(100 + rank)  # per-rank order
+        n_tensors = 12  # 3x the cache capacity set by the test
+        for rounds in range(12):
+            order = rng_order.permutation(n_tensors)
+            handles = {}
+            for t in order:
+                # every 4th round, tensor shapes shift -> INVALID ->
+                # synchronized invalidation + renegotiation
+                size = 3 + int(t) + (rounds // 4)
+                handles[int(t)] = hvd.allreduce_async(
+                    np.full((size,), float(rank + t), np.float32),
+                    name=f"cc/{t}", average=False)
+            for t, h in handles.items():
+                out = np.asarray(hvd.synchronize(h))
+                expect = np.full(
+                    (3 + t + (rounds // 4),),
+                    sum(r + t for r in range(world)), np.float32)
+                np.testing.assert_allclose(out, expect,
+                                           err_msg=f"round {rounds} t {t}")
+        from horovod_tpu.core import state
+        cache = state.global_state().runtime.controller.cache
+        assert len(cache) <= 4, len(cache)  # capacity respected
+
     elif scenario == "fusion_stress":
         # Many named tensors of mixed sizes/dtypes in flight per cycle —
         # the fusion bin-packer and response cache under load (reference:

@@ -105,6 +105,20 @@ def test_stall_triggers_global_shutdown():
         assert p.returncode == 0, out
 
 
+@pytest.mark.parametrize("world", [2, 3])
+@pytest.mark.parametrize("engine", ["1", "0"])  # native / python cycle
+def test_cache_churn_keeps_bits_aligned(world, engine):
+    """Evictions (capacity 4 << 12 tensors) + periodic shape changes +
+    skewed per-rank orders: cross-worker cache-bit alignment under churn,
+    on both cycle engines."""
+    procs, outs = _launch("cache_churn", world,
+                          extra_env={"HOROVOD_CACHE_CAPACITY": "4",
+                                     "HOROVOD_NATIVE_CYCLE": engine},
+                          timeout=240)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+
+
 def test_peer_death_fails_survivors():
     """An abruptly killed rank must surface as an error on the survivors,
     not a hang (reference: launcher kills the job on any rank failure,
