@@ -1,0 +1,25 @@
+# Test/dev image for horovod_tpu (reference: Dockerfile.test.cpu — the
+# reference bakes an mpirun-based test matrix into Docker images; here the
+# "distributed without a cluster" strategy is a virtual 8-device CPU mesh
+# plus real multi-process workers over the native TCP transport, so one
+# ordinary Python image covers the whole matrix).
+#
+# On a real TPU VM, install jax[tpu] instead of the CPU jax pinned here and
+# drop the XLA_FLAGS override.
+
+FROM python:3.13-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        build-essential make g++ openssh-client \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /horovod_tpu
+COPY . .
+
+RUN pip install --no-cache-dir "jax[cpu]" flax optax chex einops pytest \
+        torch --index-url https://pypi.org/simple \
+    && pip install --no-cache-dir -e . --no-deps
+
+# the test matrix: collective semantics, fusion, caching, error paths on a
+# fake 8-device mesh + real multi-process workers (tests/conftest.py)
+CMD ["python", "-m", "pytest", "tests/", "-x", "-q"]
