@@ -29,3 +29,16 @@ def test_tpurun_binary_two_ranks(extra_args):
          "-np", "2", *extra_args, sys.executable, WORKER, "collectives"],
         capture_output=True, text=True, timeout=240, env=env)
     assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_tpurun_jit_train_global_mesh():
+    """Jitted train step over the jax.distributed global mesh with
+    per-process data: gradient averaging must be real cross-process
+    collectives (divergent parameters fail the in-worker check)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", sys.executable, WORKER, "jit_train"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
