@@ -189,6 +189,30 @@ def main():
         root_flat = np.asarray(hvd.synchronize(h))
         np.testing.assert_allclose(root_flat, flat, rtol=1e-6, atol=1e-7)
 
+    elif scenario == "keras":
+        # The keras-style Trainer under the launcher: fit/evaluate over
+        # the jax.distributed global mesh, metric averaging across ranks.
+        import jax as _jax
+        import optax
+
+        import horovod_tpu.keras as hvd_keras
+        from horovod_tpu import callbacks
+        from horovod_tpu.models.mnist import MnistConvNet
+
+        assert _jax.process_count() == world
+        rng = np.random.RandomState(0)  # same data everywhere
+        x = rng.rand(64, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 10, 64).astype(np.int32)
+        trainer = hvd_keras.Trainer(
+            MnistConvNet(), optax.sgd(0.05 * hvd.size()), (1, 28, 28, 1))
+        history = trainer.fit(
+            x, y, epochs=2, batch_size=32,
+            callbacks=[callbacks.MetricAverageCallback()])
+        assert len(history["loss"]) == 2
+        assert np.isfinite(history["loss"]).all()
+        metrics = trainer.evaluate(x, y)
+        assert np.isfinite(metrics["loss"])
+
     elif scenario == "shape_mismatch":
         # reference: error paths (test_tensorflow.py:314-384) — mismatched
         # shapes across ranks must error on every rank

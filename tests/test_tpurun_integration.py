@@ -31,6 +31,17 @@ def test_tpurun_binary_two_ranks(extra_args):
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+def test_tpurun_keras_trainer():
+    """Keras-style Trainer fit/evaluate under the launcher's global mesh."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", sys.executable, WORKER, "keras"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
 def test_tpurun_jit_train_global_mesh():
     """Jitted train step over the jax.distributed global mesh with
     per-process data: gradient averaging must be real cross-process
