@@ -1,0 +1,155 @@
+"""Hardware bandwidth probes seeding the autotuner.
+
+The north star for the TPU rebuild keeps the reference's response-cache /
+fusion-buffer / autotuner design "backed by TPU HBM and ICI bandwidth
+probes" (BASELINE.json; the reference itself starts from a fixed 64 MB
+threshold, reference: operations.cc:379). These probes measure the actual
+machine once at startup and turn the measurement into a principled initial
+fusion threshold: fuse at most what the interconnect can reduce within a
+set fraction of one cycle, so the first autotune samples start near the
+right region instead of at a hardware-blind constant.
+
+Timing protocol: K iterations chained inside ONE jitted program (data
+dependency between iterations), wall-clocked against a scalar readback —
+the only reliable protocol through remote-dispatch tunnels, where
+``block_until_ready`` can return early and repeated identical dispatches
+are served from a cache (see docs/benchmarks.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.core import mesh as mesh_mod
+
+
+def _timed_scalar(fn, *args) -> float:
+    """Wall-clock one compiled call ending in a scalar readback."""
+    t0 = time.perf_counter()
+    float(fn(*args))
+    return time.perf_counter() - t0
+
+
+def _per_iter_time(make_chain, x, lo: int, hi: int,
+                   repeats: int = 3) -> float:
+    """Difference-quotient timing: build chain(lo) and chain(hi), take
+    min over repeats of each, return (t_hi - t_lo) / (hi - lo). Cancels
+    the constant dispatch/readback overhead that dominates through
+    remote-dispatch tunnels (docs/benchmarks.md measurement protocol).
+    ``x`` stays a traced argument so XLA cannot constant-fold the chain.
+    """
+    hi = max(hi, lo + 1)
+    c_lo, c_hi = make_chain(lo), make_chain(hi)
+    _timed_scalar(c_lo, x)  # compile + warm
+    _timed_scalar(c_hi, x)
+    t_lo = min(_timed_scalar(c_lo, x) for _ in range(repeats))
+    t_hi = min(_timed_scalar(c_hi, x) for _ in range(repeats))
+    return max((t_hi - t_lo) / (hi - lo), 1e-9)
+
+
+def probe_hbm_bandwidth(size_mb: int = 64, iters: int = 16) -> float:
+    """Sustained single-device HBM copy bandwidth in GB/s (read + write).
+
+    A chained scale-by-~one copy: each iteration reads and writes the
+    buffer once, so bytes moved per iteration = 2 * size.
+    """
+    n = size_mb * (1 << 20) // 4
+    x = jnp.ones((n,), jnp.float32)
+    k = jnp.float32(1.0000001)
+
+    def make_chain(length):
+        @jax.jit
+        def chain(v):
+            def body(c, _):
+                return c * k, None
+
+            out, _ = jax.lax.scan(body, v, None, length=length)
+            return out[0]
+
+        return chain
+
+    dt = _per_iter_time(make_chain, x, max(1, iters // 4), iters)
+    return 2.0 * x.nbytes / dt / 1e9
+
+
+def probe_allreduce_bandwidth(mesh=None, size_mb: int = 32,
+                              iters: int = 8) -> float:
+    """Algorithm bandwidth (input bytes / time) of a full-mesh all-reduce
+    in GB/s — the ICI number that bounds fused-collective latency. On a
+    1-device mesh this degenerates to an HBM-bound pass, which is the
+    right bound there too."""
+    from horovod_tpu.core import basics
+
+    if mesh is None:
+        mesh = basics._ensure_init().mesh
+    n = size_mb * (1 << 20) // 4
+    repl = NamedSharding(mesh, P())
+    x = jax.device_put(jnp.ones((n,), jnp.float32), repl)
+    inv = jnp.float32(1.0 / mesh.size)
+
+    def make_chain(length):
+        @jax.jit
+        def chain(w):
+            def inner(v):
+                def step(c, _):
+                    s = jax.lax.psum(c, mesh_mod.GLOBAL_AXES)
+                    return s * inv, None
+
+                out, _ = jax.lax.scan(step, v, None, length=length)
+                return out
+
+            y = jax.shard_map(inner, mesh=mesh, in_specs=P(), out_specs=P(),
+                              check_vma=False)(w)
+            return y[0]
+
+        return chain
+
+    dt = _per_iter_time(make_chain, x, max(1, iters // 4), iters)
+    return x.nbytes / dt / 1e9
+
+
+def recommended_fusion_threshold(allreduce_gbps: float,
+                                 cycle_time_ms: float,
+                                 cycle_fraction: float = 0.5,
+                                 floor_bytes: int = 1 << 20,
+                                 ceil_bytes: int = 256 << 20,
+                                 hbm_gbps: Optional[float] = None) -> int:
+    """Fusion threshold such that reducing one full fused buffer takes at
+    most ``cycle_fraction`` of a cycle at the probed bandwidth — big
+    enough to amortize launch overhead, small enough that fused
+    collectives don't starve the cycle cadence (the trade the reference's
+    autotuner searches for blindly, reference: parameter_manager.h:225).
+
+    The effective rate is capped by HBM when given: a fused collective
+    also packs and unpacks the buffer through HBM (one read + one write
+    each way), so the wire can never be fed faster than ``hbm/2``.
+    """
+    rate = allreduce_gbps
+    if hbm_gbps is not None:
+        rate = min(rate, hbm_gbps / 2.0)
+    budget_s = cycle_time_ms * 1e-3 * cycle_fraction
+    threshold = int(rate * 1e9 * budget_s)
+    return max(floor_bytes, min(ceil_bytes, threshold))
+
+
+def probe_and_seed(config, mesh=None) -> dict:
+    """Run the probes and seed ``config.fusion_threshold_bytes``; returns
+    the measurements. Called at runtime startup when
+    ``HOROVOD_AUTOTUNE_PROBE`` is on. Must run on EVERY process in a
+    multi-controller (jax.distributed) world — the probe programs execute
+    over the global mesh, which all processes must enter together; the
+    coordinator's seeded value then wins via the per-cycle parameter
+    broadcast, so probe noise cannot diverge the workers."""
+    hbm = probe_hbm_bandwidth()
+    ar = probe_allreduce_bandwidth(mesh)
+    threshold = recommended_fusion_threshold(ar, config.cycle_time_ms,
+                                             hbm_gbps=hbm)
+    config.fusion_threshold_bytes = threshold
+    return {"hbm_gbps": hbm, "allreduce_gbps": ar,
+            "fusion_threshold_bytes": threshold}

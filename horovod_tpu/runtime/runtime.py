@@ -100,6 +100,36 @@ class Runtime:
         # controller.cc:32-46). Coordinator tunes; everyone applies.
         self.param_manager = None
         self._autotune_active = bool(st.config.autotune)
+        if self._autotune_active and st.config.autotune_probe:
+            # Seed the fusion threshold from measured HBM/ICI bandwidth
+            # (north star: autotuner backed by hardware probes). EVERY
+            # process probes — the probe programs run over the global
+            # mesh, which all processes of a multi-controller world must
+            # enter together; the coordinator's seeded value then governs
+            # via the per-cycle parameter broadcast. Skipped when the
+            # data plane is the host TCP ring (socket mode without a
+            # global mesh), whose bandwidth the XLA-mesh probe does not
+            # measure — seeding from it would overshoot by orders of
+            # magnitude.
+            host_ring_data_plane = (net is not None
+                                    and not self.executor._spmd_world)
+            if host_ring_data_plane:
+                if self.controller.is_coordinator:
+                    log.warning(
+                        "HOROVOD_AUTOTUNE_PROBE ignored: the host TCP "
+                        "data plane is active and the XLA-mesh probe "
+                        "does not measure it; tuning starts from the "
+                        "default threshold")
+            else:
+                from horovod_tpu.autotune.probe import probe_and_seed
+
+                measured = probe_and_seed(st.config, st.mesh)
+                if self.controller.is_coordinator:
+                    log.info(
+                        "autotune probe: HBM %.1f GB/s, allreduce %.1f "
+                        "GB/s -> initial fusion threshold %d MB",
+                        measured["hbm_gbps"], measured["allreduce_gbps"],
+                        measured["fusion_threshold_bytes"] >> 20)
         if self._autotune_active and self.controller.is_coordinator:
             from horovod_tpu.autotune.parameter_manager import (
                 ParameterManager, Params)

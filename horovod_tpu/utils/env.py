@@ -18,6 +18,7 @@ HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_PROBE = "HOROVOD_AUTOTUNE_PROBE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
 HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
 HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
@@ -88,6 +89,7 @@ class Config:
     timeline_file: str = ""
     timeline_mark_cycles: bool = False
     autotune: bool = False
+    autotune_probe: bool = False
     autotune_log: str = ""
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
@@ -110,6 +112,7 @@ class Config:
             timeline_file=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_get_bool(HOROVOD_TIMELINE_MARK_CYCLES),
             autotune=_get_bool(HOROVOD_AUTOTUNE),
+            autotune_probe=_get_bool(HOROVOD_AUTOTUNE_PROBE),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG, ""),
             autotune_warmup_samples=_get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
             autotune_steps_per_sample=_get_int(HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10),

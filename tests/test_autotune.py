@@ -189,3 +189,54 @@ class TestRuntimeIntegration:
                     == rt.param_manager.best.fusion_threshold_bytes)
         finally:
             hvd.shutdown()
+
+
+class TestBandwidthProbe:
+    """Hardware probes seeding the tuner (north star: autotuner backed by
+    HBM/ICI bandwidth probes)."""
+
+    def test_probes_return_positive_bandwidth(self, hvd_flat):
+        from horovod_tpu.autotune import probe
+
+        hbm = probe.probe_hbm_bandwidth(size_mb=4, iters=2)
+        ar = probe.probe_allreduce_bandwidth(size_mb=2, iters=2)
+        assert np.isfinite(hbm) and hbm > 0
+        assert np.isfinite(ar) and ar > 0
+
+    def test_recommended_threshold_scales_and_clamps(self):
+        from horovod_tpu.autotune.probe import recommended_fusion_threshold
+
+        # 100 GB/s, 5 ms cycle, half budget -> 250 MB (under the 256 MB
+        # cap, so unclamped)
+        t = recommended_fusion_threshold(100.0, 5.0)
+        assert t == 100e9 * 0.0025
+        # HBM cap: packing/unpacking bounds the feed rate at hbm/2
+        t = recommended_fusion_threshold(100.0, 5.0, hbm_gbps=40.0)
+        assert t == 20e9 * 0.0025
+        # slow link clamps to the floor
+        assert recommended_fusion_threshold(0.001, 5.0) == 1 << 20
+        # absurdly fast link clamps to the ceiling
+        assert recommended_fusion_threshold(1e6, 5.0) == 256 << 20
+
+    def test_probe_seeds_runtime_config(self, monkeypatch):
+        import horovod_tpu as hvd
+        from horovod_tpu.autotune import probe
+        from horovod_tpu.core import state as state_mod
+
+        monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_PROBE", "1")
+        monkeypatch.setattr(probe, "probe_hbm_bandwidth",
+                            lambda **kw: 123.0)
+        monkeypatch.setattr(probe, "probe_allreduce_bandwidth",
+                            lambda mesh=None, **kw: 10.0)
+        hvd.shutdown()
+        hvd.init(mesh_shape=(1, 8))
+        try:
+            from horovod_tpu.runtime.runtime import get_runtime
+
+            rt = get_runtime()
+            expected = probe.recommended_fusion_threshold(
+                10.0, rt._st.config.cycle_time_ms)
+            assert rt._st.config.fusion_threshold_bytes == expected
+        finally:
+            hvd.shutdown()
