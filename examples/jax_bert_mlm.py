@@ -36,7 +36,10 @@ def mask_batch(rng, tokens, rate=0.15):
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", default="base", choices=["base", "large"])
+    parser.add_argument("--model", default="base",
+                        choices=["tiny", "base", "large"],
+                        help="'tiny' is a 2-layer smoke config for "
+                             "CPU-mesh development runs")
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--batch-size", type=int, default=8,
                         help="per-chip batch size")
@@ -45,7 +48,15 @@ def main():
     args = parser.parse_args()
 
     hvd.init()
-    cls = BertBase if args.model == "base" else BertLarge
+    if args.model == "tiny":
+        from functools import partial
+
+        from horovod_tpu.models.transformer import Transformer
+
+        cls = partial(Transformer, d_model=64, num_layers=2, num_heads=4,
+                      d_ff=128, causal=False)
+    else:
+        cls = BertBase if args.model == "base" else BertLarge
     model = cls(vocab_size=VOCAB, max_seq=args.seq)
 
     opt = hvd.DistributedOptimizer(optax.adamw(args.lr * hvd.size()))
