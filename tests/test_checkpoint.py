@@ -81,3 +81,36 @@ def _leaves(tree):
     import jax
 
     return jax.tree_util.tree_leaves(tree)
+
+
+def test_checkpoint_loads_without_framework(hvd, tmp_path):
+    """Checkpoints contain no framework objects: a process that never
+    imports horovod_tpu can read them with flax alone (reference contrast:
+    docs/inference.rst — reference checkpoints embed HorovodAllreduce ops
+    and need graph surgery before inference; here there is nothing to
+    strip, docs/inference.md)."""
+    import subprocess
+    import sys
+
+    import jax.numpy as jnp
+
+    from horovod_tpu import checkpoint
+
+    d = str(tmp_path / "ckpts")
+    tree = {"params": {"w": jnp.arange(6, dtype=jnp.float32)},
+            "step_count": jnp.int32(7)}
+    checkpoint.save(d, tree, step=2)
+
+    probe = (
+        "import sys\n"
+        "import flax.serialization\n"
+        f"blob = open(r'{d}/ckpt_2.msgpack', 'rb').read()\n"
+        "tree = flax.serialization.msgpack_restore(blob)\n"
+        "assert 'horovod_tpu' not in sys.modules\n"
+        "assert list(tree['params']['w']) == [0, 1, 2, 3, 4, 5]\n"
+        "print('NO-FRAMEWORK-OK')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", probe],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "NO-FRAMEWORK-OK" in out.stdout
