@@ -78,7 +78,7 @@ def _run_async(kind: str, tensor, *, average: bool = True,
     """
     st = basics._ensure_init()
     x = _to_device(tensor)
-    if _coll._socket_world(st):
+    if _coll._multiprocess_world(st) and _coll._runtime_capable(st):
         if kind == "allreduce":
             return _coll.allreduce_async(
                 x, average=average,

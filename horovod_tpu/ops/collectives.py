@@ -169,6 +169,56 @@ def _socket_world(st) -> bool:
     return st.size > st.mesh.size and jax.process_count() == 1
 
 
+def _multiprocess_world(st) -> bool:
+    """True in ANY multi-process world — socket mode or multi-controller
+    jax.distributed. A plain local array is per-process data there and an
+    eager collective on it must really communicate."""
+    return st.size > st.mesh.size or jax.process_count() > 1
+
+
+def _runtime_capable(st) -> bool:
+    """True when the enqueue runtime has (or will build) a multi-process
+    controller to exchange per-process data — the launcher env contract is
+    present, or socket mode is active. Eager per-process collectives are
+    then routed through the runtime: its background thread is the single
+    issuer of dynamically-timed collective programs, so dispatch order is
+    the coordinator-agreed order on every rank; issuing directly from the
+    caller thread would interleave differently per rank against in-flight
+    runtime programs — a distributed program mismatch (the exact hazard
+    the reference's single-background-thread architecture prevents,
+    reference: operations.cc:281-300).
+
+    Without the launcher contract (externally-initialized jax.distributed)
+    the runtime would have no controller — routing would re-enter this
+    path from the executor and hang — so callers fall back to a direct
+    global-mesh exchange on the caller thread instead."""
+    import os
+
+    return _socket_world(st) or (jax.process_count() > 1
+                                 and "HOROVOD_RANK" in os.environ)
+
+
+def _process_local_stacked(x, st) -> jax.Array:
+    """Lift one process-local value into the worker-stacked global layout:
+    each of this process's devices contributes the process's value, so
+    per-worker (= per-device) semantics stay consistent with the
+    single-controller replicated model. Multi-controller only — the
+    direct-exchange fallback for worlds without a launcher-provided
+    controller (see _runtime_capable)."""
+    local = np.broadcast_to(
+        np.asarray(x)[None], (st.local_size,) + np.shape(x)).copy()
+    return jax.make_array_from_process_local_data(
+        mesh_mod.worker_sharding(st.mesh), local)
+
+
+def _is_globally_replicated(x, st) -> bool:
+    """True when ``x`` is a jax.Array already replicated across the WHOLE
+    mesh — the only case where "every worker holds this value" is a fact
+    rather than an assumption in a multi-controller world."""
+    return (isinstance(x, jax.Array) and x.sharding.is_fully_replicated
+            and len(x.sharding.device_set) == st.size)
+
+
 def _reduce_stacked_fn(mesh, op: int):
     """Compiled: stacked (W, *S) -> reduced (*S), replicated everywhere.
 
@@ -413,18 +463,29 @@ def allreduce(
             out = _hierarchical_reduce_stacked_fn(st.mesh, red_op)(x)
         else:
             out = _reduce_stacked_fn(st.mesh, red_op)(x)
-    elif _socket_world(st):
+    elif _multiprocess_world(st) and not _is_globally_replicated(x, st):
         # Multi-process world with a plain local array: the data lives
         # per-rank, so "replicated" math would silently return a
         # local-only result — route through the named enqueue runtime
-        # (auto call-order name, like the reference's unnamed torch ops).
-        if red_op not in (Average, Sum):
-            raise NotImplementedError(
-                "multi-process allreduce over the host data plane supports "
-                "sum/average only")
-        return synchronize(allreduce_async(
-            tensor, average=average, op=op, compression=compression,
-            name=name or _auto_name("allreduce")))
+        # (auto call-order name, like the reference's unnamed torch ops),
+        # whose background thread is the single ordered issuer of
+        # collective programs (see _runtime_capable).
+        if _runtime_capable(st):
+            if red_op not in (Average, Sum):
+                raise NotImplementedError(
+                    "multi-process eager allreduce supports sum/average "
+                    "only")
+            return synchronize(allreduce_async(
+                tensor, average=average, op=op, compression=compression,
+                name=name or _auto_name("allreduce")))
+        # no controller (externally-initialized jax.distributed):
+        # direct global-mesh exchange on the caller thread
+        stacked = _process_local_stacked(x, st)
+        if (st.config.hierarchical_allreduce
+                and _hierarchical_enabled(st, red_op)):
+            out = _hierarchical_reduce_stacked_fn(st.mesh, red_op)(stacked)
+        else:
+            out = _reduce_stacked_fn(st.mesh, red_op)(stacked)
     else:
         # Replicated: every worker holds the same value.
         if red_op in (Average, Min, Max):
@@ -473,13 +534,23 @@ def grouped_allreduce(
             groups.setdefault(str(a.dtype), []).append(i)
         else:
             plain.append(i)
-    if plain and _socket_world(st):
-        # multi-process: enqueue every plain tensor first so they are all
-        # in flight in the same cycle — the runtime's tensor fusion then
-        # batches them, matching the reference's grouped guarantee
-        handles = [(i, allreduce_async(
-            tensors[i], average=average, op=op, compression=compression,
-            name=_auto_name("grouped_allreduce"))) for i in plain]
+    if plain and _multiprocess_world(st) and _runtime_capable(st):
+        # multi-process: enqueue every per-process plain tensor first so
+        # they are all in flight in the same cycle — the runtime's tensor
+        # fusion then batches them, matching the reference's grouped
+        # guarantee. Globally replicated tensors skip the round trip (and
+        # keep min/max/product working), same as single allreduce.
+        handles = []
+        for i in plain:
+            if _is_globally_replicated(arrays[i], st):
+                out[i] = allreduce(arrays[i], average=average, op=op,
+                                   compression=compression,
+                                   axis_name=axis_name)
+            else:
+                handles.append((i, allreduce_async(
+                    tensors[i], average=average, op=op,
+                    compression=compression,
+                    name=_auto_name("grouped_allreduce"))))
         for i, h in handles:
             out[i] = synchronize(h)
     else:
@@ -549,11 +620,17 @@ def allgather(tensor, name: Optional[str] = None, axis_name=None):
         return _gather_stacked_fn(st.mesh)(x)
     if x.ndim < 1:
         raise ValueError("allgather requires tensors of rank >= 1")
-    if _socket_world(st):
+    if _multiprocess_world(st) and not _is_globally_replicated(x, st):
         # Multi-process world: each rank holds its own tensor — ride the
-        # enqueue runtime rather than faking the concat locally.
-        return synchronize(allgather_async(
-            tensor, name=name or _auto_name("allgather")))
+        # enqueue runtime rather than faking the concat locally (and so
+        # the background thread keeps collective-program order agreed).
+        if _runtime_capable(st):
+            return synchronize(allgather_async(
+                tensor, name=name or _auto_name("allgather")))
+        stacked = _process_local_stacked(x, st)
+        if (st.config.hierarchical_allgather and _hierarchical_enabled(st)):
+            return _hierarchical_gather_stacked_fn(st.mesh)(stacked)
+        return _gather_stacked_fn(st.mesh)(stacked)
     # Replicated: every worker contributes the same tensor.
     return jnp.concatenate([x] * st.size, axis=0)
 
@@ -583,21 +660,16 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None, axis_name=None
     x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
     if _is_worker_stacked(x):
         return _bcast_stacked_fn(st.mesh, root_rank)(x)
-    if _socket_world(st):
-        # Multi-process world: the root's value must actually travel.
-        return synchronize(broadcast_async(
-            tensor, root_rank, name=name or _auto_name("broadcast")))
-    if jax.process_count() > 1 and not (
-            isinstance(x, jax.Array) and x.sharding.is_fully_replicated
-            and len(x.sharding.device_set) == st.size):
-        # Multi-process with process-local data: a real collective so the
-        # root's value becomes authoritative everywhere (the reference's
-        # MPI_Bcast role in checkpoint restore, torch/__init__.py:255-403).
-        local = np.broadcast_to(
-            np.asarray(x)[None], (st.local_size,) + np.shape(x)).copy()
-        stacked = jax.make_array_from_process_local_data(
-            mesh_mod.worker_sharding(st.mesh), local)
-        return _bcast_stacked_fn(st.mesh, root_rank)(stacked)
+    if _multiprocess_world(st) and not _is_globally_replicated(x, st):
+        # Multi-process world: the root's value must actually travel (the
+        # reference's MPI_Bcast role in checkpoint restore,
+        # torch/__init__.py:255-403) — through the runtime so the
+        # background thread keeps collective-program order agreed.
+        if _runtime_capable(st):
+            return synchronize(broadcast_async(
+                tensor, root_rank, name=name or _auto_name("broadcast")))
+        return _bcast_stacked_fn(st.mesh, root_rank)(
+            _process_local_stacked(x, st))
     # Single-controller: values are already globally consistent; force the
     # replicated layout over the mesh so downstream steps see it.
     return jax.device_put(x, _replicated(st.mesh))

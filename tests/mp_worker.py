@@ -189,6 +189,48 @@ def main():
         root_flat = np.asarray(hvd.synchronize(h))
         np.testing.assert_allclose(root_flat, flat, rtol=1e-6, atol=1e-7)
 
+    elif scenario == "kitchen_sink":
+        # Everything at once: named grads in rank-skewed order, unnamed
+        # eager ops, broadcast + ragged allgather in the same cycles, and
+        # periodic shape changes — in BOTH launcher modes. Caught the
+        # multi-controller eager-dispatch ordering bug (unnamed eager ops
+        # must ride the runtime's single ordered lane, not dispatch global
+        # programs from the caller thread).
+        rngk = np.random.RandomState(1000 + rank)
+        for step in range(20):
+            order = rngk.permutation(6)
+            hs = {}
+            for i in order:
+                hs[int(i)] = hvd.allreduce_async(
+                    np.full((8 + i,), float(rank + i), np.float32),
+                    name=f"ks/g{i}")
+            u = hvd.allreduce(np.full((4,), float(rank), np.float32))
+            np.testing.assert_allclose(
+                np.asarray(u), np.mean(np.arange(world, dtype=np.float32)))
+            b = hvd.broadcast_async(
+                np.full((3,), float(rank), np.float32),
+                root_rank=step % world, name="ks/b")
+            g = hvd.allgather_async(
+                np.full((rank + 1, 2), float(rank), np.float32),
+                name="ks/ag")
+            for i, h in hs.items():
+                expect = np.mean([r + i for r in range(world)])
+                np.testing.assert_allclose(
+                    np.asarray(hvd.synchronize(h)), expect,
+                    err_msg=f"step {step} grad {i}")
+            np.testing.assert_allclose(np.asarray(hvd.synchronize(b)),
+                                       float(step % world))
+            ag = np.asarray(hvd.synchronize(g))
+            expect = np.concatenate(
+                [np.full((r + 1, 2), float(r), np.float32)
+                 for r in range(world)])
+            np.testing.assert_allclose(ag, expect)
+            if step % 8 == 7:  # shape change -> synchronized invalidation
+                h = hvd.allreduce_async(
+                    np.ones((step,), np.float32), name="ks/shapeshift")
+                np.testing.assert_allclose(
+                    np.asarray(hvd.synchronize(h)), 1.0)
+
     elif scenario == "keras":
         # The keras-style Trainer under the launcher: fit/evaluate over
         # the jax.distributed global mesh, metric averaging across ranks.

@@ -31,6 +31,22 @@ def test_tpurun_binary_two_ranks(extra_args):
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+@pytest.mark.parametrize("extra_args", [["--no-jax-distributed"], []],
+                         ids=["socket-controller", "jax-distributed"])
+def test_tpurun_kitchen_sink(extra_args):
+    """Named + unnamed + broadcast + ragged allgather interleaved with
+    cache churn, in both launcher modes — the scenario that caught the
+    multi-controller eager-dispatch ordering bug."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["HOROVOD_CACHE_CAPACITY"] = "6"
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", *extra_args, sys.executable, WORKER, "kitchen_sink"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
 def test_tpurun_keras_trainer():
     """Keras-style Trainer fit/evaluate under the launcher's global mesh."""
     env = dict(os.environ)
