@@ -411,6 +411,40 @@ def main():
                 expect = sum(float(r + t) for r in range(world))
                 np.testing.assert_allclose(out, np.full(shape, expect),
                                            rtol=1e-6)
+    elif scenario == "torch_sink":
+        # Torch hook-driven optimizer with gradient accumulation, eager
+        # ops interleaved while async allreduces are in flight, and a
+        # final cross-rank parameter-identity check.
+        import torch
+        import torch.nn.functional as F
+
+        import horovod_tpu.torch as thvd
+
+        torch.manual_seed(42)
+        model = torch.nn.Sequential(
+            torch.nn.Linear(16, 32), torch.nn.ReLU(),
+            torch.nn.Linear(32, 4))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+        opt = thvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        thvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        thvd.broadcast_optimizer_state(opt, root_rank=0)
+        rng = np.random.RandomState(rank)
+        for step in range(10):
+            for _ in range(2):
+                x = torch.tensor(rng.rand(8, 16), dtype=torch.float32)
+                y = torch.tensor(rng.randint(0, 4, (8,)), dtype=torch.long)
+                F.cross_entropy(model(x), y).backward()
+            m = thvd.allreduce(torch.tensor([float(rank)]),
+                               name=f"ts/metric{step}")
+            assert abs(float(m) - np.mean(range(world))) < 1e-6
+            opt.step()
+            opt.zero_grad()
+        flat = torch.cat([p.data.flatten() for p in model.parameters()])
+        root = thvd.broadcast(flat.clone(), root_rank=0, name="ts/final")
+        assert torch.allclose(root, flat, rtol=1e-5, atol=1e-7)
+
     elif scenario == "torch":
         # The torch binding end-to-end under a real multi-process world
         # (reference: test/test_torch.py run under mpirun): hook-driven

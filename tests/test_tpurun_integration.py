@@ -47,6 +47,20 @@ def test_tpurun_kitchen_sink(extra_args):
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+@pytest.mark.parametrize("extra_args", [["--no-jax-distributed"], []],
+                         ids=["socket-controller", "jax-distributed"])
+def test_tpurun_torch_sink(extra_args):
+    """Torch hooks + accumulation + interleaved eager ops, both modes,
+    with a final parameter-identity check across ranks."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", *extra_args, sys.executable, WORKER, "torch_sink"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
 def test_tpurun_keras_trainer():
     """Keras-style Trainer fit/evaluate under the launcher's global mesh."""
     env = dict(os.environ)
