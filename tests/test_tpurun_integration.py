@@ -61,6 +61,18 @@ def test_tpurun_torch_sink(extra_args):
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+def test_tpurun_ring_attention_cross_process():
+    """Sequence parallelism over a process-spanning mesh: ring attention's
+    ppermute crosses real process boundaries and matches dense attention."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", sys.executable, WORKER, "ring_sp"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
 def test_tpurun_keras_trainer():
     """Keras-style Trainer fit/evaluate under the launcher's global mesh."""
     env = dict(os.environ)
