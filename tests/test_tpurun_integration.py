@@ -73,6 +73,17 @@ def test_tpurun_ring_attention_cross_process():
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+def test_tpurun_pipeline_and_moe_cross_process():
+    """GPipe ppermute and MoE all_to_all across real process boundaries."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", sys.executable, WORKER, "pp_ep_xproc"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
 def test_tpurun_keras_trainer():
     """Keras-style Trainer fit/evaluate under the launcher's global mesh."""
     env = dict(os.environ)
