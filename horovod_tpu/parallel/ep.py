@@ -36,6 +36,9 @@ def switch_moe(x, gate_logits, expert_fn: Callable, expert_params,
     ``expert_fn(params, h) -> h`` is the expert body; ``capacity`` is the
     per-(device, expert) token budget.
 
+    Takes ONE mesh axis name (the all_to_all routes over a single axis);
+    reshape the mesh if experts should span multiple axes.
+
     Returns ``(y, router_probs)`` where dropped tokens contribute zeros.
     """
     n_exp = lax.axis_size(axis_name)

@@ -43,6 +43,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     on the LAST stage (zeros elsewhere — combine with
     :func:`last_stage_value` or compute the loss per-device and select).
     """
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            "pipeline_apply takes ONE mesh axis name (the ppermute ring "
+            f"is a single axis); got {axis_name!r} — reshape the mesh so "
+            "the pipeline spans one axis")
     n_stages = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_micro = x.shape[0]
