@@ -401,8 +401,15 @@ int barrier(Comm* c) {
 // reduce-scatter + allgather ring the reference's transports implement.
 // ---------------------------------------------------------------------------
 
+// Reduction op codes shared with the Python binding (runtime/native.py):
+// 0=sum, 1=min, 2=max, 3=product. Average is sum + a host-side divide —
+// same as the reference's MPI_SUM + postscale (horovod averages after
+// summing).
+enum RedOp { kRedSum = 0, kRedMin = 1, kRedMax = 2, kRedProd = 3 };
+
 template <typename T>
-int ring_allreduce_t(Comm* c, T* data, uint64_t count) {
+int ring_allreduce_t(Comm* c, T* data, uint64_t count, int op) {
+  if (op < kRedSum || op > kRedProd) return -1;
   if (c->world == 1 || count == 0) return 0;
   const int w = c->world;
   // chunk boundaries
@@ -426,7 +433,22 @@ int ring_allreduce_t(Comm* c, T* data, uint64_t count) {
                         recv_n * sizeof(T)) != 0)
       return -1;
     T* dst = data + begin[recv_chunk];
-    for (uint64_t i = 0; i < recv_n; ++i) dst[i] += recv_buf[i];
+    switch (op) {
+      case kRedSum:
+        for (uint64_t i = 0; i < recv_n; ++i) dst[i] += recv_buf[i];
+        break;
+      case kRedMin:
+        for (uint64_t i = 0; i < recv_n; ++i)
+          dst[i] = std::min(dst[i], recv_buf[i]);
+        break;
+      case kRedMax:
+        for (uint64_t i = 0; i < recv_n; ++i)
+          dst[i] = std::max(dst[i], recv_buf[i]);
+        break;
+      case kRedProd:
+        for (uint64_t i = 0; i < recv_n; ++i) dst[i] *= recv_buf[i];
+        break;
+    }
   }
   // allgather ring: circulate the owned (fully reduced) chunks
   for (int step = 0; step < w - 1; ++step) {
@@ -449,6 +471,11 @@ int ring_allreduce_t(Comm* c, T* data, uint64_t count) {
 // ---------------------------------------------------------------------------
 
 extern "C" {
+
+// Bumped whenever an exported signature changes (the Python binding
+// refuses to drive a stale prebuilt .so whose symbols still resolve but
+// whose ABI differs — e.g. the op argument added to the ring kernels).
+int hvdnet_abi_version() { return 2; }
 
 void* hvdnet_init(int rank, int world, const char* coord_host, int coord_port,
                   int timeout_ms) {
@@ -516,20 +543,20 @@ int64_t hvdnet_bcast(void* h, void* buf, uint64_t len_or_cap) {
   return static_cast<int64_t>(data.size());
 }
 
-int hvdnet_allreduce_f32(void* h, float* data, uint64_t count) {
-  return ring_allreduce_t<float>(static_cast<Comm*>(h), data, count);
+int hvdnet_allreduce_f32(void* h, float* data, uint64_t count, int op) {
+  return ring_allreduce_t<float>(static_cast<Comm*>(h), data, count, op);
 }
 
-int hvdnet_allreduce_f64(void* h, double* data, uint64_t count) {
-  return ring_allreduce_t<double>(static_cast<Comm*>(h), data, count);
+int hvdnet_allreduce_f64(void* h, double* data, uint64_t count, int op) {
+  return ring_allreduce_t<double>(static_cast<Comm*>(h), data, count, op);
 }
 
-int hvdnet_allreduce_i32(void* h, int32_t* data, uint64_t count) {
-  return ring_allreduce_t<int32_t>(static_cast<Comm*>(h), data, count);
+int hvdnet_allreduce_i32(void* h, int32_t* data, uint64_t count, int op) {
+  return ring_allreduce_t<int32_t>(static_cast<Comm*>(h), data, count, op);
 }
 
-int hvdnet_allreduce_i64(void* h, int64_t* data, uint64_t count) {
-  return ring_allreduce_t<int64_t>(static_cast<Comm*>(h), data, count);
+int hvdnet_allreduce_i64(void* h, int64_t* data, uint64_t count, int op) {
+  return ring_allreduce_t<int64_t>(static_cast<Comm*>(h), data, count, op);
 }
 
 // Allgatherv over the star: gather blobs to rank 0, then broadcast the

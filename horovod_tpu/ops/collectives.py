@@ -70,6 +70,8 @@ def _resolve_op(average: Optional[bool], op: Optional[int]) -> int:
     if op is None:
         # reference default: average=True (torch/mpi_ops.py allreduce)
         return Average if (average is None or average) else Sum
+    if op not in _OP_NAMES:
+        raise ValueError(f"unknown op {op}")
     return op
 
 
@@ -471,10 +473,6 @@ def allreduce(
         # whose background thread is the single ordered issuer of
         # collective programs (see _runtime_capable).
         if _runtime_capable(st):
-            if red_op not in (Average, Sum):
-                raise NotImplementedError(
-                    "multi-process eager allreduce supports sum/average "
-                    "only")
             return synchronize(allreduce_async(
                 tensor, average=average, op=op, compression=compression,
                 name=name or _auto_name("allreduce")))
@@ -757,13 +755,15 @@ class Handle:
         self._result = result
 
     def poll(self) -> bool:
-        try:
-            leaves = jax.tree_util.tree_leaves(self._result)
-            return all(
-                leaf.is_ready() for leaf in leaves if isinstance(leaf, jax.Array)
-            )
-        except Exception:
-            return True
+        # Exceptions surface here, not swallowed: an error inside
+        # is_ready() (e.g. a failed async computation) must reach the
+        # caller that polled, not masquerade as "complete" and then raise
+        # from an unrelated wait() later. Duck-typed on is_ready so
+        # non-array leaves (python scalars in a result tree) pass through.
+        leaves = jax.tree_util.tree_leaves(self._result)
+        return all(
+            leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready")
+        )
 
     def wait(self):
         return jax.block_until_ready(self._result)
@@ -780,15 +780,12 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     horovod/mxnet/mpi_ops.py:52)."""
     if name is not None:
         red_op = _resolve_op(average, op)
-        if red_op not in (Average, Sum):
-            raise ValueError("named (runtime) allreduce supports "
-                             "sum/average only")
         from horovod_tpu.runtime.runtime import get_runtime
 
         x, ctx = compression.compress(
             tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor))
         handle = get_runtime().enqueue_allreduce(
-            name, x, average=(red_op == Average), priority=priority)
+            name, x, reduce_op=_OP_NAMES[red_op], priority=priority)
         handle._decompress = (compression, ctx)  # applied in synchronize()
         return handle
     return Handle(allreduce(tensor, average=average, op=op,

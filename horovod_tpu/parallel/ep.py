@@ -41,6 +41,11 @@ def switch_moe(x, gate_logits, expert_fn: Callable, expert_params,
 
     Returns ``(y, router_probs)`` where dropped tokens contribute zeros.
     """
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            f"switch_moe takes ONE mesh axis name (got {axis_name!r}); "
+            "the all_to_all routes over a single axis — reshape the mesh "
+            "if experts should span multiple axes")
     n_exp = lax.axis_size(axis_name)
     d = x.shape[-1]
     if gate_logits.shape[-1] != n_exp:

@@ -85,8 +85,26 @@ class _Handler(BaseHTTPRequestHandler):
 class RendezvousServer:
     """Launcher-side store. ``start()`` returns the bound port."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 bind_retries: int = 5):
+        # An explicitly-requested port can collide with a dying server
+        # from a previous launch (or a race between launchers); retry with
+        # backoff before giving up. Only EADDRINUSE is plausibly transient
+        # — EACCES/EADDRNOTAVAIL etc. fail identically every attempt, so
+        # they surface immediately. port=0 (ephemeral) cannot collide.
+        import errno
+
+        attempt = 0
+        while True:
+            try:
+                self._httpd = ThreadingHTTPServer((host, port), _Handler)
+                break
+            except OSError as exc:
+                attempt += 1
+                if (port == 0 or attempt > bind_retries
+                        or exc.errno != errno.EADDRINUSE):
+                    raise
+                time.sleep(0.2 * attempt)
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.finished = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]

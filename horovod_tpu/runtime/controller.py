@@ -84,7 +84,7 @@ def construct_response(requests: List[msg.Request]) -> msg.Response:
                     types.ERROR, [name],
                     f"Mismatched allreduce tensor shapes: {first.shape} vs "
                     f"{r.shape}.")
-            if r.average != first.average:
+            if r.reduce_op != first.reduce_op:
                 return msg.Response(
                     types.ERROR, [name],
                     "Mismatched allreduce reduction ops across workers.")

@@ -31,6 +31,25 @@ from horovod_tpu.ops import collectives
 from horovod_tpu.runtime import types
 
 
+# reduce_op name -> stacked-axis reducer for the XLA fused programs
+_REDUCERS = {
+    types.REDUCE_SUM: jnp.sum,
+    types.REDUCE_AVERAGE: jnp.mean,
+    types.REDUCE_MIN: jnp.min,
+    types.REDUCE_MAX: jnp.max,
+    types.REDUCE_PRODUCT: jnp.prod,
+}
+
+# reduce_op name -> host ring kernel op (average = sum + host divide)
+_RING_OP = {
+    types.REDUCE_SUM: "sum",
+    types.REDUCE_AVERAGE: "sum",
+    types.REDUCE_MIN: "min",
+    types.REDUCE_MAX: "max",
+    types.REDUCE_PRODUCT: "product",
+}
+
+
 class Executor:
     """First-match dispatch per response type (reference:
     operation_manager.cc:32-80). Two data planes:
@@ -76,9 +95,10 @@ class Executor:
 
         return mesh_mod.replicated_sharding(self.mesh)
 
-    def _fused_allreduce_program(self, shapes, dtype, average: bool,
+    def _fused_allreduce_program(self, shapes, dtype, reduce_op: str,
                                  hierarchical: bool = False):
-        key = ("fused_allreduce", shapes, str(dtype), average, hierarchical)
+        key = ("fused_allreduce", shapes, str(dtype), reduce_op,
+               hierarchical)
         with self._lock:
             fn = self._programs.get(key)
             if fn is not None:
@@ -93,13 +113,15 @@ class Executor:
 
         if hierarchical:
             # two-level reduction over the fused buffer (shared body with
-            # the eager path: collectives.two_level_reduce_block)
+            # the eager path: collectives.two_level_reduce_block) —
+            # sum/average only; callers gate other ops to the flat path
             cross, local = self.mesh.devices.shape
             world = cross * local
 
             def inner(xblk):
                 return collectives.two_level_reduce_block(
-                    xblk[0], local, world, average)
+                    xblk[0], local, world,
+                    reduce_op == types.REDUCE_AVERAGE)
 
             def reduce_buf(buf):
                 return jax.shard_map(
@@ -107,9 +129,10 @@ class Executor:
                     in_specs=P(mesh_mod.GLOBAL_AXES),
                     out_specs=P(), check_vma=False)(buf)
         else:
+            reducer = _REDUCERS[reduce_op]
+
             def reduce_buf(buf):
-                return (jnp.mean(buf, axis=0) if average
-                        else jnp.sum(buf, axis=0))
+                return reducer(buf, axis=0)
 
         def f(*tensors):
             flat = [t.reshape(t.shape[0], -1) for t in tensors]
@@ -149,8 +172,7 @@ class Executor:
             if response.response_type == types.ERROR:
                 status = types.Status.PreconditionError(response.error_message)
                 for e in entries:
-                    if e.callback:
-                        e.callback(status, None)
+                    e.complete(status, None)
                 return
 
             if response.response_type == types.ALLREDUCE:
@@ -179,13 +201,11 @@ class Executor:
 
             ok = types.Status.OK()
             for e in entries:
-                if e.callback:
-                    e.callback(ok, e.output)
+                e.complete(ok, e.output)
         except Exception as exc:  # propagate execution failures as statuses
             status = types.Status.UnknownError(str(exc))
             for e in entries:
-                if e.callback:
-                    e.callback(status, None)
+                e.complete(status, None)
         finally:
             if timeline is not None:
                 timeline.end(name0)
@@ -222,10 +242,11 @@ class Executor:
         if timeline is not None:
             timeline.activity_end(entries[0].name)
             timeline.activity_start(entries[0].name, "NET_RING_ALLREDUCE")
-        self.net.allreduce_sum(buf)
+        reduce_op = entries[0].reduce_op
+        self.net.allreduce(buf, _RING_OP[reduce_op])
         if timeline is not None:
             timeline.activity_end(entries[0].name)
-        if entries[0].average:
+        if reduce_op == types.REDUCE_AVERAGE:
             buf = buf / world
         off = 0
         for e, orig, w in zip(entries, arrays, wire):
@@ -234,21 +255,22 @@ class Executor:
             e.output = out
             off += n
 
-    def _fused_spmd_allreduce_program(self, n: int, dtype, average: bool):
+    def _fused_spmd_allreduce_program(self, n: int, dtype, reduce_op: str):
         """One compiled XLA program per (flat size, dtype, op): the global
         stacked fusion buffer (P, n) — one row per process, sharded over the
-        per-process sub-mesh — is mean/sum-reduced over the process axis,
-        output replicated. Integer sums are exact (no duplication)."""
-        key = ("spmd_allreduce", n, str(dtype), average)
+        per-process sub-mesh — is reduced over the process axis, output
+        replicated. Integer sums are exact (no duplication)."""
+        key = ("spmd_allreduce", n, str(dtype), reduce_op)
         with self._lock:
             fn = self._programs.get(key)
             if fn is not None:
                 return fn
 
         replicated = NamedSharding(self._proc_mesh, P())
+        reducer = _REDUCERS[reduce_op]
 
         def f(buf):
-            return jnp.mean(buf, axis=0) if average else jnp.sum(buf, axis=0)
+            return reducer(buf, axis=0)
 
         fn = jax.jit(f, out_shardings=replicated)
         with self._lock:
@@ -282,9 +304,8 @@ class Executor:
             timeline.activity_end(entries[0].name)
             timeline.activity_start(entries[0].name,
                                     timeline_mod.XLA_COLLECTIVE)
-        avg = entries[0].average
         fn = self._fused_spmd_allreduce_program(
-            int(flat.size), flat.dtype, avg)
+            int(flat.size), flat.dtype, entries[0].reduce_op)
         out = np.asarray(fn(global_stack))
         if timeline is not None:
             timeline.activity_end(entries[0].name)
@@ -327,14 +348,20 @@ class Executor:
              else replicated).append(e)
 
         # Replicated inputs need no collective: every worker already holds
-        # the same value (single-controller invariant).
+        # the same value (single-controller invariant). average/min/max of
+        # identical copies is the identity; sum/product scale by world.
+        size = collectives.state_mod.global_state().size
         for e in replicated:
-            e.output = (e.tensor if e.average
-                        else e.tensor * collectives.state_mod.global_state().size)
+            if e.reduce_op == types.REDUCE_SUM:
+                e.output = e.tensor * size
+            elif e.reduce_op == types.REDUCE_PRODUCT:
+                e.output = e.tensor ** size
+            else:
+                e.output = e.tensor
 
         if not stacked:
             return
-        avg = stacked[0].average
+        reduce_op = stacked[0].reduce_op
         shapes = tuple(tuple(e.tensor.shape) for e in stacked)
         dtype = stacked[0].tensor.dtype
         if timeline is not None:
@@ -345,8 +372,9 @@ class Executor:
                                     timeline_mod.XLA_COLLECTIVE)
         hier = (collectives.state_mod.global_state()
                 .config.hierarchical_allreduce
-                and self.hierarchical_available())
-        fn = self._fused_allreduce_program(shapes, dtype, avg, hier)
+                and self.hierarchical_available()
+                and reduce_op in (types.REDUCE_SUM, types.REDUCE_AVERAGE))
+        fn = self._fused_allreduce_program(shapes, dtype, reduce_op, hier)
         outs = fn(*[e.tensor for e in stacked])
         for e, out in zip(stacked, outs):
             e.output = out

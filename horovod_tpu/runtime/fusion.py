@@ -50,7 +50,7 @@ def _fusable(a: msg.Response, b: msg.Response,
         return False
     ra = request_by_name[a.tensor_names[0]]
     rb = request_by_name[b.tensor_names[0]]
-    return (ra.dtype == rb.dtype and ra.average == rb.average)
+    return (ra.dtype == rb.dtype and ra.reduce_op == rb.reduce_op)
 
 
 def fuse_responses_py(responses: List[msg.Response],
@@ -113,7 +113,7 @@ def fuse_responses_native(responses: List[msg.Response],
         if r.response_type == types.ALLREDUCE:
             is_ar[i] = 1
             req = request_by_name[r.tensor_names[0]]
-            key = (req.dtype, req.average)
+            key = (req.dtype, req.reduce_op)
             key_id[i] = key_ids.setdefault(key, len(key_ids))
             nbytes[i] = response_bytes(r, request_by_name)
     cap = 2 * n

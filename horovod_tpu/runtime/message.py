@@ -20,7 +20,10 @@ from typing import List, Tuple
 from horovod_tpu.runtime import types
 
 _MAGIC = 0x48  # 'H'
-_VERSION = 1
+# v2: the request op byte carries a reduce-op code (0=sum, 1=average,
+# 2=min, 3=max, 4=product) where v1 carried a boolean average byte — a
+# version-skewed peer must reject the frame, not misread min as average.
+_VERSION = 2
 
 _REQUEST_TYPES = {types.ALLREDUCE: 0, types.ALLGATHER: 1, types.BROADCAST: 2,
                   types.INVALIDATE: 4}
@@ -28,6 +31,13 @@ _REQUEST_TYPES_INV = {v: k for k, v in _REQUEST_TYPES.items()}
 _RESPONSE_TYPES = {types.ALLREDUCE: 0, types.ALLGATHER: 1,
                    types.BROADCAST: 2, types.ERROR: 3, types.INVALIDATE: 4}
 _RESPONSE_TYPES_INV = {v: k for k, v in _RESPONSE_TYPES.items()}
+
+# Reduce-op wire codes. Codes 0/1 coincide with the old boolean
+# ``average`` byte (0=sum, 1=average), so v1 frames stay readable.
+_REDUCE_OPS = {types.REDUCE_SUM: 0, types.REDUCE_AVERAGE: 1,
+               types.REDUCE_MIN: 2, types.REDUCE_MAX: 3,
+               types.REDUCE_PRODUCT: 4}
+_REDUCE_OPS_INV = {v: k for k, v in _REDUCE_OPS.items()}
 
 
 def _pack_str(s: str) -> bytes:
@@ -52,13 +62,13 @@ class Request:
     dtype: str
     shape: Tuple[int, ...]
     root_rank: int = 0
-    average: bool = True
+    reduce_op: str = types.REDUCE_AVERAGE
 
     def pack(self) -> bytes:
         head = struct.pack(
             "<BBiBiB", _MAGIC, _VERSION, self.rank,
             _REQUEST_TYPES[self.request_type], self.root_rank,
-            1 if self.average else 0)
+            _REDUCE_OPS[self.reduce_op])
         body = _pack_str(self.tensor_name) + _pack_str(self.dtype)
         body += struct.pack("<I", len(self.shape))
         body += struct.pack(f"<{len(self.shape)}q", *self.shape)
@@ -66,7 +76,7 @@ class Request:
 
     @staticmethod
     def unpack(buf: bytes, off: int = 0) -> Tuple["Request", int]:
-        magic, ver, rank, rtype, root, avg = struct.unpack_from("<BBiBiB",
+        magic, ver, rank, rtype, root, rop = struct.unpack_from("<BBiBiB",
                                                                 buf, off)
         if magic != _MAGIC or ver != _VERSION:
             raise ValueError("bad request header")
@@ -78,7 +88,7 @@ class Request:
         shape = struct.unpack_from(f"<{ndim}q", buf, off)
         off += 8 * ndim
         return Request(rank, _REQUEST_TYPES_INV[rtype], name, dtype,
-                       tuple(shape), root, bool(avg)), off
+                       tuple(shape), root, _REDUCE_OPS_INV[rop]), off
 
 
 @dataclasses.dataclass

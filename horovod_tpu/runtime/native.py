@@ -33,17 +33,23 @@ class NativeUnavailableError(RuntimeError):
 _lib_error: Optional[str] = None
 
 
-def load_library(build_if_missing: bool = True):
+def load_library(build_if_missing: bool = True, retry_failed: bool = False):
     """Load (building if needed) the native library; raises
-    NativeUnavailableError if no toolchain is available. Failure is cached:
-    callers on the hot cycle path fall back to Python without re-running
-    make/dlopen every cycle."""
+    NativeUnavailableError if no toolchain is available. Failure is cached
+    so callers on the hot cycle path (fusion/cache) fall back to Python
+    without re-running make/dlopen every cycle — but callers for whom the
+    library is REQUIRED (the transport) pass ``retry_failed=True`` so a
+    transient build failure (e.g. flock contention exceeding the make
+    timeout when many workers launch at once) does not permanently poison
+    the process."""
     global _lib, _lib_error
     with _lib_lock:
         if _lib is not None:
             return _lib
         if _lib_error is not None:
-            raise NativeUnavailableError(_lib_error)
+            if not retry_failed:
+                raise NativeUnavailableError(_lib_error)
+            _lib_error = None
         try:
             _lib = _load_locked(build_if_missing)
         except NativeUnavailableError as exc:
@@ -93,7 +99,19 @@ def _load_locked(build_if_missing: bool):
     return lib
 
 
+_ABI_VERSION = 2  # must match hvdnet_abi_version() in cpp/net.cc
+
+
 def _bind_symbols(lib) -> None:
+    # A stale prebuilt library can resolve every symbol yet have an
+    # incompatible signature (ctypes argtypes are Python-side only) —
+    # verify the compiled-in ABI version before trusting it.
+    lib.hvdnet_abi_version.restype = ctypes.c_int
+    lib.hvdnet_abi_version.argtypes = []
+    got = lib.hvdnet_abi_version()
+    if got != _ABI_VERSION:
+        raise AttributeError(
+            f"native ABI version {got} != expected {_ABI_VERSION}")
     lib.hvdnet_init.restype = ctypes.c_void_p
     lib.hvdnet_init.argtypes = [ctypes.c_int, ctypes.c_int,
                                 ctypes.c_char_p, ctypes.c_int,
@@ -117,7 +135,8 @@ def _bind_symbols(lib) -> None:
     for name in ("hvdnet_allreduce_f32", "hvdnet_allreduce_f64",
                  "hvdnet_allreduce_i32", "hvdnet_allreduce_i64"):
         fn = getattr(lib, name)
-        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                       ctypes.c_int]
     lib.hvdnet_allgatherv.restype = ctypes.c_int64
     lib.hvdnet_allgatherv.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
@@ -176,6 +195,9 @@ _ALLREDUCE_FN = {
     np.dtype(np.int64): "hvdnet_allreduce_i64",
 }
 
+# op codes shared with cpp/net.cc RedOp ("average" is sum + host divide)
+_RING_OPS = {"sum": 0, "min": 1, "max": 2, "product": 3}
+
 
 
 class NetComm:
@@ -191,7 +213,7 @@ class NetComm:
     def __init__(self, rank: int, world: int, coord_host: str = "127.0.0.1",
                  coord_port: int = 29500, timeout_ms: int = 30_000,
                  bit_words: int = 17):
-        self._lib = load_library()
+        self._lib = load_library(retry_failed=True)
         self._h = self._lib.hvdnet_init(
             rank, world, coord_host.encode(), coord_port, timeout_ms)
         if not self._h:
@@ -305,18 +327,29 @@ class NetComm:
             return self.bcast(relayed[root])
         return self.bcast(None)
 
-    def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
-        """In-place ring allreduce (sum) on a contiguous host array."""
+    def allreduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        """In-place ring allreduce on a contiguous host array.
+
+        ``op`` is one of sum/min/max/product (reference generalizes its op
+        dispatch the same way, horovod/torch/mpi_ops_v2.cc:52-76; the ring
+        reduction body only differs in the combine step)."""
         if arr.dtype not in _ALLREDUCE_FN:
             raise TypeError(f"unsupported dtype {arr.dtype} for host "
                             "allreduce (use float32/float64/int32/int64)")
+        if op not in _RING_OPS:
+            raise ValueError(f"unsupported ring allreduce op {op!r}")
         arr = np.ascontiguousarray(arr)
         fn = getattr(self._lib, _ALLREDUCE_FN[arr.dtype])
         with self._lock:
-            rc = fn(self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.size)
+            rc = fn(self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.size,
+                    _RING_OPS[op])
         if rc != 0:
             raise RuntimeError("ring allreduce failed")
         return arr
+
+    def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
+        """In-place ring allreduce (sum) on a contiguous host array."""
+        return self.allreduce(arr, "sum")
 
     def _allgatherv_raw(self, blob: bytes, cap: int) -> List[bytes]:
         lens = (ctypes.c_uint64 * self.world)()

@@ -57,7 +57,7 @@ class ResponseCache:
     @staticmethod
     def _params_key(request: msg.Request) -> tuple:
         return (request.request_type, request.dtype, request.shape,
-                request.root_rank, request.average)
+                request.root_rank, request.reduce_op)
 
     def cached(self, request: msg.Request) -> CacheState:
         """reference: response_cache.cc:50-76 — a name hit with changed

@@ -65,11 +65,7 @@ class RuntimeHandle:
 def _fail_incomplete_entries(entries) -> None:
     status = types.Status.Aborted("background cycle failed; see runtime log")
     for e in entries:
-        cb = e.callback
-        handle = getattr(cb, "__self__", None)
-        done = handle.poll() if hasattr(handle, "poll") else False
-        if cb is not None and not done:
-            cb(status, None)
+        e.complete(status, None)  # exactly-once guard lives on the entry
 
 
 class Runtime:
@@ -130,6 +126,22 @@ class Runtime:
                         "GB/s -> initial fusion threshold %d MB",
                         measured["hbm_gbps"], measured["allreduce_gbps"],
                         measured["fusion_threshold_bytes"] >> 20)
+                # Each process's probe is independently noisy, but
+                # fuse_responses runs per-worker inside the cycle — every
+                # rank must bin-pack cycle 1 with the SAME threshold or
+                # workers dispatch mismatched fused programs. Agree on the
+                # coordinator's measurement before the cycle thread starts
+                # (the per-cycle _autotune_sync takes over from cycle 1's
+                # end).
+                if getattr(self.controller, "world", 1) > 1:
+                    import struct
+
+                    blob = (struct.pack(
+                        "<q", st.config.fusion_threshold_bytes)
+                        if self.controller.is_coordinator else None)
+                    agreed = struct.unpack(
+                        "<q", bytes(self.controller.bcast_blob(blob)))[0]
+                    st.config.fusion_threshold_bytes = agreed
         if self._autotune_active and self.controller.is_coordinator:
             from horovod_tpu.autotune.parameter_manager import (
                 ParameterManager, Params)
@@ -183,14 +195,15 @@ class Runtime:
 
     # -- enqueue APIs (reference: operations.cc:736-843) -------------------
     def _enqueue(self, request_type: str, name: str, tensor,
-                 root_rank: int = 0, average: bool = True,
+                 root_rank: int = 0,
+                 reduce_op: str = types.REDUCE_AVERAGE,
                  priority: int = 0) -> RuntimeHandle:
         if self._stop.is_set():
             raise RuntimeError(types.SHUT_DOWN_ERROR)
         handle = RuntimeHandle(name)
         entry = types.TensorTableEntry(
             name=name, tensor=tensor, request_type=request_type,
-            root_rank=root_rank, average=average,
+            root_rank=root_rank, reduce_op=reduce_op,
             callback=handle._complete,
             dtype=str(tensor.dtype), shape=tuple(tensor.shape),
             enqueue_time=time.monotonic(), priority=priority)
@@ -206,15 +219,23 @@ class Runtime:
         request = msg.Request(
             rank=self.controller.rank, request_type=request_type,
             tensor_name=name, dtype=str(tensor.dtype),
-            shape=wire_shape, root_rank=root_rank, average=average)
+            shape=wire_shape, root_rank=root_rank, reduce_op=reduce_op)
         self.queue.add(entry, request)  # raises DuplicateNameError on misuse
         self._woken.set()  # don't wait out the full cycle for new work
         return handle
 
-    def enqueue_allreduce(self, name: str, tensor, average: bool = True,
+    def enqueue_allreduce(self, name: str, tensor, average: bool = None,
+                          reduce_op: str = None,
                           priority: int = 0) -> RuntimeHandle:
-        return self._enqueue(types.ALLREDUCE, name, tensor, average=average,
-                             priority=priority)
+        if reduce_op is None:
+            reduce_op = (types.REDUCE_AVERAGE
+                         if average is None or average else types.REDUCE_SUM)
+        elif average is not None:
+            raise ValueError("specify either average or reduce_op, not both")
+        elif reduce_op not in types.REDUCE_OPS:
+            raise ValueError(f"unknown reduce_op {reduce_op!r}")
+        return self._enqueue(types.ALLREDUCE, name, tensor,
+                             reduce_op=reduce_op, priority=priority)
 
     def enqueue_allgather(self, name: str, tensor,
                           priority: int = 0) -> RuntimeHandle:
@@ -274,8 +295,7 @@ class Runtime:
                 "background cycle failed; see runtime log")
             for e in self.queue.get_entries(
                     [r.tensor_name for r in requests]):
-                if e.callback is not None:
-                    e.callback(status, None)
+                e.complete(status, None)
             raise
 
     def _run_cycle_body(self, requests, cycle_t0: float) -> bool:

@@ -82,5 +82,4 @@ class TensorQueue:
             self._table.clear()
             self._pending.clear()
         for e in entries:
-            if e.callback is not None:
-                e.callback(status, None)
+            e.complete(status, None)

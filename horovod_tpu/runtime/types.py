@@ -81,6 +81,17 @@ SHUT_DOWN_ERROR = (
 
 StatusCallback = Callable[[Status, Optional[Any]], None]
 
+# Reduction ops carried on allreduce entries/requests over the wire
+# (reference: the op-type dispatch in horovod/torch/mpi_ops_v2.cc:52-76,
+# generalized beyond sum/average).
+REDUCE_SUM = "sum"
+REDUCE_AVERAGE = "average"
+REDUCE_MIN = "min"
+REDUCE_MAX = "max"
+REDUCE_PRODUCT = "product"
+REDUCE_OPS = (REDUCE_SUM, REDUCE_AVERAGE, REDUCE_MIN, REDUCE_MAX,
+              REDUCE_PRODUCT)
+
 
 @dataclasses.dataclass
 class TensorTableEntry:
@@ -94,7 +105,7 @@ class TensorTableEntry:
     tensor: Any
     request_type: str = ALLREDUCE
     root_rank: int = 0
-    average: bool = True
+    reduce_op: str = REDUCE_AVERAGE
     callback: Optional[StatusCallback] = None
     output: Any = None
     # set at enqueue time for negotiation/validation
@@ -105,6 +116,20 @@ class TensorTableEntry:
     # thus fusion) first within a cycle (reference: mxnet ops pass priority
     # to the MXNet engine, horovod/mxnet/mpi_ops.py:52)
     priority: int = 0
+    # completion is tracked on the entry itself so the exactly-once guard
+    # works for ANY callable — not just bound methods of a pollable handle
+    completed: bool = False
+
+    def complete(self, status, output=None) -> None:
+        """Fire the completion callback exactly once. All runtime paths
+        (success, error, shutdown, cycle-failure cleanup) funnel through
+        here, so a double fire is structurally impossible no matter what
+        the callback is wrapped in."""
+        if self.completed:
+            return
+        self.completed = True
+        if self.callback is not None:
+            self.callback(status, output)
 
 
 def entry_nbytes(entry: "TensorTableEntry") -> int:

@@ -53,6 +53,22 @@ def main():
         h = hvd.broadcast_async(
             np.full((4,), float(rank), np.float32), root_rank=1, name="bc/x")
         np.testing.assert_allclose(np.asarray(hvd.synchronize(h)), 1.0)
+        # min/max/product ride the same wire (op-generalized ring kernels;
+        # reference: op-type dispatch of torch/mpi_ops_v2.cc:52-76) —
+        # bit-exact expectations
+        h = hvd.allreduce_async(np.full((3,), float(rank + 1), np.float32),
+                                name="red/min", op=hvd.Min)
+        np.testing.assert_array_equal(np.asarray(hvd.synchronize(h)), 1.0)
+        h = hvd.allreduce_async(np.full((3,), float(rank + 1), np.float32),
+                                name="red/max", op=hvd.Max)
+        np.testing.assert_array_equal(
+            np.asarray(hvd.synchronize(h)), float(world))
+        h = hvd.allreduce_async(np.full((3,), rank + 2, np.int32),
+                                name="red/prod", op=hvd.Product)
+        expect = int(np.prod(np.arange(2, world + 2, dtype=np.int64)))
+        out = np.asarray(hvd.synchronize(h))
+        assert out.dtype == np.int32
+        np.testing.assert_array_equal(out, expect)
         # cache populated
         from horovod_tpu.core import state
         rt = state.global_state().runtime
@@ -149,6 +165,17 @@ def main():
         out = np.asarray(hvd.synchronize(h))
         assert out.dtype == np.int32
         np.testing.assert_array_equal(out, (1 << 24) * world)
+        # min/max/product through the XLA sub-mesh path
+        h = hvd.allreduce_async(
+            np.full((2,), float(hvd.rank() + 1), np.float32),
+            name="spmd/min", op=hvd.Min)
+        np.testing.assert_array_equal(np.asarray(hvd.synchronize(h)), 1.0)
+        h = hvd.allreduce_async(
+            np.full((2,), hvd.rank() + 2, np.int32),
+            name="spmd/prod", op=hvd.Product)
+        np.testing.assert_array_equal(
+            np.asarray(hvd.synchronize(h)),
+            int(np.prod(np.arange(2, world + 2, dtype=np.int64))))
 
     elif scenario == "jit_train":
         # The canonical jax-surface-under-tpurun flow: jax.distributed has
@@ -338,6 +365,19 @@ def main():
         b = hvd.broadcast(np.full((3,), float(rank), np.float32),
                           root_rank=1)
         np.testing.assert_allclose(np.asarray(b), 1.0)
+        # eager min/max/product: same execution modes as sum/average now
+        # (the r1 API-surface inconsistency is gone)
+        out = hvd.allreduce(np.full((4,), float(rank + 1), np.float32),
+                            op=hvd.Min)
+        np.testing.assert_array_equal(np.asarray(out), 1.0)
+        out = hvd.allreduce(np.full((4,), float(rank + 1), np.float32),
+                            op=hvd.Max)
+        np.testing.assert_array_equal(np.asarray(out), float(world))
+        out = hvd.allreduce(np.full((4,), rank + 2, np.int32),
+                            op=hvd.Product)
+        np.testing.assert_array_equal(
+            np.asarray(out),
+            int(np.prod(np.arange(2, world + 2, dtype=np.int64))))
         # grouped: all tensors enqueue before any synchronize, so the
         # runtime fuses them within one cycle
         group = hvd.grouped_allreduce(

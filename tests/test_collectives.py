@@ -300,6 +300,19 @@ class TestAsyncHandles:
         np.testing.assert_allclose(np.asarray(out), np.sum(np.stack(vals), 0))
         assert hvd.poll(handle)
 
+    def test_poll_propagates_errors(self):
+        """An error raised inside is_ready() must surface to the poll()
+        caller — not be reported as 'complete' only to raise from an
+        unrelated wait() later."""
+        from horovod_tpu.ops.collectives import Handle
+
+        class Poisoned:
+            def is_ready(self):
+                raise RuntimeError("device poisoned")
+
+        with pytest.raises(RuntimeError, match="device poisoned"):
+            Handle(Poisoned()).poll()
+
     def test_multiple_in_flight(self, hvd):
         handles = [
             hvd.allreduce_async(

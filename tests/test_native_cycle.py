@@ -24,8 +24,9 @@ pytestmark = pytest.mark.skipif(not native_built(),
 
 
 def _req(name, rtype=types.ALLREDUCE, dtype="float32", shape=(4,), root=0,
-         average=True, rank=0):
-    return msg.Request(rank, rtype, name, dtype, shape, root, average)
+         average=True, rank=0, reduce_op=None):
+    rop = reduce_op or ("average" if average else "sum")
+    return msg.Request(rank, rtype, name, dtype, shape, root, rop)
 
 
 def _resp(req):

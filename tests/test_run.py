@@ -187,6 +187,26 @@ def test_rendezvous_put_get_finish():
         server.stop()
 
 
+def test_rendezvous_port_collision_retry():
+    """An explicit port held by a dying server is retried with backoff
+    instead of failing the launch (port=0 never retries)."""
+    import threading
+
+    holder = RendezvousServer("127.0.0.1")
+    port = holder.start()
+    # while the holder is alive, a no-retry bind must fail fast
+    with pytest.raises(OSError):
+        RendezvousServer("127.0.0.1", port=port, bind_retries=0)
+    releaser = threading.Timer(0.5, holder.stop)
+    releaser.start()
+    try:
+        server = RendezvousServer("127.0.0.1", port=port, bind_retries=25)
+        assert server.start() == port
+        server.stop()
+    finally:
+        releaser.join()
+
+
 def test_rendezvous_waits_for_publication():
     import threading
     import time as time_mod

@@ -22,8 +22,9 @@ from horovod_tpu.runtime.tensor_queue import DuplicateNameError, TensorQueue
 
 
 def _req(name, rank=0, rtype=types.ALLREDUCE, dtype="float32", shape=(4,),
-         root=0, average=True):
-    return msg.Request(rank, rtype, name, dtype, shape, root, average)
+         root=0, average=True, reduce_op=None):
+    rop = reduce_op or ("average" if average else "sum")
+    return msg.Request(rank, rtype, name, dtype, shape, root, rop)
 
 
 class TestMessages:
@@ -364,6 +365,33 @@ class TestStallInspector:
 
         insp = StallInspector(enabled=False, warning_time_seconds=0.0)
         assert insp.check(MessageTable()) is False
+
+
+class TestEntryCompletion:
+    def test_complete_fires_exactly_once(self):
+        calls = []
+        e = types.TensorTableEntry(
+            name="x", tensor=None,
+            callback=lambda s, o: calls.append((s, o)))
+        e.complete(types.Status.OK(), 1)
+        e.complete(types.Status.Aborted("late"), None)
+        assert calls == [(calls[0][0], 1)] and calls[0][0].ok()
+
+    def test_fail_incomplete_guards_any_callable(self):
+        """The double-complete guard must hold for plain function
+        callbacks (e.g. framework-binding wrappers), not only bound
+        methods of a pollable handle."""
+        from horovod_tpu.runtime.runtime import _fail_incomplete_entries
+
+        calls = []
+        done = types.TensorTableEntry(
+            name="x", tensor=None, callback=lambda s, o: calls.append(s))
+        done.complete(types.Status.OK(), None)
+        pending = types.TensorTableEntry(
+            name="y", tensor=None, callback=lambda s, o: calls.append(s))
+        _fail_incomplete_entries([done, pending])
+        assert len(calls) == 2  # done NOT re-fired; pending failed once
+        assert calls[0].ok() and not calls[1].ok()
 
 
 class TestCycleFailureHandling:
