@@ -43,6 +43,38 @@ WARMUP_ITERS = int(os.environ.get("BENCH_WARMUP", "20"))
 TIMED_ROUNDS = int(os.environ.get("BENCH_ROUNDS", "10"))
 BATCHES_PER_ROUND = int(os.environ.get("BENCH_BATCHES_PER_ROUND", "20"))
 
+# ResNet-50 @ 224²: ~4.09 GFLOP forward per image (multiply-add = 2
+# FLOPs); train step fwd + bwd ≈ 3x forward — the convention MFU
+# reporting uses (bwd ≈ 2x fwd FLOPs).
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.089e9
+
+# bf16 peak by device kind (jax.devices()[0].device_kind prefix match) —
+# published per-chip peaks; None -> mfu reported as null
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_chip():
+    kind = jax.devices()[0].device_kind
+    for prefix in sorted(PEAK_BF16_FLOPS, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return PEAK_BF16_FLOPS[prefix]
+    return None
+
+
+def mfu(flops_per_sec_per_chip):
+    peak = peak_flops_per_chip()
+    if peak is None:
+        return None
+    return round(flops_per_sec_per_chip / peak, 4)
+
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
@@ -103,9 +135,96 @@ def main():
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
+        "mfu": mfu(per_chip * RESNET50_TRAIN_FLOPS_PER_IMAGE),
+    }
+    print(json.dumps(result), flush=True)
+
+
+def bert_main():
+    """Second headline: BERT-Base MLM tokens/sec + MFU (BASELINE
+    progression config #5's model family; reference transformer workloads
+    in docs/benchmarks.rst). Flash-attention path (models/transformer.py
+    runs the Pallas kernel)."""
+    import optax as _optax
+
+    from horovod_tpu.models.transformer import BertBase, masked_lm_loss
+
+    hvd.init()
+    n_chips = hvd.size()
+    seq = int(os.environ.get("BENCH_BERT_SEQ", "512"))
+    batch = int(os.environ.get("BENCH_BERT_BATCH", "16"))
+    vocab = 30522
+    global_batch = batch * n_chips
+
+    model = BertBase(vocab_size=vocab, max_seq=seq, dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, vocab, (global_batch, seq)).astype(np.int32)
+    mask = (rng.rand(global_batch, seq) < 0.15).astype(np.int32)
+
+    params = model.init(jax.random.PRNGKey(0), tokens[:1], train=False)
+    opt = hvd.DistributedOptimizer(_optax.adamw(1e-4))
+    opt_state = opt.init(params)
+
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    # training FLOPs/token: 6*N (fwd+bwd matmuls) + attention term
+    # 12*L*S*d (fwd+bwd QK^T and PV at seq length S)
+    l_layers, d_model = 12, 768
+    flops_per_token = 6 * n_params + 12 * l_layers * seq * d_model
+
+    def loss_fn(p, toks, msk):
+        logits = model.apply(p, toks, train=True)
+        return masked_lm_loss(logits, toks, msk)
+
+    @jax.jit
+    def round_fn(p, s, toks, msk):
+        def body(carry, _):
+            p, s = carry
+            loss, g = jax.value_and_grad(loss_fn)(p, toks, msk)
+            upd, s = opt.update(g, s, p)
+            p = _optax.apply_updates(p, upd)
+            return (p, s), loss
+
+        (p, s), losses = jax.lax.scan(body, (p, s), None,
+                                      length=BATCHES_PER_ROUND)
+        return p, s, losses[-1]
+
+    log(f"BERT-Base seq {seq} batch {batch}/chip "
+        f"({n_params / 1e6:.0f}M params), compiling...")
+    t0 = time.perf_counter()
+    params, opt_state, loss = round_fn(params, opt_state, tokens, mask)
+    jax.block_until_ready(loss)
+    log(f"warmup done in {time.perf_counter() - t0:.1f}s "
+        f"(loss={float(loss):.3f})")
+
+    rates = []
+    for r in range(TIMED_ROUNDS):
+        t0 = time.perf_counter()
+        params, opt_state, loss = round_fn(params, opt_state, tokens, mask)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rates.append(global_batch * seq * BATCHES_PER_ROUND / dt)
+        log(f"round {r}: {rates[-1]:.0f} tokens/s")
+
+    tokens_per_sec = float(np.mean(rates))
+    per_chip = tokens_per_sec / n_chips
+    result = {
+        "metric": f"tokens/sec/chip (BERT-Base MLM, bf16, seq {seq}, "
+                  f"batch {batch}/chip, flash attention)",
+        "value": round(per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,  # the reference publishes no absolute
+        # transformer number (docs/benchmarks.rst is ResNet/VGG only)
+        "mfu": mfu(per_chip * flops_per_token),
     }
     print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "bert"])
+    cli = parser.parse_args()
+    bert_main() if cli.model == "bert" else main()

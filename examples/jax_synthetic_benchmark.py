@@ -10,6 +10,7 @@ of num-batches-per-iter batches; reports images/sec and images/sec/chip.
 """
 
 import argparse
+import os
 import time
 
 import jax
@@ -34,12 +35,32 @@ def main():
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--fp16-allreduce", action="store_true",
                         help="bf16 wire compression for gradient exchange")
+    parser.add_argument("--image-size", type=int, default=None,
+                        help="override input resolution (CI smoke runs)")
+    parser.add_argument("--json", action="store_true",
+                        help="rank 0 prints one JSON line with "
+                             "imgs_per_sec / n_chips / scaling_efficiency "
+                             "(the reference's headline metric, "
+                             "docs/benchmarks.rst:16-64)")
+    parser.add_argument("--one-chip-rate", type=float,
+                        default=float(os.environ.get(
+                            "BENCH_ONE_CHIP_IMGS_PER_SEC", "0")) or None,
+                        help="stored 1-chip imgs/sec (run once with -np 1) "
+                             "for the scaling_efficiency denominator; also "
+                             "via BENCH_ONE_CHIP_IMGS_PER_SEC")
+    parser.add_argument("--platform", default=None,
+                        help="force a jax platform (e.g. 'cpu' for "
+                             "virtual-device CI runs; overrides site "
+                             "config, must run before first device use)")
     args = parser.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     hvd.init()
     model = getattr(models, args.model)(num_classes=1000,
                                         dtype=jnp.bfloat16)
-    image_size = 299 if args.model == "InceptionV3" else 224
+    image_size = args.image_size or (
+        299 if args.model == "InceptionV3" else 224)
     compression = (hvd.Compression.fp16 if args.fp16_allreduce
                    else hvd.Compression.none)
     opt = hvd.DistributedOptimizer(
@@ -92,6 +113,17 @@ def main():
         mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
         print(f"Img/sec total: {mean:.1f} +- {conf:.1f}")
         print(f"Img/sec per chip: {mean / hvd.size():.1f}")
+        if args.json:
+            import json
+
+            n = hvd.size()
+            efficiency = (round(mean / (n * args.one_chip_rate), 4)
+                          if args.one_chip_rate else None)
+            print(json.dumps({
+                "imgs_per_sec": round(float(mean), 1),
+                "n_chips": n,
+                "scaling_efficiency": efficiency,
+            }), flush=True)
 
 
 if __name__ == "__main__":

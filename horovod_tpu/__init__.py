@@ -51,8 +51,10 @@ from horovod_tpu.ops.collectives import (
     Max,
     Product,
     Handle,
+    OrderedLaneError,
     allreduce,
     allreduce_async,
+    assert_collective_lane_clear,
     allgather,
     allgather_async,
     alltoall,
@@ -116,6 +118,7 @@ __all__ = [
     "allgather", "allgather_async", "broadcast", "broadcast_async",
     "reducescatter", "alltoall", "stack_per_worker",
     "Handle", "poll", "synchronize",
+    "OrderedLaneError", "assert_collective_lane_clear",
     # data-parallel API
     "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",

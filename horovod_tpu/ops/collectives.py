@@ -64,6 +64,55 @@ def _global_axes(axis_name):
     return axis_name
 
 
+class OrderedLaneError(RuntimeError):
+    """A global-mesh collective program was about to be dispatched from a
+    caller thread while named async collectives were still in flight on
+    the background runtime lane.
+
+    In a multi-process (SPMD) world every rank must issue collective
+    programs in the SAME order; the enqueue runtime's background thread is
+    the single ordered issuer for dynamically-timed ops (reference
+    architecture note: operations.cc:281-300). Interleaving a caller-thread
+    global program with in-flight named ops can order programs differently
+    per rank — a hang or garbage, which the reference's analogous misuse
+    paths turn into errors (tensor_queue.cc:26-29). Synchronize the
+    outstanding handles first."""
+
+
+def _lane_check() -> None:
+    """Raise instead of hanging on the documented cross-rank
+    program-order hazard (docs/troubleshooting.md: one ordered collective
+    lane). Only the multi-process SPMD mode is at risk; the runtime's own
+    background thread IS the lane and is exempt."""
+    if jax.process_count() <= 1:
+        return
+    st = state_mod.global_state()
+    rt = getattr(st, "runtime", None)
+    if rt is None:
+        return
+    if threading.current_thread() is getattr(rt, "_thread", None):
+        return
+    n = rt.in_flight()
+    if n:
+        raise OrderedLaneError(
+            f"{n} named async collective(s) are still in flight on the "
+            "background runtime lane; dispatching a global-mesh collective "
+            "program from the caller thread now can interleave collective "
+            "programs differently across ranks (hang/garbage). Call "
+            "hvd.synchronize() on the outstanding handles (or "
+            "optimizer.step() in the torch binding) first — see "
+            "docs/troubleshooting.md, 'one ordered collective lane'.")
+
+
+def assert_collective_lane_clear() -> None:
+    """Public guard for user-owned global programs: call before
+    dispatching your own jitted global-mesh step (e.g. a pjit train step)
+    in multi-process mode; raises :class:`OrderedLaneError` if named async
+    collectives are still in flight instead of risking the documented
+    cross-rank interleaving hang."""
+    _lane_check()
+
+
 def _resolve_op(average: Optional[bool], op: Optional[int]) -> int:
     if op is not None and average is not None:
         raise ValueError("specify either average or op, not both")
@@ -131,6 +180,10 @@ _jit_cache_lock = threading.Lock()
 
 
 def _cached(key, builder):
+    # Every eager stacked-dispatch site fetches its compiled program here
+    # at call time, so this is the one chokepoint for the ordered-lane
+    # misuse check (raise instead of the documented cross-rank hang).
+    _lane_check()
     with _jit_cache_lock:
         fn = _jit_cache.get(key)
         if fn is None:

@@ -1,0 +1,175 @@
+"""NIC / interface discovery for the launcher.
+
+TPU-native port of the reference's ring interface probe (reference:
+horovod/run/run.py:195-265 ``_driver_fn`` + horovod/run/task_fn.py:24-50):
+before fan-out, a task agent starts on every host, registers its candidate
+addresses with the driver, probes the *next* host's candidates in a ring,
+and the driver intersects the results. Where the reference intersects
+interface *names* (for Gloo's ``iface=`` binding), the TPU launcher needs
+proven-routable *addresses*: the rendezvous / jax.distributed coordinator
+address handed to workers must be one the workers demonstrably reached —
+not whatever ``gethostbyname`` returns on a multi-NIC host.
+
+Products:
+* ``driver_addr`` — the driver candidate address every task actually used
+  to register (majority vote), fed into ``HOROVOD_GLOO_RENDEZVOUS_ADDR`` /
+  ``HOROVOD_COORDINATOR_ADDR``.
+* ``host_routable`` — per host index, the addresses its ring predecessor
+  reached with an authenticated ping; exported as a diagnostic and usable
+  as a bind hint.
+
+Remote agents are spawned over ssh (``python -m horovod_tpu.run.task_agent``)
+exactly as the reference spawns ``task_fn`` on every host; local hosts run
+the agent in-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.run import util
+from horovod_tpu.run.service import (DriverService, ProbeAddressesRequest,
+                                     ServiceClient, ShutdownServiceRequest,
+                                     TaskService, local_addresses)
+
+
+@dataclasses.dataclass
+class DiscoveryResult:
+    driver_addr: str
+    host_routable: Dict[int, List[Tuple[str, int]]]
+
+
+def _client_for(addresses: List[Tuple[str, int]], key: bytes
+                ) -> ServiceClient:
+    """Client bound to the first address that answers an authenticated
+    ping (a task registers ALL its candidate addresses; the driver may
+    only be able to route to some of them)."""
+    last_exc: Optional[Exception] = None
+    for addr in addresses:
+        client = ServiceClient(tuple(addr), key, timeout=3.0)
+        try:
+            client.call(ProbeAddressesRequest([]))
+            return client
+        except Exception as exc:  # noqa: BLE001 — try the next candidate
+            last_exc = exc
+    raise RuntimeError(
+        f"no registered task address reachable from the driver: "
+        f"{addresses} ({last_exc})")
+
+
+
+
+def _ssh_agent(hostname: str, index: int, num_hosts: int, key: bytes,
+               driver_addrs: List[Tuple[str, int]],
+               ssh_port: Optional[int], timeout: float) -> subprocess.Popen:
+    addrs = ",".join(f"{h}:{p}" for h, p in driver_addrs)
+    inner = (f"HOROVOD_TASK_KEY={key.hex()} {shlex.quote(sys.executable)} "
+             f"-m horovod_tpu.run.task_agent {index} {num_hosts} "
+             f"{shlex.quote(addrs)} {int(timeout)}")
+    port_arg = f"-p {ssh_port} " if ssh_port else ""
+    cmd = (f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no "
+           f"{port_arg}{hostname} "
+           f"{shlex.quote(f'cd {os.getcwd()} 2>/dev/null; {inner}')}")
+    return subprocess.Popen(cmd, shell=True, start_new_session=True)
+
+
+def discover(hostnames: List[str], key: bytes,
+             is_local: Optional[callable] = None,
+             ssh_port: Optional[int] = None,
+             timeout: float = 120.0) -> DiscoveryResult:
+    """Run the ring probe across ``hostnames`` (one agent per host) and
+    return the proven driver address plus per-host routable addresses.
+
+    ``is_local`` decides in-process vs ssh agent (default: the launcher's
+    ``is_local_host``)."""
+    if is_local is None:
+        from horovod_tpu.run.launcher import is_local_host as is_local
+
+    n = len(hostnames)
+    driver = DriverService(key, n)
+    driver_addrs = local_addresses(driver.port)
+    local_tasks: List[TaskService] = []
+    ssh_procs: List[subprocess.Popen] = []
+    try:
+        agent_threads = []
+        for index, host in enumerate(hostnames):
+            if is_local(host):
+                task = TaskService(key, index)
+                local_tasks.append(task)
+                t = threading.Thread(
+                    target=task.register_any,
+                    args=(driver_addrs, key,
+                          util.Timeout(timeout, "task registration")),
+                    daemon=True)
+                t.start()
+                agent_threads.append(t)
+            else:
+                ssh_procs.append(_ssh_agent(host, index, n, key,
+                                            driver_addrs, ssh_port, timeout))
+        driver.wait_for_initial_registration(
+            util.Timeout(timeout, "task registration (NIC discovery)"))
+        for t in agent_threads:
+            t.join(timeout=timeout)
+
+        task_addresses = driver.task_addresses()
+        # ring probe: task i checks the candidates of task (i+1) % n; an
+        # authenticated pong proves routability host-to-host (not just
+        # driver-to-host)
+        host_routable: Dict[int, List[Tuple[str, int]]] = {}
+        for index in range(n):
+            succ = (index + 1) % n
+            client = _client_for(task_addresses[index], key)
+            reachable = client.call(
+                ProbeAddressesRequest(task_addresses[succ]))
+            host_routable[succ] = [tuple(a) for a in reachable]
+        empty = [i for i in range(n) if not host_routable[i]]
+        if empty:
+            raise RuntimeError(
+                "NIC discovery: no routable address found for host(s) "
+                f"{[hostnames[i] for i in empty]}; candidates were "
+                f"{ {i: task_addresses[i] for i in empty} } "
+                "(reference raises the same way when no common interface "
+                "exists, run/run.py:253-262)")
+
+        # the driver address EVERY task proved it can reach — an
+        # intersection, like the reference's common_intfs (run/run.py:
+        # 253-262); a majority pick would hand minority hosts an address
+        # they demonstrably cannot route to
+        reachable_sets = [set(addrs) for addrs in
+                          driver.task_driver_reachable().values()]
+        common = set.intersection(*reachable_sets) if reachable_sets else set()
+        if not common:
+            raise RuntimeError(
+                "NIC discovery: no driver address is reachable from every "
+                f"host; per-task reachable sets: "
+                f"{driver.task_driver_reachable()}")
+        # deterministic preference: candidate order (default-route
+        # address first, loopback last — service.local_addresses)
+        driver_addr = next(a[0] for a in driver_addrs if tuple(a) in common)
+        return DiscoveryResult(driver_addr=driver_addr,
+                               host_routable=host_routable)
+    finally:
+        if ssh_procs:
+            # tell remote agents to exit (best-effort), then reap
+            local_idx = {t.index for t in local_tasks}
+            for index, addrs in driver.task_addresses().items():
+                if index in local_idx:
+                    continue
+                try:
+                    _client_for(addrs, key).call(ShutdownServiceRequest())
+                except Exception:
+                    pass
+            for proc in ssh_procs:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        for task in local_tasks:
+            task.shutdown()
+        driver.shutdown()

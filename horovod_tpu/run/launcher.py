@@ -115,6 +115,26 @@ def launch_job(command: str, slots: List[SlotInfo],
     base_env = dict(os.environ if env is None else env)
     driver_ip = get_driver_ip(slots)
 
+    # NIC discovery (reference: run/run.py:195-265): on multi-NIC hosts
+    # the heuristic driver_ip may not be the address workers can route
+    # to — run the ring probe and use the proven address. Default: on
+    # whenever a remote host is involved; HOROVOD_NIC_DISCOVERY=1 forces
+    # it for all-local runs (tests), =0 disables.
+    knob = base_env.get("HOROVOD_NIC_DISCOVERY", "").lower()
+    any_remote = not all(is_local_host(s.hostname) for s in slots)
+    if knob not in ("0", "false", "off") and (
+            any_remote or knob in ("1", "true", "on")):
+        from horovod_tpu.run import discovery as discovery_mod
+
+        hostnames = list(dict.fromkeys(s.hostname for s in slots))
+        try:
+            found = discovery_mod.discover(
+                hostnames, util.make_secret_key(), ssh_port=ssh_port)
+            driver_ip = found.driver_addr
+        except Exception as exc:  # fall back to the heuristic address
+            print(f"tpurun: NIC discovery failed ({exc}); using "
+                  f"{driver_ip}", file=sys.stderr)
+
     rendezvous = RendezvousServer()
     http_port = rendezvous.start()
     socket_port = _free_port()

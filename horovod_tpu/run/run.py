@@ -117,6 +117,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
          help="Chrome-trace timeline output (rank 0).")
     _add(timeline, "--timeline-mark-cycles", dest="timeline_mark_cycles",
          action="store_true", help="Mark cycles in the timeline.")
+    _add(timeline, "--merge-trace", dest="merge_trace", metavar="OUT",
+         help="Merge Chrome trace files (per-rank timelines, device "
+              "traces exported as Chrome JSON / .json.gz) into OUT and "
+              "exit; inputs follow as positional arguments.")
+    _add(timeline, "--merge-trace-align", dest="merge_trace_align",
+         action="store_true",
+         help="With --merge-trace: rebase each input's earliest event to "
+              "a common origin (for traces not in the epoch clock "
+              "domain).")
 
     autotune = parser.add_argument_group("autotune")
     _add(autotune, "--autotune", dest="autotune", action="store_true",
@@ -270,6 +279,17 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     command = list(args.command or [])
     if command and command[0] == "--":
         command = command[1:]
+    if args.merge_trace:
+        from horovod_tpu.timeline import merge_traces
+
+        if not command:
+            sys.stderr.write("tpurun --merge-trace: no input traces\n")
+            return 2
+        n = merge_traces(args.merge_trace, command,
+                         align=args.merge_trace_align)
+        print(f"merged {n} events from {len(command)} trace(s) into "
+              f"{args.merge_trace}")
+        return 0
     if not command:
         sys.stderr.write("tpurun: no command given\n")
         return 2

@@ -29,6 +29,14 @@ class RegisterTaskRequest:
     index: int
     addresses: List[Tuple[str, int]]
     host_hash: str
+    # which of the driver's candidate addresses this task actually reached
+    # (NIC discovery: the proven-routable driver address wins over the
+    # gethostbyname guess; reference: run/run.py:195-265)
+    driver_addr_used: Optional[Tuple[str, int]] = None
+    # the FULL reachable subset — the driver must pick an address common
+    # to every host (intersection, like the reference's common_intfs),
+    # not a majority winner a minority host provably cannot reach
+    driver_addrs_reachable: Optional[List[Tuple[str, int]]] = None
 
 
 @dataclasses.dataclass
@@ -49,6 +57,21 @@ class CommandExitCodeRequest:
 
 @dataclasses.dataclass
 class PingRequest:
+    pass
+
+
+@dataclasses.dataclass
+class ProbeAddressesRequest:
+    """Ask a task to probe candidate (ip, port) addresses of its ring
+    successor and report the reachable subset (reference: task_fn.py:24-50
+    — tasks ping each other in a ring to weed out NAT'ed/dead
+    interfaces)."""
+
+    addresses: List[Tuple[str, int]]
+
+
+@dataclasses.dataclass
+class ShutdownServiceRequest:
     pass
 
 
@@ -110,6 +133,8 @@ class BasicService:
     """Threaded TCP service with the HMAC wire protocol."""
 
     def __init__(self, key: bytes, port: int = 0):
+        self._key = key
+        self.shutdown_requested = threading.Event()
         self._server = socketserver.ThreadingTCPServer(
             ("0.0.0.0", port), _WireHandler, bind_and_activate=True)
         self._server.daemon_threads = True
@@ -129,6 +154,11 @@ class BasicService:
 
     def _handle(self, req):
         if isinstance(req, PingRequest):
+            return OkResponse()
+        if isinstance(req, ShutdownServiceRequest):
+            # acknowledge first; the owner (task agent) tears down the
+            # server after seeing the event
+            self.shutdown_requested.set()
             return OkResponse()
         return ErrorResponse(f"unhandled request {type(req).__name__}")
 
@@ -171,6 +201,27 @@ class DriverService(BasicService):
         with self._lock:
             return {i: t.host_hash for i, t in self._tasks.items()}
 
+    def task_driver_addrs(self) -> Dict[int, Optional[Tuple[str, int]]]:
+        """Which driver address each task registered through (NIC
+        discovery input)."""
+        with self._lock:
+            return {i: t.driver_addr_used for i, t in self._tasks.items()}
+
+    def task_driver_reachable(self) -> Dict[int, list]:
+        """Each task's full reachable-driver-address subset (falls back
+        to the single registration address for agents that did not probe
+        the full set)."""
+        with self._lock:
+            out = {}
+            for i, t in self._tasks.items():
+                if t.driver_addrs_reachable:
+                    out[i] = [tuple(a) for a in t.driver_addrs_reachable]
+                elif t.driver_addr_used:
+                    out[i] = [tuple(t.driver_addr_used)]
+                else:
+                    out[i] = []
+            return out
+
 
 class TaskService(BasicService):
     """Per-host agent: registers with the driver, can run commands
@@ -199,12 +250,15 @@ class TaskService(BasicService):
             if proc is None:
                 return OkResponse(None)
             return OkResponse(proc.poll())
+        if isinstance(req, ProbeAddressesRequest):
+            return OkResponse(probe_reachable(req.addresses, self._key))
         return super()._handle(req)
 
     def register(self, driver_addr: Tuple[str, int], key: bytes,
                  timeout: Optional[util.Timeout] = None) -> None:
         req = RegisterTaskRequest(
-            self.index, local_addresses(self.port), util.host_hash())
+            self.index, local_addresses(self.port), util.host_hash(),
+            driver_addr_used=driver_addr)
         client = ServiceClient(driver_addr, key)
         timeout = timeout or util.Timeout(60, "driver registration")
         while True:
@@ -214,6 +268,46 @@ class TaskService(BasicService):
             except (ConnectionError, OSError):
                 timeout.check()
                 time.sleep(0.2)
+
+    def register_any(self, driver_addrs: List[Tuple[str, int]], key: bytes,
+                     timeout: Optional[util.Timeout] = None
+                     ) -> Tuple[str, int]:
+        """Probe ALL the driver's candidate addresses, then register via
+        the first reachable one, reporting the full reachable subset (the
+        driver intersects these across hosts to pick the rendezvous
+        address every host can actually route to)."""
+        timeout = timeout or util.Timeout(60, "driver registration")
+        while True:
+            reachable = probe_reachable(driver_addrs, key)
+            for addr in reachable:
+                req = RegisterTaskRequest(
+                    self.index, local_addresses(self.port),
+                    util.host_hash(), driver_addr_used=tuple(addr),
+                    driver_addrs_reachable=[tuple(a) for a in reachable])
+                try:
+                    ServiceClient(addr, key, timeout=3.0).call(req)
+                    return tuple(addr)
+                except (ConnectionError, OSError):
+                    continue
+            timeout.check()
+            time.sleep(0.2)
+
+
+def probe_reachable(addresses: List[Tuple[str, int]],
+                    key: bytes, timeout: float = 3.0
+                    ) -> List[Tuple[str, int]]:
+    """Authenticated-ping each candidate address; return the subset that
+    answered. An HMAC-verified pong proves the address routes to a live
+    peer service, not a NAT artifact (reference: task_fn.py match_intf)."""
+    good: List[Tuple[str, int]] = []
+    for addr in addresses:
+        try:
+            ServiceClient(tuple(addr), key, timeout=timeout).call(
+                PingRequest())
+            good.append(tuple(addr))
+        except Exception:
+            continue
+    return good
 
 
 class ServiceClient:

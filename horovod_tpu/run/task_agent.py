@@ -1,0 +1,52 @@
+"""Per-host NIC-discovery agent, spawned over ssh by the launcher.
+
+TPU-native analogue of the reference's ``task_fn`` executable (reference:
+horovod/run/task_fn.py:24-63, spawned by run.py:143-171): starts a
+:class:`TaskService`, registers its candidate addresses with the driver
+(reporting which driver address proved reachable), answers ring-probe
+requests from the driver, and exits on ``ShutdownServiceRequest``.
+
+Usage (what ``discovery._ssh_agent`` generates)::
+
+    HOROVOD_TASK_KEY=<hex> python -m horovod_tpu.run.task_agent \
+        <index> <num_hosts> <driver_host:port,...> <timeout_seconds>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from horovod_tpu.run import util
+from horovod_tpu.run.service import TaskService
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 4:
+        print("usage: task_agent <index> <num_hosts> <driver_addrs> "
+              "<timeout_s>", file=sys.stderr)
+        return 2
+    index = int(argv[0])
+    timeout_s = float(argv[3])
+    driver_addrs = []
+    for part in argv[2].split(","):
+        host, port = part.rsplit(":", 1)
+        driver_addrs.append((host, int(port)))
+    key = bytes.fromhex(os.environ["HOROVOD_TASK_KEY"])
+
+    task = TaskService(key, index)
+    try:
+        task.register_any(driver_addrs, key,
+                          util.Timeout(timeout_s, "driver registration"))
+        if not task.shutdown_requested.wait(timeout=timeout_s):
+            print(f"task_agent {index}: no shutdown signal within "
+                  f"{timeout_s}s, exiting", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        task.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

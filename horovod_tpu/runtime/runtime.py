@@ -168,6 +168,11 @@ class Runtime:
                 gp_noise=st.config.autotune_gaussian_process_noise,
                 log_path=st.config.autotune_log, rank=st.rank,
                 sweep=tuple(sweep))
+        # enqueued-but-not-completed count, for the ordered-lane misuse
+        # guard (ops/collectives._lane_check): covers both queued entries
+        # and entries popped for execution
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._stop = threading.Event()
         self._woken = threading.Event()
         self._thread = threading.Thread(
@@ -201,10 +206,16 @@ class Runtime:
         if self._stop.is_set():
             raise RuntimeError(types.SHUT_DOWN_ERROR)
         handle = RuntimeHandle(name)
+
+        def _on_complete(status, output, _h=handle):
+            with self._inflight_lock:
+                self._inflight -= 1
+            _h._complete(status, output)
+
         entry = types.TensorTableEntry(
             name=name, tensor=tensor, request_type=request_type,
             root_rank=root_rank, reduce_op=reduce_op,
-            callback=handle._complete,
+            callback=_on_complete,
             dtype=str(tensor.dtype), shape=tuple(tensor.shape),
             enqueue_time=time.monotonic(), priority=priority)
         # The announced shape is the PER-WORKER tensor shape — for a
@@ -220,9 +231,24 @@ class Runtime:
             rank=self.controller.rank, request_type=request_type,
             tensor_name=name, dtype=str(tensor.dtype),
             shape=wire_shape, root_rank=root_rank, reduce_op=reduce_op)
-        self.queue.add(entry, request)  # raises DuplicateNameError on misuse
+        # count BEFORE the entry becomes visible to the cycle thread —
+        # otherwise a fast cycle can complete (and decrement) first and
+        # the counter transiently goes negative
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            self.queue.add(entry, request)  # DuplicateNameError on misuse
+        except BaseException:
+            with self._inflight_lock:
+                self._inflight -= 1
+            raise
         self._woken.set()  # don't wait out the full cycle for new work
         return handle
+
+    def in_flight(self) -> int:
+        """Named async collectives enqueued but not yet completed."""
+        with self._inflight_lock:
+            return self._inflight
 
     def enqueue_allreduce(self, name: str, tensor, average: bool = None,
                           reduce_op: str = None,

@@ -15,9 +15,16 @@ timeline.h:77).
 
 Enable with ``HOROVOD_TIMELINE=/path/to/trace.json``; optional per-cycle
 markers with ``HOROVOD_TIMELINE_MARK_CYCLES`` (reference:
-operations.cc:363-375). Merge with device-side traces via
-``jax.profiler.trace`` separately — this file covers the host-side
-coordination plane, the analogue of the reference's CPU-side events.
+operations.cc:363-375).
+
+Timestamps are **epoch microseconds** (one clock domain across ranks and
+across trace producers), so per-rank timelines and device-side traces
+exported as Chrome JSON (e.g. ``jax.profiler.trace`` via TensorBoard's
+profile plugin) compose into ONE merged view with
+``tpurun --merge-trace out.json rank0.json rank1.json device.json.gz``
+(:func:`merge_traces`) — the analogue of the reference's single
+host+device Chrome trace (reference: timeline.cc,
+cuda_operations.cc:69-93 event timestamps).
 """
 
 from __future__ import annotations
@@ -140,12 +147,13 @@ class Timeline:
         self._lock = threading.Lock()
         self._tensor_pids: dict[str, int] = {}
         self._next_pid = 1
-        self._start_ns = time.monotonic_ns()
         self._cycle = 0
 
     # -- helpers -----------------------------------------------------------
     def _ts_us(self) -> float:
-        return (time.monotonic_ns() - self._start_ns) / 1e3
+        # epoch domain so traces from different ranks/producers align
+        # (double keeps microsecond precision: 2^53 us >> epoch us)
+        return time.time_ns() / 1e3
 
     def _pid(self, tensor_name: str) -> int:
         pid = self._tensor_pids.get(tensor_name)
@@ -203,3 +211,68 @@ class Timeline:
         # teardown (hvd_tl_close frees the C++ ring)
         with self._lock:
             self._writer.close()
+
+
+# ---------------------------------------------------------------------------
+# Trace merging (the reference writes host+device into ONE Chrome trace,
+# timeline.cc + cuda_operations.cc:69-93; here separate producers share the
+# epoch clock domain and this merges their files)
+# ---------------------------------------------------------------------------
+
+def _load_trace_events(path: str) -> list:
+    """Read a Chrome trace: plain or gzipped, 'JSON Array' or
+    '{"traceEvents": [...]}' object format."""
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        # tolerate the truncated array a crashed writer leaves behind
+        # (Chrome tracing does the same)
+        data = json.loads(text.rstrip().rstrip(",") + "]")
+    events = data.get("traceEvents", []) if isinstance(data, dict) else data
+    return [e for e in events if isinstance(e, dict) and "ph" in e]
+
+
+def merge_traces(out_path: str, inputs: list, align: bool = False) -> int:
+    """Merge Chrome trace files into one (``tpurun --merge-trace``).
+
+    Each input's pids are offset into a private range (a label metadata
+    event names the source file) so per-rank timelines and device traces
+    coexist; timestamps are preserved — every producer in this package
+    stamps epoch microseconds, so events interleave truthfully. Traces
+    from producers with a different zero (``align=True``) are rebased so
+    each file's earliest event sits at a common origin instead.
+
+    Returns the number of events written.
+    """
+    merged = []
+    pid_base = 0
+    for path in inputs:
+        events = _load_trace_events(path)
+        pids = [e.get("pid", 0) for e in events]
+        max_pid = max(pids, default=0)
+        tss = [e["ts"] for e in events if isinstance(e.get("ts"),
+                                                     (int, float))]
+        base_ts = min(tss, default=0.0)
+        # label EVERY pid this file uses (a single label at one pid would
+        # orphan device traces whose events sit on nonzero pids)
+        label = f"[{path.rsplit('/', 1)[-1]}]"
+        for orig_pid in sorted(set(pids)):
+            merged.append({"ph": "M", "pid": orig_pid + pid_base, "ts": 0,
+                           "name": "process_labels",
+                           "args": {"labels": label}})
+        for e in events:
+            e = dict(e)
+            e["pid"] = e.get("pid", 0) + pid_base
+            if align and isinstance(e.get("ts"), (int, float)):
+                e["ts"] = e["ts"] - base_ts
+            merged.append(e)
+        pid_base += max_pid + 2
+    merged.sort(key=lambda e: (e.get("ts") or 0))
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged}, f)
+    return len(merged)

@@ -387,6 +387,144 @@ def main():
             assert g.shape == (k + 1,)
             np.testing.assert_allclose(
                 np.asarray(g), np.sum(np.arange(world, dtype=np.float32)))
+    elif scenario == "soak":
+        # Combined stress (VERDICT r1 #8): autotune param sync + cache
+        # churn/invalidation + skewed arrival + torch hooks + eager
+        # interleave, all SIMULTANEOUSLY for SOAK_SECONDS, then a
+        # bit-alignment audit. Each ingredient has a dedicated test; this
+        # proves they compose (the reference's tests run the whole runtime
+        # under mpirun the same way, SURVEY.md §4).
+        import time
+
+        import torch
+        import horovod_tpu.torch as thvd
+
+        soak_seconds = float(os.environ.get("SOAK_SECONDS", "45"))
+        rng = np.random.RandomState(1000 + rank)
+
+        model = torch.nn.Linear(6, 3)
+        for p in model.parameters():  # identical start on every rank
+            torch.nn.init.constant_(p, 0.5)
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.01),
+            named_parameters=model.named_parameters())
+
+        n_churn = 6  # 2x the cache capacity set by the test
+        shapes = [(4,), (8,)]
+        deadline = time.monotonic() + soak_seconds
+        it = 0
+        world_mean = np.mean(np.arange(world, dtype=np.float32))
+        # time-bounded, but with an iteration floor so a heavily loaded
+        # box still does real combined work (and a ceiling so a fast box
+        # is bounded by the deadline, not the floor)
+        min_iters = int(os.environ.get("SOAK_MIN_ITERS", "5"))
+        debug = os.environ.get("SOAK_DEBUG")
+        while True:
+            # Collective termination: per-rank clocks diverge, and a rank
+            # that exits one iteration before its peers strands their last
+            # enqueues forever. The continue flag is itself a Min
+            # allreduce over the new wire op — every rank stops at the
+            # SAME iteration, the first one where any rank's deadline
+            # passed.
+            my_continue = 1.0 if (time.monotonic() < deadline
+                                  or it < min_iters) else 0.0
+            cont = hvd.synchronize(hvd.allreduce_async(
+                np.full((1,), my_continue, np.float32),
+                name="soak/continue", op=hvd.Min))
+            if float(np.asarray(cont)[0]) < 1.0:
+                break
+            it += 1
+            if debug:
+                print(f"[r{rank}] iter {it} "
+                      f"t={time.monotonic() - deadline + soak_seconds:.1f}",
+                      file=sys.stderr, flush=True)
+            # skewed arrival: per-rank jitter far beyond the cycle time
+            time.sleep(float(rng.uniform(0, 0.02)))
+            # cache churn: rotating names, period-flipping shapes
+            # (invalidation), random submission order per rank
+            order = rng.permutation(n_churn)  # per-rank order
+            shape = shapes[(it // 7) % 2]
+            handles = [
+                hvd.allreduce_async(
+                    np.full(shape, float(rank), np.float32),
+                    name=f"soak/churn_{k}")
+                for k in order
+            ]
+            # torch hook-driven step on per-rank data (its own named ops)
+            x = torch.full((5, 6), float(rank + it % 3))
+            opt.zero_grad()
+            model(x).sum().backward()
+            opt.step()
+            # eager interleave: unnamed op through the same ordered lane
+            out = hvd.allreduce(np.full((3,), float(rank), np.float32))
+            np.testing.assert_allclose(np.asarray(out), world_mean,
+                                       rtol=1e-5)
+            for h in handles:
+                np.testing.assert_allclose(
+                    np.asarray(hvd.synchronize(h)), world_mean, rtol=1e-5)
+
+        # parameters must not have diverged across ranks (hooks averaged
+        # every gradient)
+        digest = thvd.allgather(
+            torch.cat([p.detach().reshape(-1)
+                       for p in model.parameters()]).reshape(1, -1),
+            name="soak/weights")
+        for r in range(1, world):
+            assert torch.equal(digest[0], digest[r]), \
+                f"rank weights diverged after {it} iterations"
+        # bit-alignment audit: every rank's cache must map the same names
+        # to the same bits (the invariant cache churn attacks)
+        from horovod_tpu.core import state as state_mod
+
+        cache = state_mod.global_state().runtime.controller.cache
+        bits = ";".join(
+            f"{k}={cache.bit_for_name(f'soak/churn_{k}')}"
+            for k in range(n_churn))
+        assert it >= min_iters
+        blobs = hvd.synchronize(hvd.allgather_async(
+            np.frombuffer(bits.ljust(256).encode(), dtype=np.uint8)
+            .reshape(1, -1).copy(), name="soak/bits"))
+        rows = np.asarray(blobs)
+        for r in range(1, world):
+            assert np.array_equal(rows[0], rows[r]), (
+                "cache bit maps diverged:\n"
+                + rows[0].tobytes().decode()
+                + "\nvs\n" + rows[r].tobytes().decode())
+        print(f"soak: {it} iterations, bit map {bits!r}", flush=True)
+
+    elif scenario == "lane_misuse":
+        # SPMD mode only: a caller-thread global-mesh program while named
+        # async ops are in flight is the documented cross-rank
+        # program-order hazard (docs/troubleshooting.md) — it must RAISE
+        # now, not hang. Legal path first: nothing in flight, eager
+        # stacked dispatch is fine.
+        import jax as _jax
+
+        assert _jax.process_count() == world
+        s = hvd.stack_per_worker(
+            [np.full((2,), float(r), np.float32) for r in range(world)])
+        out = hvd.allreduce(s, op=hvd.Sum)
+        np.testing.assert_allclose(
+            np.asarray(out), np.sum(np.arange(world, dtype=np.float32)))
+        # a name only this rank announces can never complete -> stays in
+        # flight deterministically
+        h = hvd.allreduce_async(np.full((4,), 1.0, np.float32),
+                                name=f"lane/only_rank_{rank}")
+        try:
+            hvd.allreduce(s, op=hvd.Sum)
+        except hvd.OrderedLaneError:
+            pass
+        else:
+            raise AssertionError("expected OrderedLaneError")
+        # the public guard for user-owned pjit programs sees it too
+        try:
+            hvd.assert_collective_lane_clear()
+        except hvd.OrderedLaneError:
+            pass
+        else:
+            raise AssertionError("expected OrderedLaneError from guard")
+        del h  # completed with SHUT_DOWN_ERROR at shutdown
+
     elif scenario == "cache_churn":
         # Tiny cache capacity + periodically changing shapes: constant
         # evictions (LRU bit recycling) and synchronized invalidations

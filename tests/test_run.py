@@ -367,6 +367,62 @@ def _run_mp_worker(monkeypatch, scenario, extra_flags=()):
         ["-np", "2", *extra_flags, sys.executable, worker, scenario])
 
 
+# ---------------------------------------------------------------------------
+# NIC discovery (reference: run/run.py:195-265 ring probe)
+# ---------------------------------------------------------------------------
+
+def test_nic_discovery_filters_unroutable(monkeypatch):
+    """The ring probe drops candidate addresses nothing can reach and the
+    driver address is one tasks actually used — mocked multi-NIC setup."""
+    from horovod_tpu.run import discovery, service, util as run_util
+
+    real_local_addresses = service.local_addresses
+
+    def fake_local_addresses(port):
+        # a dead NIC candidate first: TEST-NET-1, guaranteed unroutable
+        return [("192.0.2.1", port)] + real_local_addresses(port)
+
+    monkeypatch.setattr(service, "local_addresses", fake_local_addresses)
+    key = run_util.make_secret_key()
+    result = discovery.discover(
+        ["localhost", "localhost"], key, is_local=lambda h: True,
+        timeout=60.0)
+    assert result.driver_addr and result.driver_addr != "192.0.2.1"
+    assert set(result.host_routable) == {0, 1}
+    for idx, addrs in result.host_routable.items():
+        assert addrs, f"host {idx} has no routable address"
+        assert all(ip != "192.0.2.1" for ip, _ in addrs)
+
+
+def test_nic_discovery_raises_when_unreachable(monkeypatch):
+    """No routable address -> a clear error naming the host (reference
+    raises the same way, run/run.py:253-262)."""
+    from horovod_tpu.run import discovery, service, util as run_util
+
+    # deny only the ring probes (registration still works): the same
+    # code path as all-dead NICs between hosts
+    real_handle = service.TaskService._handle
+
+    def deny_ring_probe(self, req):
+        if isinstance(req, service.ProbeAddressesRequest) and req.addresses:
+            return service.OkResponse([])
+        return real_handle(self, req)
+
+    monkeypatch.setattr(service.TaskService, "_handle", deny_ring_probe)
+    key = run_util.make_secret_key()
+    with pytest.raises(RuntimeError, match="no routable address"):
+        discovery.discover(["localhost", "localhost"], key,
+                           is_local=lambda h: True, timeout=30.0)
+
+
+def test_tpurun_forced_nic_discovery(monkeypatch):
+    """End-to-end: 2-process localhost launch with discovery forced on
+    feeds the proven driver address into the rendezvous env."""
+    monkeypatch.setenv("HOROVOD_NIC_DISCOVERY", "1")
+    assert _run_mp_worker(
+        monkeypatch, "collectives", ["--no-jax-distributed"]) == 0
+
+
 def test_tpurun_end_to_end_collective(monkeypatch):
     """tpurun-launched workers form a world and allreduce through the
     socket controller — the full launcher→init→collective path the

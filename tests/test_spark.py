@@ -206,3 +206,85 @@ class TestSparkRetrySafety:
             assert env0["HOROVOD_GLOO_RENDEZVOUS_ADDR"] == "10.0.0.5"
         finally:
             driver.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Real pyspark local-mode integration (reference: test/test_spark.py:51-103
+# runs horovod.spark.run on a local-mode SparkContext). Skipped LOUDLY when
+# pyspark is not installed — install pyspark to activate.
+# ---------------------------------------------------------------------------
+
+try:
+    import pyspark as _pyspark  # noqa: F401
+    _HAVE_PYSPARK = True
+except ImportError:
+    _HAVE_PYSPARK = False
+
+pyspark_required = pytest.mark.skipif(
+    not _HAVE_PYSPARK,
+    reason="SKIPPING real-pyspark integration: pyspark not installed "
+           "(pip install pyspark to run horovod_tpu.spark.run end-to-end)")
+
+
+def _spark_train_fn():
+    """Runs inside each Spark python worker: init, one collective, report."""
+    import os as _os
+
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as _np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = hvd.synchronize(hvd.allreduce_async(
+        _np.full((2,), float(hvd.rank() + 1), _np.float32),
+        name="spark/x", average=False))
+    rank, size = hvd.rank(), hvd.size()
+    hvd.shutdown()
+    return rank, size, float(out[0])
+
+
+def _spark_failing_fn():
+    import os as _os
+
+    import horovod_tpu as hvd
+
+    if int(_os.environ["HOROVOD_RANK"]) == 1:
+        raise ValueError("injected task failure")
+    hvd.init()
+    hvd.shutdown()
+    return "ok"
+
+
+@pyspark_required
+class TestRealPyspark:
+    @pytest.fixture()
+    def spark(self):
+        from pyspark.sql import SparkSession
+
+        os.environ["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        session = (SparkSession.builder.master("local[2]")
+                   .appName("horovod_tpu-test")
+                   .config("spark.ui.enabled", "false")
+                   .getOrCreate())
+        yield session
+        session.stop()
+
+    def test_run_happy_path(self, spark):
+        results = run(_spark_train_fn, num_proc=2, start_timeout=120)
+        assert [r[:2] for r in results] == [(0, 2), (1, 2)]
+        # sum over ranks of (rank + 1) = 3, bit-exact on both ranks
+        assert [r[2] for r in results] == [3.0, 3.0]
+
+    def test_run_task_failure_raises(self, spark):
+        with pytest.raises(RuntimeError, match="injected task failure"):
+            run(_spark_failing_fn, num_proc=2, start_timeout=120)
+
+    def test_run_timeout_when_undersubscribed(self, spark):
+        # local[2] can only run 2 concurrent tasks; 4 ranks never fully
+        # register and the start timeout names the capacity problem
+        # (reference: test_spark.py timeout path)
+        with pytest.raises(TimeoutError, match="task slots|register"):
+            run(_spark_train_fn, num_proc=4, start_timeout=10)

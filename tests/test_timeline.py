@@ -85,3 +85,78 @@ def test_native_writer_stress_many_events(tmp_path):
                   if e.get("name") == "dropped_events")
     starts = sum(1 for e in events if e.get("ph") == "B")
     assert starts + dropped >= 5000
+
+
+def test_timeline_epoch_clock_domain(tmp_path):
+    """Events are stamped in epoch microseconds so traces from different
+    ranks/producers interleave truthfully when merged."""
+    import time
+
+    path = str(tmp_path / "trace.json")
+    before_us = time.time_ns() / 1e3
+    tl = Timeline(path)
+    tl.start("t", "ALLREDUCE")
+    tl.end("t")
+    tl.close()
+    after_us = time.time_ns() / 1e3
+    events = [e for e in json.load(open(path)) if e.get("ph") == "B"]
+    assert events and before_us <= events[0]["ts"] <= after_us
+
+
+def test_merge_traces(tmp_path):
+    """tpurun --merge-trace: per-rank timelines + a gzipped device-style
+    trace become one Chrome trace with disjoint pid ranges and preserved
+    epoch timestamps (reference: one host+device trace, timeline.cc)."""
+    import gzip
+
+    from horovod_tpu.timeline import merge_traces
+
+    r0, r1 = str(tmp_path / "r0.json"), str(tmp_path / "r1.json")
+    for path, tensor in [(r0, "grad/a"), (r1, "grad/b")]:
+        tl = Timeline(path)
+        tl.negotiate_start(tensor, "ALLREDUCE")
+        tl.negotiate_end(tensor)
+        tl.close()
+    # a device-side trace in the object format, gzipped (what TensorBoard's
+    # profile export produces)
+    dev = str(tmp_path / "device.json.gz")
+    with gzip.open(dev, "wt") as f:
+        json.dump({"traceEvents": [
+            {"ph": "X", "pid": 7, "tid": 0, "ts": 1.0, "dur": 5.0,
+             "name": "fusion.1"}]}, f)
+
+    out = str(tmp_path / "merged.json")
+    n = merge_traces(out, [r0, r1, dev])
+    merged = json.load(open(out))["traceEvents"]
+    assert len(merged) == n
+    names = {e.get("name") for e in merged}
+    assert "NEGOTIATE_ALLREDUCE" in names and "fusion.1" in names
+    # each input's pids got a source-file label and a private pid range
+    label_events = [e for e in merged
+                    if e.get("ph") == "M" and e.get("name") == "process_labels"]
+    assert {e["args"]["labels"] for e in label_events} == {
+        "[r0.json]", "[r1.json]", "[device.json.gz]"}
+    by_name = {e.get("name"): e for e in merged}
+    # the device event's label sits on the device event's OWN pid
+    dev_pid = by_name["fusion.1"]["pid"]
+    assert any(e["pid"] == dev_pid and e["args"]["labels"] ==
+               "[device.json.gz]" for e in label_events)
+    assert by_name["NEGOTIATE_ALLREDUCE"]["pid"] != dev_pid
+
+
+def test_merge_traces_align_and_truncated(tmp_path):
+    """--merge-trace-align rebases each input to a common origin; a
+    truncated array from a crashed writer still loads."""
+    from horovod_tpu.timeline import merge_traces
+
+    a = str(tmp_path / "a.json")
+    with open(a, "w") as f:  # truncated: no closing bracket
+        f.write('[\n{"ph": "B", "pid": 1, "ts": 1000.0, "name": "x"},\n')
+    b = str(tmp_path / "b.json")
+    json.dump([{"ph": "B", "pid": 1, "ts": 5555.0, "name": "y"}],
+              open(b, "w"))
+    out = str(tmp_path / "m.json")
+    merge_traces(out, [a, b], align=True)
+    merged = json.load(open(out))["traceEvents"]
+    by_name = {e.get("name"): e for e in merged if e.get("ph") == "B"}
+    assert by_name["x"]["ts"] == 0.0 and by_name["y"]["ts"] == 0.0
