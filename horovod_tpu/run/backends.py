@@ -1,0 +1,120 @@
+"""Pluggable launch backends for tpurun.
+
+The reference launcher selects its fan-out mechanism at runtime — mpirun
+when MPI is built, the gloo/ssh path otherwise (reference:
+horovod/run/run.py:715-732 `_run`, gloo_run.py vs mpi_run.py). The
+mpirun path itself is dead on a TPU stack, but the SEAM matters: this
+module is that seam, and provides the TPU-idiomatic second backend — GCE
+TPU-VM fan-out via ``gcloud compute tpus tpu-vm ssh --worker=N``, the
+way multi-host TPU pods are actually driven.
+
+A backend turns (slot, command, worker_env) into the shell command the
+launcher executes on the driver host; `launch_job` runs whatever comes
+back through the same supervision machinery (output prefixes, teardown
+on failure) regardless of backend.
+
+Selection: ``tpurun --launch-backend {ssh,gcloud-tpu-vm}`` or
+``HOROVOD_LAUNCH_BACKEND``; default ssh (local exec for local hosts).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+from typing import Dict, Optional
+
+from horovod_tpu.run.hosts import SlotInfo
+
+# env prefixes exported across the remote boundary (ssh/gcloud do not
+# forward the environment)
+_EXPORT_PREFIXES = ("HOROVOD_", "JAX_", "XLA_", "PATH", "PYTHONPATH",
+                    "LD_LIBRARY_PATH", "TPU_")
+
+
+def _export_prefix(env: Dict[str, str]) -> str:
+    return " ".join(
+        f"export {k}={shlex.quote(v)};" for k, v in sorted(env.items())
+        if k.startswith(_EXPORT_PREFIXES))
+
+
+class LaunchBackend:
+    """One method: the shell command the driver runs for a slot (the
+    launcher always passes the worker env to the spawned process too, so
+    a backend that runs the command locally may return it unwrapped)."""
+
+    name = "abstract"
+
+    def command_for_slot(self, slot: SlotInfo, command: str,
+                         env: Dict[str, str]) -> str:
+        raise NotImplementedError
+
+
+class SSHBackend(LaunchBackend):
+    """Default: exec locally for local hosts, ssh otherwise (reference:
+    gloo_run.py:211-301 launch loop)."""
+
+    name = "ssh"
+
+    def __init__(self, ssh_port: Optional[int] = None):
+        self.ssh_port = ssh_port
+
+    def command_for_slot(self, slot: SlotInfo, command: str,
+                         env: Dict[str, str]) -> str:
+        from horovod_tpu.run.launcher import is_local_host
+
+        if is_local_host(slot.hostname):
+            return command
+        port_arg = f"-p {self.ssh_port} " if self.ssh_port else ""
+        remote = (f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1; "
+                  f"{_export_prefix(env)} {command}")
+        return (f"ssh -o PasswordAuthentication=no "
+                f"-o StrictHostKeyChecking=no "
+                f"{port_arg}{slot.hostname} {shlex.quote(remote)}")
+
+
+class GCloudTPUVMBackend(LaunchBackend):
+    """GCE TPU-VM fan-out: every host entry names a TPU VM, and the slot's
+    local rank selects the pod worker — `gcloud compute tpus tpu-vm ssh
+    <tpu> --worker=<local_rank> --command=...`. The TPU-idiomatic
+    equivalent of the reference's second (mpirun) launch path."""
+
+    name = "gcloud-tpu-vm"
+
+    def __init__(self, zone: Optional[str] = None,
+                 project: Optional[str] = None):
+        self.zone = zone
+        self.project = project
+
+    def command_for_slot(self, slot: SlotInfo, command: str,
+                         env: Dict[str, str]) -> str:
+        remote = (f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1; "
+                  f"{_export_prefix(env)} {command}")
+        zone = f" --zone={shlex.quote(self.zone)}" if self.zone else ""
+        project = (f" --project={shlex.quote(self.project)}"
+                   if self.project else "")
+        return (f"gcloud compute tpus tpu-vm ssh "
+                f"{shlex.quote(slot.hostname)}"
+                f" --worker={slot.local_rank}{zone}{project}"
+                f" --command={shlex.quote(remote)}")
+
+
+_BACKENDS = {
+    SSHBackend.name: SSHBackend,
+    GCloudTPUVMBackend.name: GCloudTPUVMBackend,
+}
+
+
+def make_backend(name: Optional[str] = None,
+                 ssh_port: Optional[int] = None,
+                 gcloud_zone: Optional[str] = None,
+                 gcloud_project: Optional[str] = None) -> LaunchBackend:
+    """Resolve the backend like the reference resolves gloo vs mpirun
+    (run/run.py:715-732): explicit flag first, then env, default ssh."""
+    name = name or os.environ.get("HOROVOD_LAUNCH_BACKEND", "") or "ssh"
+    if name not in _BACKENDS:
+        raise ValueError(
+            f"unknown launch backend {name!r} (choices: "
+            f"{sorted(_BACKENDS)})")
+    if name == GCloudTPUVMBackend.name:
+        return GCloudTPUVMBackend(zone=gcloud_zone, project=gcloud_project)
+    return SSHBackend(ssh_port=ssh_port)

@@ -88,30 +88,23 @@ def build_worker_env(slot: SlotInfo, base_env: Dict[str, str],
     return env
 
 
-def _ssh_command(slot: SlotInfo, command: str, env: Dict[str, str],
-                 ssh_port: Optional[int]) -> str:
-    """Wrap the command for ssh execution, exporting the worker env
-    explicitly (ssh does not forward the environment)."""
-    exports = " ".join(
-        f"export {k}={shlex.quote(v)};" for k, v in sorted(env.items())
-        if k.startswith(("HOROVOD_", "JAX_", "XLA_", "PATH", "PYTHONPATH",
-                         "LD_LIBRARY_PATH", "TPU_")))
-    port_arg = f"-p {ssh_port} " if ssh_port else ""
-    remote = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1; {exports} {command}"
-    return (f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no "
-            f"{port_arg}{slot.hostname} {shlex.quote(remote)}")
-
-
 def launch_job(command: str, slots: List[SlotInfo],
                env: Optional[Dict[str, str]] = None,
                ssh_port: Optional[int] = None,
                output_dir: Optional[str] = None,
                use_jax_distributed: bool = True,
                prefix_output: bool = True,
-               start_timeout: float = 300.0) -> int:
+               start_timeout: float = 300.0,
+               backend=None) -> int:
     """Run ``command`` on every slot; returns the job exit code (first
     non-zero worker code, else 0). Starts the rendezvous KV server for the
-    job's lifetime."""
+    job's lifetime. ``backend`` is a :class:`run.backends.LaunchBackend`
+    (default: ssh/local — the seam the reference's gloo-vs-mpirun choice
+    occupies, run/run.py:715-732)."""
+    from horovod_tpu.run.backends import make_backend
+
+    if backend is None:
+        backend = make_backend(ssh_port=ssh_port)
     base_env = dict(os.environ if env is None else env)
     driver_ip = get_driver_ip(slots)
 
@@ -119,11 +112,18 @@ def launch_job(command: str, slots: List[SlotInfo],
     # the heuristic driver_ip may not be the address workers can route
     # to — run the ring probe and use the proven address. Default: on
     # whenever a remote host is involved; HOROVOD_NIC_DISCOVERY=1 forces
-    # it for all-local runs (tests), =0 disables.
+    # it for all-local runs (tests), =0 disables. ssh backend only (the
+    # agents are ssh-spawned); a non-ssh backend announces the skip so a
+    # forced =1 never disappears silently.
     knob = base_env.get("HOROVOD_NIC_DISCOVERY", "").lower()
     any_remote = not all(is_local_host(s.hostname) for s in slots)
-    if knob not in ("0", "false", "off") and (
-            any_remote or knob in ("1", "true", "on")):
+    discovery_wanted = knob not in ("0", "false", "off") and (
+        any_remote or knob in ("1", "true", "on"))
+    if discovery_wanted and getattr(backend, "name", "ssh") != "ssh":
+        print(f"tpurun: NIC discovery skipped for launch backend "
+              f"{backend.name!r} (agents are ssh-spawned); using "
+              f"{driver_ip}", file=sys.stderr)
+    elif discovery_wanted:
         from horovod_tpu.run import discovery as discovery_mod
 
         hostnames = list(dict.fromkeys(s.hostname for s in slots))
@@ -151,10 +151,7 @@ def launch_job(command: str, slots: List[SlotInfo],
             coordinator_port,
             num_processes=len(slots),
             use_jax_distributed=use_jax_distributed)
-        if is_local_host(slot.hostname):
-            cmd = command
-        else:
-            cmd = _ssh_command(slot, command, worker_env, ssh_port)
+        cmd = backend.command_for_slot(slot, command, worker_env)
 
         stdout = stderr = None
         files = []

@@ -95,6 +95,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     _add(parser, "--no-jax-distributed", dest="no_jax_distributed",
          action="store_true",
          help="Do not bootstrap jax.distributed (host data plane only).")
+    _add(parser, "--launch-backend", dest="launch_backend",
+         choices=["ssh", "gcloud-tpu-vm"],
+         help="Fan-out mechanism: ssh (default; local exec for local "
+              "hosts) or gcloud-tpu-vm (GCE `gcloud compute tpus tpu-vm "
+              "ssh --worker=N`; hosts name TPU VMs). Also "
+              "HOROVOD_LAUNCH_BACKEND. The seam the reference's "
+              "gloo-vs-mpirun choice occupies (run/run.py:715-732).")
+    _add(parser, "--gcloud-zone", dest="gcloud_zone",
+         help="GCE zone for --launch-backend gcloud-tpu-vm.")
+    _add(parser, "--gcloud-project", dest="gcloud_project",
+         help="GCP project for --launch-backend gcloud-tpu-vm.")
     _add(parser, "--mesh-shape", dest="mesh_shape",
          help="Global mesh as 'cross,local' (default: hosts x slots).")
 
@@ -303,9 +314,21 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         host_infos = [hosts_mod.HostInfo("localhost", nproc)]
     np = args.np or sum(h.slots for h in host_infos)
 
-    check_all_hosts_ssh_successful(
-        [h.hostname for h in host_infos], args.ssh_port,
-        use_cache=not args.disable_cache)
+    from horovod_tpu.run.backends import make_backend
+
+    try:
+        backend = make_backend(args.launch_backend, ssh_port=args.ssh_port,
+                               gcloud_zone=args.gcloud_zone,
+                               gcloud_project=args.gcloud_project)
+    except ValueError as exc:  # bad HOROVOD_LAUNCH_BACKEND env value
+        sys.stderr.write(f"tpurun: {exc}\n")
+        return 2
+    if backend.name == "ssh":
+        # plain-ssh reachability only makes sense for the ssh backend —
+        # gcloud-tpu-vm hosts are TPU VM names reached through gcloud
+        check_all_hosts_ssh_successful(
+            [h.hostname for h in host_infos], args.ssh_port,
+            use_cache=not args.disable_cache)
 
     slots = hosts_mod.allocate(host_infos, np)
     if args.verbose:
@@ -319,12 +342,13 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     env["HOROVOD_NP"] = str(np)
 
     import shlex as _shlex
+
     command_str = " ".join(_shlex.quote(c) for c in command)
     return launcher.launch_job(
         command_str, slots, env=env, ssh_port=args.ssh_port,
         output_dir=args.output_dir,
         use_jax_distributed=not args.no_jax_distributed,
-        start_timeout=args.start_timeout)
+        start_timeout=args.start_timeout, backend=backend)
 
 
 def main() -> None:

@@ -476,3 +476,77 @@ def test_safe_exec_kills_process_tree():
     t.join(timeout=20)
     assert not t.is_alive()
     assert results["code"] != 0
+
+
+# ---------------------------------------------------------------------------
+# launch backends (reference: the gloo-vs-mpirun selection seam,
+# run/run.py:715-732 — here ssh vs gcloud TPU-VM)
+# ---------------------------------------------------------------------------
+
+def test_backend_selection(monkeypatch):
+    from horovod_tpu.run import backends
+
+    assert backends.make_backend(None).name == "ssh"
+    assert backends.make_backend("gcloud-tpu-vm").name == "gcloud-tpu-vm"
+    monkeypatch.setenv("HOROVOD_LAUNCH_BACKEND", "gcloud-tpu-vm")
+    assert backends.make_backend(None).name == "gcloud-tpu-vm"
+    assert backends.make_backend("ssh").name == "ssh"  # flag beats env
+    with pytest.raises(ValueError, match="unknown launch backend"):
+        backends.make_backend("mpirun")
+
+
+def test_ssh_backend_commands():
+    from horovod_tpu.run import backends
+
+    b = backends.SSHBackend(ssh_port=2222)
+    local = hosts.SlotInfo("localhost", rank=0, local_rank=0, local_size=2,
+                           cross_rank=0, cross_size=1, size=2)
+    remote = hosts.SlotInfo("worker-7", rank=1, local_rank=1, local_size=2,
+                            cross_rank=0, cross_size=1, size=2)
+    assert b.command_for_slot(local, "python train.py", {}) == \
+        "python train.py"
+    cmd = b.command_for_slot(
+        remote, "python train.py",
+        {"HOROVOD_RANK": "1", "SECRET_TOKEN": "x"})
+    assert cmd.startswith("ssh ") and "-p 2222" in cmd and "worker-7" in cmd
+    assert "HOROVOD_RANK=1" in cmd
+    assert "SECRET_TOKEN" not in cmd  # only whitelisted prefixes exported
+
+
+def test_gcloud_tpu_vm_backend_commands():
+    from horovod_tpu.run import backends
+
+    b = backends.GCloudTPUVMBackend(zone="us-central2-b", project="proj-1")
+    slot = hosts.SlotInfo("my-pod", rank=5, local_rank=3, local_size=4,
+                          cross_rank=1, cross_size=2, size=8)
+    cmd = b.command_for_slot(slot, "python train.py",
+                             {"HOROVOD_RANK": "5", "JAX_PLATFORMS": "tpu"})
+    assert cmd.startswith("gcloud compute tpus tpu-vm ssh my-pod")
+    assert "--worker=3" in cmd
+    assert "--zone=us-central2-b" in cmd and "--project=proj-1" in cmd
+    assert "HOROVOD_RANK=5" in cmd and "JAX_PLATFORMS=tpu" in cmd
+
+
+def test_tpurun_gcloud_backend_skips_ssh_check(monkeypatch):
+    """--launch-backend gcloud-tpu-vm must not plain-ssh TPU VM names; the
+    constructed fan-out commands go through gcloud."""
+    import horovod_tpu.run.run as run_mod
+    from horovod_tpu.run import launcher as launcher_mod
+
+    captured = {}
+
+    def fake_launch_job(command, slots, **kw):
+        captured["backend"] = kw.get("backend")
+        captured["slots"] = slots
+        return 0
+
+    def boom(*a, **kw):
+        raise AssertionError("ssh check must be skipped for gcloud backend")
+
+    monkeypatch.setattr(run_mod.launcher, "launch_job", fake_launch_job)
+    monkeypatch.setattr(run_mod, "check_all_hosts_ssh_successful", boom)
+    rc = run_commandline(
+        ["-np", "2", "-H", "pod-a:2", "--launch-backend", "gcloud-tpu-vm",
+         "--gcloud-zone", "z", "python", "x.py"])
+    assert rc == 0
+    assert captured["backend"].name == "gcloud-tpu-vm"
