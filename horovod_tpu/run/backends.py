@@ -37,6 +37,13 @@ def _export_prefix(env: Dict[str, str]) -> str:
         if k.startswith(_EXPORT_PREFIXES))
 
 
+def _remote_command(command: str, env: Dict[str, str]) -> str:
+    """The shell line run on the far side of any remote transport:
+    enter the driver's cwd, export the whitelisted env, run."""
+    return (f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1; "
+            f"{_export_prefix(env)} {command}")
+
+
 class LaunchBackend:
     """One method: the shell command the driver runs for a slot (the
     launcher always passes the worker env to the spawned process too, so
@@ -65,11 +72,10 @@ class SSHBackend(LaunchBackend):
         if is_local_host(slot.hostname):
             return command
         port_arg = f"-p {self.ssh_port} " if self.ssh_port else ""
-        remote = (f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1; "
-                  f"{_export_prefix(env)} {command}")
         return (f"ssh -o PasswordAuthentication=no "
                 f"-o StrictHostKeyChecking=no "
-                f"{port_arg}{slot.hostname} {shlex.quote(remote)}")
+                f"{port_arg}{slot.hostname} "
+                f"{shlex.quote(_remote_command(command, env))}")
 
 
 class GCloudTPUVMBackend(LaunchBackend):
@@ -87,15 +93,13 @@ class GCloudTPUVMBackend(LaunchBackend):
 
     def command_for_slot(self, slot: SlotInfo, command: str,
                          env: Dict[str, str]) -> str:
-        remote = (f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1; "
-                  f"{_export_prefix(env)} {command}")
         zone = f" --zone={shlex.quote(self.zone)}" if self.zone else ""
         project = (f" --project={shlex.quote(self.project)}"
                    if self.project else "")
         return (f"gcloud compute tpus tpu-vm ssh "
                 f"{shlex.quote(slot.hostname)}"
                 f" --worker={slot.local_rank}{zone}{project}"
-                f" --command={shlex.quote(remote)}")
+                f" --command={shlex.quote(_remote_command(command, env))}")
 
 
 _BACKENDS = {
@@ -107,14 +111,20 @@ _BACKENDS = {
 def make_backend(name: Optional[str] = None,
                  ssh_port: Optional[int] = None,
                  gcloud_zone: Optional[str] = None,
-                 gcloud_project: Optional[str] = None) -> LaunchBackend:
+                 gcloud_project: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None) -> LaunchBackend:
     """Resolve the backend like the reference resolves gloo vs mpirun
-    (run/run.py:715-732): explicit flag first, then env, default ssh."""
-    name = name or os.environ.get("HOROVOD_LAUNCH_BACKEND", "") or "ssh"
+    (run/run.py:715-732): explicit flag first, then env (``env`` mapping
+    if given, else the process environment — HOROVOD_LAUNCH_BACKEND,
+    HOROVOD_GCLOUD_ZONE, HOROVOD_GCLOUD_PROJECT), default ssh."""
+    lookup = os.environ if env is None else env
+    name = name or lookup.get("HOROVOD_LAUNCH_BACKEND", "") or "ssh"
     if name not in _BACKENDS:
         raise ValueError(
             f"unknown launch backend {name!r} (choices: "
             f"{sorted(_BACKENDS)})")
     if name == GCloudTPUVMBackend.name:
-        return GCloudTPUVMBackend(zone=gcloud_zone, project=gcloud_project)
+        return GCloudTPUVMBackend(
+            zone=gcloud_zone or lookup.get("HOROVOD_GCLOUD_ZONE"),
+            project=gcloud_project or lookup.get("HOROVOD_GCLOUD_PROJECT"))
     return SSHBackend(ssh_port=ssh_port)

@@ -17,7 +17,6 @@ equivalent of NCCL communicator bootstrap.
 from __future__ import annotations
 
 import os
-import shlex
 import signal
 import socket
 import sys
@@ -103,9 +102,12 @@ def launch_job(command: str, slots: List[SlotInfo],
     occupies, run/run.py:715-732)."""
     from horovod_tpu.run.backends import make_backend
 
-    if backend is None:
-        backend = make_backend(ssh_port=ssh_port)
     base_env = dict(os.environ if env is None else env)
+    if backend is None:
+        # resolve from the CALLER's env mapping (like the NIC-discovery
+        # knob below), so programmatic callers control the backend the
+        # same way tpurun's CLI does
+        backend = make_backend(ssh_port=ssh_port, env=base_env)
     driver_ip = get_driver_ip(slots)
 
     # NIC discovery (reference: run/run.py:195-265): on multi-NIC hosts
