@@ -734,14 +734,27 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[int] = No
     red_op = _resolve_op(average, op)
     if _is_tracer(tensor):
         axes = _global_axes(axis_name)
-        out = lax.psum_scatter(tensor, axes, scatter_dimension=0, tiled=True)
-        if red_op == Average:
-            # divide by the size of the axes actually reduced, not the
-            # global world size (they differ for e.g. axis_name='local')
-            out = out / lax.axis_size(axes)
-        elif red_op != Sum:
-            raise ValueError("in-jit reducescatter supports sum/average only")
-        return out
+        if red_op in (Average, Sum):
+            out = lax.psum_scatter(tensor, axes, scatter_dimension=0,
+                                   tiled=True)
+            if red_op == Average:
+                # divide by the size of the axes actually reduced, not
+                # the global world size (they differ for axis_name='local')
+                out = out / lax.axis_size(axes)
+            return out
+        # XLA's reduce-scatter primitive is sum-only; min/max/product
+        # decompose into all_to_all + local reduce — same bytes on the
+        # wire as a reduce-scatter (each device sends shard j to owner j)
+        world = lax.axis_size(axes)
+        if tensor.shape[0] % world != 0:
+            raise ValueError(
+                f"reducescatter dim 0 ({tensor.shape[0]}) must divide "
+                f"evenly by the axis size ({world})")
+        xr = tensor.reshape((world, tensor.shape[0] // world)
+                            + tensor.shape[1:])
+        got = lax.all_to_all(xr, axes, split_axis=0, concat_axis=0)
+        reducer = {Min: jnp.min, Max: jnp.max, Product: jnp.prod}[red_op]
+        return reducer(got, axis=0)
 
     st = basics._ensure_init()
     x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)

@@ -224,6 +224,50 @@ class TestInJitEdgeCases:
         np.testing.assert_allclose(np.asarray(run(jnp.asarray(vals_zero))),
                                    [0.0])
 
+    def test_reducescatter_min_max_product_in_jit(self, hvd):
+        """psum_scatter is sum-only; min/max/product decompose into
+        all_to_all + local reduce. Each device contributes (8,) = 8
+        devices x shard 1; device d's output is op over all devices'
+        element d."""
+        mesh = hvd.mesh()
+        rng = np.random.RandomState(7)
+        per_dev = rng.randint(1, 5, size=(8, 8)).astype(np.float32)
+
+        for op, npop in [(hvd.Min, np.min), (hvd.Max, np.max),
+                         (hvd.Product, np.prod)]:
+            def f(x, _op=op):
+                return hvd.reducescatter(x, op=_op)
+
+            x = jnp.asarray(per_dev.reshape(-1))  # (64,) -> (8,)/device
+            out = jax.jit(jax.shard_map(
+                f, mesh=mesh,
+                in_specs=P(hvd.GLOBAL_AXES),
+                out_specs=P(hvd.GLOBAL_AXES)))(x)
+            np.testing.assert_allclose(
+                np.asarray(out), npop(per_dev, axis=0), rtol=1e-6)
+
+    def test_reducescatter_min_subaxis(self, hvd):
+        """Pin the all_to_all shard placement on a PARTIAL axis: min over
+        the 'local' axis (size 4) of the 2x4 mesh, with a trailing dim.
+        data[g, j, d, :] = local device (g, j)'s row d; device (g, d)
+        must end up with min over j of data[g, j, d, :]."""
+        mesh = hvd.mesh()
+        rng = np.random.RandomState(11)
+        data = rng.randint(0, 9, size=(2, 4, 4, 3)).astype(np.float32)
+
+        def f(x):  # per-device (4, 3): rows scatter over the local axis
+            return hvd.reducescatter(x, op=hvd.Min,
+                                     axis_name=hvd.LOCAL_AXIS)
+
+        x = jnp.asarray(data.reshape(32, 3))
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh,
+            in_specs=P(hvd.GLOBAL_AXES),
+            out_specs=P(hvd.GLOBAL_AXES)))(x)
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(2, 4, 3), np.min(data, axis=1),
+            rtol=1e-6)
+
     def test_reducescatter_average_subaxis(self, hvd):
         # average over the 'local' axis only must divide by local_size (4),
         # not the global size (8).
