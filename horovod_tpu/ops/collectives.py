@@ -52,6 +52,7 @@ Max = 3
 Product = 4
 
 _OP_NAMES = {Average: "average", Sum: "sum", Min: "min", Max: "max", Product: "product"}
+OPS_BY_NAME = {v: k for k, v in _OP_NAMES.items()}
 
 
 def _is_tracer(x) -> bool:
@@ -759,6 +760,15 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[int] = No
     st = basics._ensure_init()
     x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
     if not _is_worker_stacked(x):
+        if _multiprocess_world(st) and _runtime_capable(st):
+            # per-process data: route through the runtime lane like
+            # allreduce (each rank contributes its local tensor, receives
+            # its shard of the reduction)
+            from horovod_tpu.runtime.runtime import get_runtime
+
+            return synchronize(get_runtime().enqueue_reducescatter(
+                _auto_name("reducescatter"), x,
+                reduce_op=_OP_NAMES[red_op]))
         raise ValueError("eager reducescatter requires stacked per-worker input")
     if x.ndim < 2:
         raise ValueError(
@@ -786,6 +796,11 @@ def alltoall(tensor, name: Optional[str] = None, axis_name=None):
     st = basics._ensure_init()
     x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
     if not _is_worker_stacked(x):
+        if _multiprocess_world(st) and _runtime_capable(st):
+            from horovod_tpu.runtime.runtime import get_runtime
+
+            return synchronize(get_runtime().enqueue_alltoall(
+                name or _auto_name("alltoall"), x))
         raise ValueError("eager alltoall requires stacked per-worker input")
     if x.ndim < 2:
         raise ValueError(

@@ -118,6 +118,41 @@ def construct_response(requests: List[msg.Request]) -> msg.Response:
                     f"{r.shape}.")
         return msg.Response(types.BROADCAST, [name])
 
+    if first.request_type == types.REDUCESCATTER:
+        world = len(requests)
+        for r in requests[1:]:
+            if r.shape != first.shape:
+                return msg.Response(
+                    types.ERROR, [name],
+                    f"Mismatched reducescatter tensor shapes: "
+                    f"{first.shape} vs {r.shape}.")
+            if r.reduce_op != first.reduce_op:
+                return msg.Response(
+                    types.ERROR, [name],
+                    "Mismatched reducescatter reduction ops across "
+                    "workers.")
+        if not first.shape or first.shape[0] % world != 0:
+            return msg.Response(
+                types.ERROR, [name],
+                f"reducescatter dim 0 ({first.shape[0] if first.shape else 0}) "
+                f"must divide evenly by the world size ({world}).")
+        return msg.Response(types.REDUCESCATTER, [name])
+
+    if first.request_type == types.ALLTOALL:
+        world = len(requests)
+        for r in requests[1:]:
+            if r.shape != first.shape:
+                return msg.Response(
+                    types.ERROR, [name],
+                    f"Mismatched alltoall tensor shapes: {first.shape} vs "
+                    f"{r.shape} (equal splits require identical shapes).")
+        if not first.shape or first.shape[0] % world != 0:
+            return msg.Response(
+                types.ERROR, [name],
+                f"alltoall dim 0 ({first.shape[0] if first.shape else 0}) "
+                f"must divide evenly by the world size ({world}).")
+        return msg.Response(types.ALLTOALL, [name])
+
     return msg.Response(types.ERROR, [name],
                         f"Unknown request type {first.request_type}.")
 

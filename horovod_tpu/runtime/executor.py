@@ -50,6 +50,29 @@ _RING_OP = {
 }
 
 
+def _widen_for_ring(a, copy: bool = False):
+    """Map narrow dtypes onto the native ring kernels' four types
+    (fp32 accumulation for 16-bit floats matches the reference's fp16
+    MPI op behavior, half.cc:43-75). Results are always C-contiguous —
+    the ring reduces through ``ravel()``, which must be a view, not a
+    stray copy. ``copy=True`` guarantees a NEW array safe to reduce in
+    place (callers that reduce the widened buffer itself)."""
+    import numpy as np
+
+    if a.dtype in (np.float32, np.float64, np.int32, np.int64):
+        if copy:
+            return np.array(a, order="C", copy=True)
+        return np.ascontiguousarray(a)
+    if a.dtype.kind in ("f", "V"):  # f16 / bfloat16(ml_dtypes)
+        return a.astype(np.float32, order="C")
+    if a.dtype == np.uint32:
+        return a.astype(np.int64, order="C")  # exact, no wrap
+    if a.dtype.kind in ("i", "b") or a.dtype in (np.uint8, np.uint16):
+        return a.astype(np.int32, order="C")
+    raise TypeError(f"unsupported host allreduce dtype {a.dtype} "
+                    "(uint64 cannot be widened losslessly)")
+
+
 class Executor:
     """First-match dispatch per response type (reference:
     operation_manager.cc:32-80). Two data planes:
@@ -195,6 +218,19 @@ class Executor:
                 else:
                     for e in entries:
                         e.output = collectives.broadcast(e.tensor, e.root_rank)
+            elif response.response_type == types.REDUCESCATTER:
+                if self.net is not None:
+                    self._execute_reducescatter_host(entries)
+                else:
+                    for e in entries:
+                        e.output = collectives.reducescatter(
+                            e.tensor, op=collectives.OPS_BY_NAME[e.reduce_op])
+            elif response.response_type == types.ALLTOALL:
+                if self.net is not None:
+                    self._execute_alltoall_host(entries)
+                else:
+                    for e in entries:
+                        e.output = collectives.alltoall(e.tensor)
             else:
                 raise ValueError(
                     f"unknown response type {response.response_type}")
@@ -220,21 +256,7 @@ class Executor:
         world = self.net.world
         arrays = [np.asarray(e.tensor) for e in entries]
         # narrow types have no native host-ring kernels; widen for the wire
-        # (fp32 accumulation for 16-bit floats matches the reference's fp16
-        # MPI op behavior, half.cc:43-75)
-        def widen(a):
-            if a.dtype in (np.float32, np.float64, np.int32, np.int64):
-                return a
-            if a.dtype.kind in ("f", "V"):  # f16 / bfloat16(ml_dtypes)
-                return a.astype(np.float32)
-            if a.dtype == np.uint32:
-                return a.astype(np.int64)  # exact, no wrap
-            if a.dtype.kind in ("i", "b") or a.dtype in (np.uint8, np.uint16):
-                return a.astype(np.int32)
-            raise TypeError(f"unsupported host allreduce dtype {a.dtype} "
-                            "(uint64 cannot be widened losslessly)")
-
-        wire = [widen(a) for a in arrays]
+        wire = [_widen_for_ring(a) for a in arrays]
         if timeline is not None:
             timeline.activity_start(entries[0].name,
                                     timeline_mod.MEMCPY_IN_FUSION_BUFFER)
@@ -328,6 +350,46 @@ class Executor:
                 first = (response.tensor_sizes[r] if response.tensor_sizes
                          else a.size // max(int(np.prod(trailing)) or 1, 1))
                 parts.append(a.reshape((first,) + trailing))
+            e.output = np.concatenate(parts, axis=0)
+
+    def _execute_reducescatter_host(self, entries) -> None:
+        """Host reduce-scatter: ring allreduce then slice the own shard.
+        Half the ring's traffic is the reduce-scatter phase, so this costs
+        2x the optimal bytes — acceptable for the host control/data plane
+        (the hot path is the XLA psum_scatter; reference's CPU ops take
+        similar shortcuts, gloo_operations.cc)."""
+        import numpy as np
+
+        world, rank = self.net.world, self.net.rank
+        for e in entries:
+            a = np.asarray(e.tensor)
+            wire = _widen_for_ring(a, copy=True)  # reduced in place
+            self.net.allreduce(wire.ravel(), _RING_OP[e.reduce_op])
+            red = wire.reshape(a.shape)
+            if e.reduce_op == types.REDUCE_AVERAGE:
+                red = red / world
+            shard = a.shape[0] // world
+            # copy the shard: a view would pin the full world-sized
+            # reduced buffer for the output's lifetime
+            e.output = red[rank * shard:(rank + 1) * shard].astype(
+                a.dtype, copy=True)
+
+    def _execute_alltoall_host(self, entries) -> None:
+        """Host all-to-all over the star allgatherv: every rank receives
+        every chunk and keeps its own column — W× the optimal bytes, the
+        same simplicity-over-bandwidth tradeoff as the broadcast relay
+        (the hot path is XLA all_to_all over ICI)."""
+        import numpy as np
+
+        world, rank = self.net.world, self.net.rank
+        for e in entries:
+            a = np.ascontiguousarray(np.asarray(e.tensor))
+            blobs = self.net.allgatherv(a.tobytes())
+            shard = a.shape[0] // world
+            parts = []
+            for blob in blobs:  # rank order
+                src = np.frombuffer(blob, dtype=a.dtype).reshape(a.shape)
+                parts.append(src[rank * shard:(rank + 1) * shard])
             e.output = np.concatenate(parts, axis=0)
 
     def _execute_broadcast_host(self, entries) -> None:

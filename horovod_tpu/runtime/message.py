@@ -26,10 +26,12 @@ _MAGIC = 0x48  # 'H'
 _VERSION = 2
 
 _REQUEST_TYPES = {types.ALLREDUCE: 0, types.ALLGATHER: 1, types.BROADCAST: 2,
-                  types.INVALIDATE: 4}
+                  types.INVALIDATE: 4, types.REDUCESCATTER: 5,
+                  types.ALLTOALL: 6}
 _REQUEST_TYPES_INV = {v: k for k, v in _REQUEST_TYPES.items()}
 _RESPONSE_TYPES = {types.ALLREDUCE: 0, types.ALLGATHER: 1,
-                   types.BROADCAST: 2, types.ERROR: 3, types.INVALIDATE: 4}
+                   types.BROADCAST: 2, types.ERROR: 3, types.INVALIDATE: 4,
+                   types.REDUCESCATTER: 5, types.ALLTOALL: 6}
 _RESPONSE_TYPES_INV = {v: k for k, v in _RESPONSE_TYPES.items()}
 
 # Reduce-op wire codes. Codes 0/1 coincide with the old boolean

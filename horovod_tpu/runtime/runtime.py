@@ -273,6 +273,19 @@ class Runtime:
         return self._enqueue(types.BROADCAST, name, tensor,
                              root_rank=root_rank, priority=priority)
 
+    def enqueue_reducescatter(self, name: str, tensor,
+                              reduce_op: str = types.REDUCE_SUM,
+                              priority: int = 0) -> RuntimeHandle:
+        if reduce_op not in types.REDUCE_OPS:
+            raise ValueError(f"unknown reduce_op {reduce_op!r}")
+        return self._enqueue(types.REDUCESCATTER, name, tensor,
+                             reduce_op=reduce_op, priority=priority)
+
+    def enqueue_alltoall(self, name: str, tensor,
+                         priority: int = 0) -> RuntimeHandle:
+        return self._enqueue(types.ALLTOALL, name, tensor,
+                             priority=priority)
+
     # -- cycle loop (reference: RunLoopOnce, operations.cc:500-550) --------
     def _run_loop(self) -> None:
         while not self._stop.is_set():

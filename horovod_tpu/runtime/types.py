@@ -17,6 +17,8 @@ from typing import Any, Callable, Optional
 ALLREDUCE = "ALLREDUCE"
 ALLGATHER = "ALLGATHER"
 BROADCAST = "BROADCAST"
+REDUCESCATTER = "REDUCESCATTER"
+ALLTOALL = "ALLTOALL"
 ERROR = "ERROR"
 # Synchronized cache-invalidation notice (no reference analogue as a wire
 # type; the reference syncs invalidated cache bits inside its

@@ -69,6 +69,26 @@ def main():
         out = np.asarray(hvd.synchronize(h))
         assert out.dtype == np.int32
         np.testing.assert_array_equal(out, expect)
+        # reducescatter: rank r contributes data[r] (world*2, 3); rank r
+        # receives shard r of the element-wise sum
+        data = np.stack([np.arange(world * 2 * 3, dtype=np.float32)
+                         .reshape(world * 2, 3) + 10 * r
+                         for r in range(world)])
+        out = np.asarray(hvd.reducescatter(data[rank], op=hvd.Sum))
+        full = data.sum(axis=0)
+        np.testing.assert_allclose(out, full[rank * 2:(rank + 1) * 2])
+        out = np.asarray(hvd.reducescatter(data[rank], op=hvd.Min))
+        np.testing.assert_allclose(out, data.min(axis=0)[rank * 2:(rank + 1) * 2])
+        # non-C-contiguous input must still reduce correctly (regression:
+        # the in-place ring must not write into a stray ravel() copy)
+        out = np.asarray(hvd.reducescatter(
+            np.asfortranarray(data[rank]), op=hvd.Sum))
+        np.testing.assert_allclose(out, full[rank * 2:(rank + 1) * 2])
+        # alltoall: rank r sends chunk j of its tensor to rank j
+        out = np.asarray(hvd.alltoall(data[rank]))
+        expect_a2a = np.concatenate(
+            [data[j, rank * 2:(rank + 1) * 2] for j in range(world)])
+        np.testing.assert_allclose(out, expect_a2a)
         # cache populated
         from horovod_tpu.core import state
         rt = state.global_state().runtime

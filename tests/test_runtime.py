@@ -367,6 +367,45 @@ class TestStallInspector:
         assert insp.check(MessageTable()) is False
 
 
+class TestReduceScatterAlltoallNegotiation:
+    def test_message_roundtrip_new_types(self):
+        for rtype in (types.REDUCESCATTER, types.ALLTOALL):
+            r = _req("t", rtype=rtype, shape=(8, 3), reduce_op="min")
+            out, _ = msg.Request.unpack(r.pack())
+            assert out == r
+        resp = msg.Response(types.REDUCESCATTER, ["t"])
+        assert msg.Response.unpack(resp.pack())[0].response_type == \
+            types.REDUCESCATTER
+
+    def test_construct_response_validates(self):
+        ok = construct_response([
+            _req("t", rank=0, rtype=types.REDUCESCATTER, shape=(4, 3)),
+            _req("t", rank=1, rtype=types.REDUCESCATTER, shape=(4, 3))])
+        assert ok.response_type == types.REDUCESCATTER
+        bad_shape = construct_response([
+            _req("t", rank=0, rtype=types.REDUCESCATTER, shape=(4, 3)),
+            _req("t", rank=1, rtype=types.REDUCESCATTER, shape=(6, 3))])
+        assert bad_shape.response_type == types.ERROR
+        bad_op = construct_response([
+            _req("t", rank=0, rtype=types.REDUCESCATTER, shape=(4, 3),
+                 reduce_op="sum"),
+            _req("t", rank=1, rtype=types.REDUCESCATTER, shape=(4, 3),
+                 reduce_op="min")])
+        assert "reduction ops" in bad_op.error_message
+        indivisible = construct_response([
+            _req("t", rank=0, rtype=types.REDUCESCATTER, shape=(3, 3)),
+            _req("t", rank=1, rtype=types.REDUCESCATTER, shape=(3, 3))])
+        assert "divide evenly" in indivisible.error_message
+        a2a_bad = construct_response([
+            _req("t", rank=0, rtype=types.ALLTOALL, shape=(4, 3)),
+            _req("t", rank=1, rtype=types.ALLTOALL, shape=(4, 2))])
+        assert a2a_bad.response_type == types.ERROR
+        a2a_ok = construct_response([
+            _req("t", rank=0, rtype=types.ALLTOALL, shape=(4, 3)),
+            _req("t", rank=1, rtype=types.ALLTOALL, shape=(4, 3))])
+        assert a2a_ok.response_type == types.ALLTOALL
+
+
 class TestEntryCompletion:
     def test_complete_fires_exactly_once(self):
         calls = []
