@@ -140,25 +140,30 @@ def main():
     print(json.dumps(result), flush=True)
 
 
-def bert_main():
-    """Second headline: BERT-Base MLM tokens/sec + MFU (BASELINE
-    progression config #5's model family; reference transformer workloads
-    in docs/benchmarks.rst). Flash-attention path (models/transformer.py
-    runs the Pallas kernel)."""
+def transformer_main(family: str):
+    """Transformer headlines: tokens/sec + MFU for BERT-Base MLM (BASELINE
+    progression config #5's model family) and GPT-2-small causal LM —
+    both on the Pallas flash-attention path (models/transformer.py).
+
+    Batch defaults are the measured v5e sweet spots (r2 sweeps: BERT
+    seq 512 — 16 -> 46.5% MFU, 32 -> 50.8%, 64 -> 47.7%)."""
     import optax as _optax
 
-    from horovod_tpu.models.transformer import BertBase, masked_lm_loss
+    from horovod_tpu.models.transformer import (BertBase, GPT2Small,
+                                                causal_lm_loss,
+                                                masked_lm_loss)
 
     hvd.init()
     n_chips = hvd.size()
-    # batch 32 is the measured v5e sweet spot (r2 sweep: 16 -> 46.5% MFU,
-    # 32 -> 50.8%, 64 -> 47.7%)
-    seq = int(os.environ.get("BENCH_BERT_SEQ", "512"))
-    batch = int(os.environ.get("BENCH_BERT_BATCH", "32"))
-    vocab = 30522
+    causal = family == "gpt2"
+    seq = int(os.environ.get("BENCH_BERT_SEQ", "1024" if causal else "512"))
+    batch = int(os.environ.get("BENCH_BERT_BATCH", "16" if causal else "32"))
+    vocab = 50257 if causal else 30522
     global_batch = batch * n_chips
+    label = "GPT-2-small causal LM" if causal else "BERT-Base MLM"
 
-    model = BertBase(vocab_size=vocab, max_seq=seq, dtype=jnp.bfloat16)
+    cls = GPT2Small if causal else BertBase
+    model = cls(vocab_size=vocab, max_seq=seq, dtype=jnp.bfloat16)
     rng = np.random.RandomState(0)
     tokens = rng.randint(0, vocab, (global_batch, seq)).astype(np.int32)
     mask = (rng.rand(global_batch, seq) < 0.15).astype(np.int32)
@@ -170,12 +175,19 @@ def bert_main():
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
     # training FLOPs/token: 6*N (fwd+bwd matmuls) + attention term
-    # 12*L*S*d (fwd+bwd QK^T and PV at seq length S)
+    # 12*L*S*d (fwd+bwd QK^T and PV). Causal counts the half score
+    # matrix — the standard MODEL-FLOPs convention for MFU (the useful
+    # math; at this seq/block config the kernel executes full masked
+    # blocks, i.e. hardware FLOPs are higher, which only makes the
+    # reported MFU conservative about the hardware's utilization).
     l_layers, d_model = 12, 768
-    flops_per_token = 6 * n_params + 12 * l_layers * seq * d_model
+    attn = 12 * l_layers * seq * d_model
+    flops_per_token = 6 * n_params + (attn // 2 if causal else attn)
 
     def loss_fn(p, toks, msk):
         logits = model.apply(p, toks, train=True)
+        if causal:
+            return causal_lm_loss(logits, toks)
         return masked_lm_loss(logits, toks, msk)
 
     @jax.jit
@@ -191,7 +203,7 @@ def bert_main():
                                       length=BATCHES_PER_ROUND)
         return p, s, losses[-1]
 
-    log(f"BERT-Base seq {seq} batch {batch}/chip "
+    log(f"{label} seq {seq} batch {batch}/chip "
         f"({n_params / 1e6:.0f}M params), compiling...")
     t0 = time.perf_counter()
     params, opt_state, loss = round_fn(params, opt_state, tokens, mask)
@@ -211,7 +223,7 @@ def bert_main():
     tokens_per_sec = float(np.mean(rates))
     per_chip = tokens_per_sec / n_chips
     result = {
-        "metric": f"tokens/sec/chip (BERT-Base MLM, bf16, seq {seq}, "
+        "metric": f"tokens/sec/chip ({label}, bf16, seq {seq}, "
                   f"batch {batch}/chip, flash attention)",
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
@@ -227,6 +239,9 @@ if __name__ == "__main__":
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet50",
-                        choices=["resnet50", "bert"])
+                        choices=["resnet50", "bert", "gpt2"])
     cli = parser.parse_args()
-    bert_main() if cli.model == "bert" else main()
+    if cli.model in ("bert", "gpt2"):
+        transformer_main(cli.model)
+    else:
+        main()
