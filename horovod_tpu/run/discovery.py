@@ -31,6 +31,7 @@ import shlex
 import subprocess
 import sys
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.run import util
@@ -45,16 +46,22 @@ class DiscoveryResult:
     host_routable: Dict[int, List[Tuple[str, int]]]
 
 
-def _client_for(addresses: List[Tuple[str, int]], key: bytes
-                ) -> ServiceClient:
+def _client_for(addresses: List[Tuple[str, int]], key: bytes,
+                probe_timeout: float = 3.0,
+                call_timeout: Optional[float] = None) -> ServiceClient:
     """Client bound to the first address that answers an authenticated
     ping (a task registers ALL its candidate addresses; the driver may
-    only be able to route to some of them)."""
+    only be able to route to some of them). Each candidate dial is bounded
+    by ``probe_timeout``; the returned client uses ``call_timeout``
+    (default: ``probe_timeout``) — callers whose next request makes the
+    task dial further peers must size it to cover those serial dials."""
     last_exc: Optional[Exception] = None
     for addr in addresses:
-        client = ServiceClient(tuple(addr), key, timeout=3.0)
+        client = ServiceClient(tuple(addr), key, timeout=probe_timeout)
         try:
             client.call(ProbeAddressesRequest([]))
+            if call_timeout is not None and call_timeout != probe_timeout:
+                return ServiceClient(tuple(addr), key, timeout=call_timeout)
             return client
         except Exception as exc:  # noqa: BLE001 — try the next candidate
             last_exc = exc
@@ -68,28 +75,85 @@ def _client_for(addresses: List[Tuple[str, int]], key: bytes
 def _ssh_agent(hostname: str, index: int, num_hosts: int, key: bytes,
                driver_addrs: List[Tuple[str, int]],
                ssh_port: Optional[int], timeout: float) -> subprocess.Popen:
+    from horovod_tpu.run.backends import _remote_command
+
     addrs = ",".join(f"{h}:{p}" for h, p in driver_addrs)
-    inner = (f"HOROVOD_TASK_KEY={key.hex()} {shlex.quote(sys.executable)} "
+    # the HMAC key travels over the agent's STDIN, never the command line
+    # (a command-line key is visible to every local user via `ps` for the
+    # agent's whole lifetime); the remote command gets the launcher's
+    # whitelisted env (PYTHONPATH etc.) so the agent can import
+    # horovod_tpu in PYTHONPATH-based deployments
+    inner = (f"{shlex.quote(sys.executable)} "
              f"-m horovod_tpu.run.task_agent {index} {num_hosts} "
-             f"{shlex.quote(addrs)} {int(timeout)}")
+             f"{shlex.quote(addrs)} {int(timeout)} --key-stdin")
+    # the key must never ride the command line — strip it from the env
+    # export too (backends' whitelist would otherwise re-leak it into ps)
+    env = {k: v for k, v in os.environ.items() if k != "HOROVOD_TASK_KEY"}
     port_arg = f"-p {ssh_port} " if ssh_port else ""
     cmd = (f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no "
            f"{port_arg}{hostname} "
-           f"{shlex.quote(f'cd {os.getcwd()} 2>/dev/null; {inner}')}")
-    return subprocess.Popen(cmd, shell=True, start_new_session=True)
+           f"{shlex.quote(_remote_command(inner, env))}")
+    proc = subprocess.Popen(cmd, shell=True, start_new_session=True,
+                            stdin=subprocess.PIPE)
+    try:
+        proc.stdin.write(key.hex().encode() + b"\n")
+        proc.stdin.flush()
+        proc.stdin.close()
+    except (BrokenPipeError, OSError):
+        pass  # agent died instantly; registration timeout reports it
+    return proc
+
+
+def _ring_probe(task_addresses: Dict[int, List[Tuple[str, int]]],
+                key: bytes, probe_timeout: float
+                ) -> Dict[int, List[Tuple[str, int]]]:
+    """Ring probe: task i checks the candidates of task (i+1) % n; an
+    authenticated pong proves routability host-to-host (not just
+    driver-to-host). All n probes run concurrently — each is one
+    driver->task-i dial plus one task-i->task-succ probe, independent of
+    the others, so wall-clock is ~one probe round, not n of them (the
+    reference likewise launches all task probes at once,
+    run/run.py:195-265)."""
+    n = len(task_addresses)
+
+    def _probe(index: int) -> List[Tuple[str, int]]:
+        succ = (index + 1) % n
+        # the task dials each successor candidate serially with
+        # probe_timeout, so the driver's wait on this one request must
+        # cover ALL those dials, not a single one
+        call_timeout = probe_timeout * max(1, len(task_addresses[succ])) + 5.0
+        client = _client_for(task_addresses[index], key, probe_timeout,
+                             call_timeout=call_timeout)
+        reachable = client.call(
+            ProbeAddressesRequest(task_addresses[succ],
+                                  dial_timeout=probe_timeout))
+        return [tuple(a) for a in reachable]
+
+    host_routable: Dict[int, List[Tuple[str, int]]] = {}
+    with ThreadPoolExecutor(max_workers=min(n, 32)) as pool:
+        for index, reachable in enumerate(pool.map(_probe, range(n))):
+            host_routable[(index + 1) % n] = reachable
+    return host_routable
 
 
 def discover(hostnames: List[str], key: bytes,
              is_local: Optional[callable] = None,
              ssh_port: Optional[int] = None,
-             timeout: float = 120.0) -> DiscoveryResult:
+             timeout: float = 120.0,
+             probe_timeout: Optional[float] = None) -> DiscoveryResult:
     """Run the ring probe across ``hostnames`` (one agent per host) and
     return the proven driver address plus per-host routable addresses.
 
     ``is_local`` decides in-process vs ssh agent (default: the launcher's
-    ``is_local_host``)."""
+    ``is_local_host``). ``probe_timeout`` bounds each candidate-address
+    dial (default 3 s, ``HOROVOD_PROBE_TIMEOUT``); the per-host probes
+    run concurrently — the reference launches all task probes at once
+    (run/run.py:195-265), and serial dialing would cost minutes on a
+    64-host pod with one stale interface per host."""
     if is_local is None:
         from horovod_tpu.run.launcher import is_local_host as is_local
+    if probe_timeout is None:
+        probe_timeout = float(os.environ.get("HOROVOD_PROBE_TIMEOUT", "3"))
 
     n = len(hostnames)
     driver = DriverService(key, n)
@@ -118,16 +182,7 @@ def discover(hostnames: List[str], key: bytes,
             t.join(timeout=timeout)
 
         task_addresses = driver.task_addresses()
-        # ring probe: task i checks the candidates of task (i+1) % n; an
-        # authenticated pong proves routability host-to-host (not just
-        # driver-to-host)
-        host_routable: Dict[int, List[Tuple[str, int]]] = {}
-        for index in range(n):
-            succ = (index + 1) % n
-            client = _client_for(task_addresses[index], key)
-            reachable = client.call(
-                ProbeAddressesRequest(task_addresses[succ]))
-            host_routable[succ] = [tuple(a) for a in reachable]
+        host_routable = _ring_probe(task_addresses, key, probe_timeout)
         empty = [i for i in range(n) if not host_routable[i]]
         if empty:
             raise RuntimeError(
@@ -156,15 +211,24 @@ def discover(hostnames: List[str], key: bytes,
                                host_routable=host_routable)
     finally:
         if ssh_procs:
-            # tell remote agents to exit (best-effort), then reap
+            # tell remote agents to exit (best-effort, concurrently), then
+            # reap
             local_idx = {t.index for t in local_tasks}
-            for index, addrs in driver.task_addresses().items():
-                if index in local_idx:
-                    continue
+            remote = [addrs for index, addrs
+                      in driver.task_addresses().items()
+                      if index not in local_idx]
+
+            def _shutdown_one(addrs):
                 try:
-                    _client_for(addrs, key).call(ShutdownServiceRequest())
+                    _client_for(addrs, key, probe_timeout).call(
+                        ShutdownServiceRequest())
                 except Exception:
                     pass
+
+            if remote:
+                with ThreadPoolExecutor(
+                        max_workers=min(len(remote), 32)) as pool:
+                    list(pool.map(_shutdown_one, remote))
             for proc in ssh_procs:
                 try:
                     proc.wait(timeout=10)

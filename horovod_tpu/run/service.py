@@ -65,9 +65,12 @@ class ProbeAddressesRequest:
     """Ask a task to probe candidate (ip, port) addresses of its ring
     successor and report the reachable subset (reference: task_fn.py:24-50
     — tasks ping each other in a ring to weed out NAT'ed/dead
-    interfaces)."""
+    interfaces). ``dial_timeout`` bounds each candidate dial on the task
+    side (propagated from the driver's probe-timeout knob so one setting
+    governs every dial in the probe)."""
 
     addresses: List[Tuple[str, int]]
+    dial_timeout: float = 3.0
 
 
 @dataclasses.dataclass
@@ -251,7 +254,8 @@ class TaskService(BasicService):
                 return OkResponse(None)
             return OkResponse(proc.poll())
         if isinstance(req, ProbeAddressesRequest):
-            return OkResponse(probe_reachable(req.addresses, self._key))
+            return OkResponse(probe_reachable(req.addresses, self._key,
+                                              timeout=req.dial_timeout))
         return super()._handle(req)
 
     def register(self, driver_addr: Tuple[str, int], key: bytes,

@@ -8,8 +8,14 @@ requests from the driver, and exits on ``ShutdownServiceRequest``.
 
 Usage (what ``discovery._ssh_agent`` generates)::
 
-    HOROVOD_TASK_KEY=<hex> python -m horovod_tpu.run.task_agent \
-        <index> <num_hosts> <driver_host:port,...> <timeout_seconds>
+    echo <key-hex> | python -m horovod_tpu.run.task_agent \
+        <index> <num_hosts> <driver_host:port,...> <timeout_seconds> \
+        --key-stdin
+
+With ``--key-stdin`` the HMAC key arrives as one hex line on stdin (the
+launcher pipes it through ssh) so it never appears on a command line or
+in ``ps`` output; without the flag it falls back to the
+``HOROVOD_TASK_KEY`` environment variable (in-process/test use).
 """
 
 from __future__ import annotations
@@ -23,9 +29,11 @@ from horovod_tpu.run.service import TaskService
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    key_stdin = "--key-stdin" in argv
+    argv = [a for a in argv if a != "--key-stdin"]
     if len(argv) != 4:
         print("usage: task_agent <index> <num_hosts> <driver_addrs> "
-              "<timeout_s>", file=sys.stderr)
+              "<timeout_s> [--key-stdin]", file=sys.stderr)
         return 2
     index = int(argv[0])
     timeout_s = float(argv[3])
@@ -33,7 +41,16 @@ def main(argv=None) -> int:
     for part in argv[2].split(","):
         host, port = part.rsplit(":", 1)
         driver_addrs.append((host, int(port)))
-    key = bytes.fromhex(os.environ["HOROVOD_TASK_KEY"])
+    if key_stdin:
+        line = sys.stdin.readline().strip()
+        if not line:
+            print("task_agent: --key-stdin given but no key arrived on "
+                  "stdin (transport dropped before delivering it?)",
+                  file=sys.stderr)
+            return 2
+        key = bytes.fromhex(line)
+    else:
+        key = bytes.fromhex(os.environ["HOROVOD_TASK_KEY"])
 
     task = TaskService(key, index)
     try:

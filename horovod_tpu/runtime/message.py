@@ -34,8 +34,10 @@ _RESPONSE_TYPES = {types.ALLREDUCE: 0, types.ALLGATHER: 1,
                    types.REDUCESCATTER: 5, types.ALLTOALL: 6}
 _RESPONSE_TYPES_INV = {v: k for k, v in _RESPONSE_TYPES.items()}
 
-# Reduce-op wire codes. Codes 0/1 coincide with the old boolean
-# ``average`` byte (0=sum, 1=average), so v1 frames stay readable.
+# Reduce-op wire codes. Codes 0/1 preserve the *meaning* of the old v1
+# boolean ``average`` byte (0=sum, 1=average) so the assignment stays
+# self-documenting; version-skewed frames are still rejected outright by
+# the _VERSION check above, never interpreted.
 _REDUCE_OPS = {types.REDUCE_SUM: 0, types.REDUCE_AVERAGE: 1,
                types.REDUCE_MIN: 2, types.REDUCE_MAX: 3,
                types.REDUCE_PRODUCT: 4}

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import threading
 from typing import Any, Callable, Optional
 
 # reference: horovod/common/message.h RequestType / ResponseType
@@ -121,15 +122,20 @@ class TensorTableEntry:
     # completion is tracked on the entry itself so the exactly-once guard
     # works for ANY callable — not just bound methods of a pollable handle
     completed: bool = False
+    # the cycle thread and the caller thread (stop() -> finalize) can race
+    # to complete the same entry; the lock makes the check-then-set atomic
+    _complete_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
 
     def complete(self, status, output=None) -> None:
         """Fire the completion callback exactly once. All runtime paths
         (success, error, shutdown, cycle-failure cleanup) funnel through
         here, so a double fire is structurally impossible no matter what
         the callback is wrapped in."""
-        if self.completed:
-            return
-        self.completed = True
+        with self._complete_lock:
+            if self.completed:
+                return
+            self.completed = True
         if self.callback is not None:
             self.callback(status, output)
 

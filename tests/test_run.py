@@ -415,6 +415,61 @@ def test_nic_discovery_raises_when_unreachable(monkeypatch):
                            is_local=lambda h: True, timeout=30.0)
 
 
+def test_ring_probe_runs_concurrently(monkeypatch):
+    """32 mocked hosts, each dial costing a fixed delay: the probe phase
+    must take ~one probe round (concurrent), not 32 serial rounds — the
+    reference launches all task probes at once (run/run.py:195-265)."""
+    import time
+
+    from horovod_tpu.run import discovery, util as run_util
+
+    n, dial_delay = 32, 0.2
+    task_addresses = {i: [(f"10.0.0.{i}", 9000 + i)] for i in range(n)}
+
+    class FakeClient:
+        def __init__(self, addrs):
+            self.addrs = addrs
+
+        def call(self, request):
+            time.sleep(dial_delay)  # the task->successor probe
+            return request.addresses
+
+    def fake_client_for(addresses, key, probe_timeout=3.0,
+                        call_timeout=None):
+        time.sleep(dial_delay)  # the driver->task dial
+        return FakeClient(addresses)
+
+    monkeypatch.setattr(discovery, "_client_for", fake_client_for)
+    key = run_util.make_secret_key()
+    t0 = time.perf_counter()
+    routable = discovery._ring_probe(task_addresses, key, probe_timeout=1.0)
+    wall = time.perf_counter() - t0
+    assert set(routable) == set(range(n))
+    for i in range(n):
+        assert routable[i] == [tuple(a) for a in task_addresses[i]]
+    # serial would be n * 2 * dial_delay = 12.8s; concurrent is ~2 dials.
+    # Generous bound (4 rounds) for a loaded 1-core CI box.
+    assert wall < 4 * 2 * dial_delay, f"probe phase not concurrent: {wall:.2f}s"
+
+
+def test_task_agent_key_over_stdin(monkeypatch, capsys):
+    """--key-stdin reads the HMAC key from stdin (never the command line /
+    process environment); a bad driver address makes registration fail
+    fast but proves the key parse happened."""
+    from horovod_tpu.run import task_agent
+
+    monkeypatch.delenv("HOROVOD_TASK_KEY", raising=False)
+    monkeypatch.setattr("sys.stdin", io.StringIO("a1b2c3d4\n"))
+    # key parse succeeds (no KeyError on the absent env var); registration
+    # then times out against the dead driver address
+    with pytest.raises(TimeoutError):
+        task_agent.main(["0", "1", "127.0.0.1:1", "0.2", "--key-stdin"])
+    # and without --key-stdin the env fallback still applies
+    monkeypatch.setenv("HOROVOD_TASK_KEY", "a1b2c3d4")
+    with pytest.raises(TimeoutError):
+        task_agent.main(["0", "1", "127.0.0.1:1", "0.2"])
+
+
 def test_tpurun_forced_nic_discovery(monkeypatch):
     """End-to-end: 2-process localhost launch with discovery forced on
     feeds the proven driver address into the rendezvous env."""
