@@ -10,14 +10,17 @@
 FROM python:3.13-slim
 
 RUN apt-get update && apt-get install -y --no-install-recommends \
-        build-essential make g++ openssh-client \
+        build-essential make g++ openssh-client default-jre-headless \
     && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /horovod_tpu
 COPY . .
 
+# tensorflow-cpu exercises the TF binding; pyspark (+ the JRE above) the
+# real-local[2] Spark tests — the reference bakes both into its test
+# image (Dockerfile.test.cpu:53-83)
 RUN pip install --no-cache-dir "jax[cpu]" flax optax chex einops pytest \
-        torch --index-url https://pypi.org/simple \
+        torch tensorflow-cpu pyspark --index-url https://pypi.org/simple \
     && pip install --no-cache-dir -e . --no-deps
 
 # the test matrix: collective semantics, fusion, caching, error paths on a

@@ -1,0 +1,61 @@
+"""Gradient compression for the TensorFlow binding.
+
+Rebuild of the reference's TF compression (reference:
+horovod/tensorflow/compression.py:23-78): compress to fp16 on the wire,
+decompress back to the original dtype after the collective. Non-float
+tensors pass through untouched.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface: compress before the collective, decompress after."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: compression.py:34-44)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Halve wire bytes for float tensors (reference:
+    compression.py:47-69). Integer tensors pass through — casting them
+    would corrupt the values."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating and tensor.dtype != tf.float16:
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return tf.cast(tensor, ctx)
+
+
+class Compression:
+    """Namespace matching the reference's selection surface
+    (compression.py:72-78)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -1,0 +1,197 @@
+"""TensorFlow collective ops backed by the TPU-native runtime.
+
+Rebuild of the reference's TF op layer (reference:
+horovod/tensorflow/mpi_ops.py:33-180 and the kernels in
+horovod/tensorflow/mpi_ops.cc:276-440): ``_allreduce`` / ``allgather`` /
+``broadcast`` with registered gradients so the ops are differentiable
+under ``tf.GradientTape`` and inside ``tf.function`` graphs.
+
+Where the reference loads a compiled ``mpi_lib`` op library whose
+AsyncOpKernels enqueue into the Horovod runtime, this binding reaches the
+same dynamic enqueue runtime (negotiation, response cache, tensor fusion
+— SURVEY.md §2.1) through the named-async numpy API, bridged into the TF
+graph with ``tf.py_function`` and differentiated with
+``tf.custom_gradient`` — the TF2-idiomatic equivalents of a custom op
+with a ``RegisterGradient`` entry. TF tensors cross as numpy arrays
+(bfloat16 included — TF's ``.numpy()`` yields ``ml_dtypes.bfloat16``,
+which the collective layer handles natively); the collective itself runs
+on the XLA data plane or the multi-process wire exactly as for the torch
+binding.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu.core.basics import (  # noqa: F401 — re-exported lifecycle
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mpi_threads_supported,
+    mpi_enabled,
+    mpi_built,
+    gloo_built,
+    nccl_built,
+    ddl_built,
+    mlsl_built,
+    xla_built,
+)
+from horovod_tpu.ops import collectives as _c
+
+# the reference exposes gloo_enabled alongside gloo_built
+# (mpi_ops.py:61-62); in this runtime the wire transport is active
+# whenever it is built
+gloo_enabled = gloo_built
+
+Average = _c.Average
+Sum = _c.Sum
+
+# Per-process op counters for auto-generated names, shared convention
+# with the torch binding (torch/mpi_ops.py:33-43): all ranks must issue
+# unnamed ops in the same order (and trace tf.functions in the same
+# order) — the reference's graph-mode naming has the same property.
+_op_counters = {}
+_counter_lock = threading.Lock()
+
+
+def _op_name(op_kind, name):
+    if name is not None:
+        return _normalize_name(name)
+    with _counter_lock:
+        n = _op_counters.get(op_kind, 0)
+        _op_counters[op_kind] = n + 1
+    return f"{op_kind}.noname.{n}"
+
+
+def _normalize_name(name):
+    """Normalize an op name to TF charset rules (reference:
+    mpi_ops.py:68-70) — also keeps wire names printable."""
+    return re.sub("[^a-zA-Z0-9_./]", "_", name)
+
+
+def _run_collective(launch, tensor, out_dtype, out_shape):
+    """Run a numpy-level collective inside the TF graph.
+
+    ``launch(np_array) -> np_array`` is executed via ``tf.py_function``
+    so the same code path serves eager execution and traced
+    ``tf.function`` graphs (the reference's AsyncOpKernel serves both the
+    same way). ``out_shape`` restores the static shape py_function
+    erases; pass None when the output shape depends on other ranks
+    (allgather's dim 0)."""
+
+    def bridge(t):
+        return launch(t.numpy())
+
+    out = tf.py_function(bridge, [tensor], Tout=out_dtype)
+    if out_shape is not None:
+        out.set_shape(out_shape)
+    else:
+        shape = tensor.shape.as_list() if tensor.shape.rank is not None \
+            else None
+        if shape is not None:
+            shape[0] = None
+            out.set_shape(shape)
+    return out
+
+
+def _allreduce(tensor, name=None, op=Sum):
+    """Sum (by default) a tensor over all processes, keyed by name; the
+    op completes only after every rank contributed (reference:
+    mpi_ops.py:73-86). Differentiable: grad(allreduce) = allreduce(grad)
+    (reference: mpi_ops.py:89-100)."""
+    tensor = tf.convert_to_tensor(tensor)
+    if size() == 1:
+        return tf.identity(tensor)
+    wire_name = _op_name("allreduce", name)
+
+    @tf.custom_gradient
+    def fn(t):
+        def launch(arr):
+            return np.asarray(_c.synchronize(
+                _c.allreduce_async(arr, op=op, name=wire_name)))
+
+        result = _run_collective(launch, t, t.dtype, t.shape)
+
+        def grad(dy):
+            return _allreduce(dy, name=f"{wire_name}.grad", op=op)
+
+        return result, grad
+
+    return fn(tensor)
+
+
+def allgather(tensor, name=None):
+    """Concatenate each rank's tensor along dim 0; ranks may differ in
+    dim 0 (reference: mpi_ops.py:103-119). Differentiable: the gradient
+    is this rank's slice of the summed gradient (reference:
+    mpi_ops.py:122-145)."""
+    tensor = tf.convert_to_tensor(tensor)
+    if size() == 1:
+        return tf.identity(tensor)
+    wire_name = _op_name("allgather", name)
+
+    @tf.custom_gradient
+    def fn(t):
+        def launch(arr):
+            return np.asarray(_c.synchronize(
+                _c.allgather_async(arr, name=wire_name)))
+
+        result = _run_collective(launch, t, t.dtype, None)
+
+        def grad(dy):
+            # sizes travel as one more allgather so ragged dim 0 splits
+            # correctly (reference does the same with a [d0] gather)
+            d0 = tf.shape(t)[0]
+            sizes = allgather(tf.reshape(d0, [1]),
+                              name=f"{wire_name}.sizes")
+            summed = _allreduce(dy, name=f"{wire_name}.grad")
+            offset = tf.reduce_sum(sizes[:rank()])
+            begin = tf.concat(
+                [tf.reshape(offset, [1]),
+                 tf.zeros([tf.rank(t) - 1], dtype=tf.int32)], axis=0)
+            extent = tf.concat(
+                [tf.reshape(d0, [1]), tf.shape(t)[1:]], axis=0)
+            return tf.slice(summed, begin, extent)
+
+        return result, grad
+
+    return fn(tensor)
+
+
+def broadcast(tensor, root_rank, name=None):
+    """Broadcast the root rank's value to every process, keyed by name
+    (reference: mpi_ops.py:148-162). Differentiable: the gradient is the
+    summed gradient on the root and zero elsewhere (reference:
+    mpi_ops.py:165-180)."""
+    tensor = tf.convert_to_tensor(tensor)
+    if size() == 1:
+        return tf.identity(tensor)
+    wire_name = _op_name("broadcast", name)
+
+    @tf.custom_gradient
+    def fn(t):
+        def launch(arr):
+            return np.asarray(_c.synchronize(
+                _c.broadcast_async(arr, root_rank, name=wire_name)))
+
+        result = _run_collective(launch, t, t.dtype, t.shape)
+
+        def grad(dy):
+            summed = _allreduce(dy, name=f"{wire_name}.grad")
+            if rank() != root_rank:
+                return tf.zeros_like(summed)
+            return summed
+
+        return result, grad
+
+    return fn(tensor)

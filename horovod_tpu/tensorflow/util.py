@@ -1,0 +1,41 @@
+"""Small TF-side helpers for the TensorFlow binding.
+
+TPU-native analogue of the reference's helper module (reference:
+horovod/tensorflow/util.py:21-55 — ``_executing_eagerly``,
+``_make_subgraph``, ``_cache``): eager detection, tf.function wrapping,
+and a per-argument cache used to build the grads-allreduce closure once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import tensorflow as tf
+
+
+def _executing_eagerly() -> bool:
+    """True when TF is executing eagerly (TF2 default)."""
+    return tf.executing_eagerly()
+
+
+def _make_subgraph(fn):
+    """Compile ``fn`` into a single TF graph so independent ops inside it
+    (e.g. the per-variable broadcasts of ``broadcast_variables``) run
+    concurrently instead of serializing through the eager executor."""
+    return tf.function(fn)
+
+
+def _cache(fn):
+    """Memoize on hashable positional args (the reference caches its
+    closure factories the same way so tf.function tracing happens once
+    per configuration, not once per call)."""
+    cache = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        key = (args, tf.executing_eagerly())
+        if key not in cache:
+            cache[key] = fn(*args)
+        return cache[key]
+
+    return wrapper

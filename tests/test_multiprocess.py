@@ -84,6 +84,19 @@ def test_shape_mismatch_errors_on_all_ranks():
         assert p.returncode == 0, out
 
 
+@pytest.mark.parametrize("world", [2])
+def test_tensorflow_binding_across_processes(world):
+    """TF eager binding under a real multi-process world (reference:
+    test/test_tensorflow.py under mpirun -np 2): collectives, custom
+    gradients, DistributedGradientTape/Optimizer lockstep,
+    broadcast_variables, IndexedSlices, object broadcast."""
+    pytest.importorskip("tensorflow")
+    procs, outs = _launch("tensorflow", world, timeout=300)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK rank=" in out
+
+
 @pytest.mark.parametrize("world", [2, 3])
 def test_torch_binding_across_processes(world):
     """Torch DistributedOptimizer + broadcasts under a real multi-process
