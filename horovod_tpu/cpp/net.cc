@@ -67,6 +67,12 @@ int recv_all(int fd, void* buf, size_t n) {
   return 0;
 }
 
+// Data-plane bytes sent by this process through duplex_exchange (the
+// ring/mesh collective kernels). Lets tests assert the optimal byte
+// counts of the reduce-scatter ((w-1)/w) and pairwise alltoall ((w-1)/w)
+// instead of trusting the algorithm comment.
+uint64_t g_data_bytes_sent = 0;
+
 // Full-duplex exchange: send `sn` bytes to `sfd` while receiving `rn` bytes
 // from `rfd`, making progress on whichever direction is ready. Required for
 // the ring steps: every rank sends and receives a chunk simultaneously, so a
@@ -74,6 +80,7 @@ int recv_all(int fd, void* buf, size_t n) {
 // deadlock the whole ring (all ranks stuck in send, nobody draining).
 int duplex_exchange(int sfd, const void* send_buf, size_t sn, int rfd,
                     void* recv_buf, size_t rn) {
+  g_data_bytes_sent += sn;
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   while (sn > 0 || rn > 0) {
@@ -185,16 +192,24 @@ struct Comm {
   int rank = 0;
   int world = 1;
   // star: coordinator holds star[r] per worker r (star[0] unused);
-  // workers hold star[0] = socket to coordinator.
+  // workers hold star[0] = socket to coordinator. Control verbs only —
+  // kept separate from the data mesh so control frames never interleave
+  // with collective payloads.
   std::vector<int> star;
-  // ring: socket to successor and predecessor
+  // full data mesh: mesh[s] = socket to rank s (mesh[rank] unused). The
+  // ring links are the (rank±1) entries; the remaining links carry the
+  // pairwise alltoall (a ring-only topology would force W/2x the bytes
+  // through the neighbor links).
+  std::vector<int> mesh;
+  // ring aliases into mesh (not separately owned)
   int ring_next = -1;
   int ring_prev = -1;
   std::string error;
 };
 
 // handshake tags
-constexpr uint32_t KHELLO = 0x68766431;  // "hvd1"
+constexpr uint32_t KHELLO = 0x68766431;  // "hvd1" (star hello)
+constexpr uint32_t KMESH = 0x68766d31;   // "hvm1" (mesh hello)
 
 // ring address book entry: where each rank's ring listener is reachable.
 // The coordinator fills `ip` from getpeername() on the rank's star socket —
@@ -204,6 +219,46 @@ struct RingAddr {
   char ip[46];  // INET6_ADDRSTRLEN
   int32_t port;
 };
+
+// Build the full data mesh over the per-rank listeners: every rank dials
+// all lower ranks (their listeners are already up, so connects land in
+// the backlog even while the peer is still dialing) and accepts one
+// connection from every higher rank, identified by a hello frame.
+int mesh_build(Comm* c, int listen_fd, const std::vector<RingAddr>& addrs,
+               int timeout_ms) {
+  const int w = c->world, r = c->rank;
+  c->mesh.assign(w, -1);
+  for (int s = 0; s < r; ++s) {
+    int fd = tcp_connect_retry(addrs[s].ip, addrs[s].port, timeout_ms);
+    if (fd < 0) return -1;
+    uint32_t magic = KMESH;
+    int32_t me = r;
+    if (send_all(fd, &magic, sizeof(magic)) != 0 ||
+        send_all(fd, &me, sizeof(me)) != 0) {
+      ::close(fd);
+      return -1;
+    }
+    c->mesh[s] = fd;
+  }
+  for (int n = 0; n < w - 1 - r; ++n) {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) return -1;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint32_t magic = 0;
+    int32_t peer = -1;
+    if (recv_all(fd, &magic, sizeof(magic)) != 0 || magic != KMESH ||
+        recv_all(fd, &peer, sizeof(peer)) != 0 || peer <= r || peer >= w ||
+        c->mesh[peer] != -1) {
+      ::close(fd);
+      return -1;
+    }
+    c->mesh[peer] = fd;
+  }
+  c->ring_next = c->mesh[(r + 1) % w];
+  c->ring_prev = c->mesh[(r - 1 + w) % w];
+  return 0;
+}
 
 int comm_init(Comm* c, int rank, int world, const char* coord_host,
               int coord_port, int timeout_ms) {
@@ -264,7 +319,7 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
       ring_addrs[peer_rank].port = peer_ring_port;
     }
     ::close(lfd);
-    // broadcast the ring address book
+    // broadcast the mesh address book
     for (int r = 1; r < world; ++r) {
       if (send_all(c->star[r], ring_addrs.data(),
                    sizeof(RingAddr) * world) != 0) {
@@ -272,11 +327,10 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
         return -1;
       }
     }
-    // ring connects: rank r dials (r+1)%world at that rank's own address;
-    // everyone accepts from its predecessor.
-    c->ring_next = tcp_connect_retry(ring_addrs[1 % world].ip,
-                                     ring_addrs[1 % world].port, timeout_ms);
-    c->ring_prev = ::accept(ring_listen_fd, nullptr, nullptr);
+    if (mesh_build(c, ring_listen_fd, ring_addrs, timeout_ms) != 0) {
+      c->error = "mesh setup failed";
+      return -1;
+    }
   } else {
     int fd = tcp_connect_retry(coord_host, coord_port, timeout_ms);
     if (fd < 0) {
@@ -297,26 +351,27 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
       c->error = "address book recv failed";
       return -1;
     }
-    const RingAddr& next = ring_addrs[(rank + 1) % world];
-    c->ring_next = tcp_connect_retry(next.ip, next.port, timeout_ms);
-    c->ring_prev = ::accept(ring_listen_fd, nullptr, nullptr);
+    if (mesh_build(c, ring_listen_fd, ring_addrs, timeout_ms) != 0) {
+      c->error = "mesh setup failed";
+      return -1;
+    }
   }
   ::close(ring_listen_fd);
   if (c->ring_next < 0 || c->ring_prev < 0) {
     c->error = "ring setup failed";
     return -1;
   }
-  int one = 1;
-  ::setsockopt(c->ring_prev, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return 0;
 }
 
 void comm_close(Comm* c) {
   for (int fd : c->star)
     if (fd >= 0) ::close(fd);
-  if (c->ring_next >= 0) ::close(c->ring_next);
-  if (c->ring_prev >= 0) ::close(c->ring_prev);
+  for (int fd : c->mesh)
+    if (fd >= 0) ::close(fd);
   c->star.clear();
+  c->mesh.clear();
+  // aliases into mesh — already closed above
   c->ring_next = c->ring_prev = -1;
 }
 
@@ -407,6 +462,29 @@ int barrier(Comm* c) {
 // summing).
 enum RedOp { kRedSum = 0, kRedMin = 1, kRedMax = 2, kRedProd = 3 };
 
+// chunk boundary i of `count` elements split into `w` near-equal chunks
+inline uint64_t chunk_begin(uint64_t count, int w, int i) {
+  return count * static_cast<uint64_t>(i) / w;
+}
+
+template <typename T>
+void combine(T* dst, const T* src, uint64_t n, int op) {
+  switch (op) {
+    case kRedSum:
+      for (uint64_t i = 0; i < n; ++i) dst[i] += src[i];
+      break;
+    case kRedMin:
+      for (uint64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case kRedMax:
+      for (uint64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case kRedProd:
+      for (uint64_t i = 0; i < n; ++i) dst[i] *= src[i];
+      break;
+  }
+}
+
 template <typename T>
 int ring_allreduce_t(Comm* c, T* data, uint64_t count, int op) {
   if (op < kRedSum || op > kRedProd) return -1;
@@ -432,23 +510,7 @@ int ring_allreduce_t(Comm* c, T* data, uint64_t count, int op) {
                         send_n * sizeof(T), c->ring_prev, recv_buf.data(),
                         recv_n * sizeof(T)) != 0)
       return -1;
-    T* dst = data + begin[recv_chunk];
-    switch (op) {
-      case kRedSum:
-        for (uint64_t i = 0; i < recv_n; ++i) dst[i] += recv_buf[i];
-        break;
-      case kRedMin:
-        for (uint64_t i = 0; i < recv_n; ++i)
-          dst[i] = std::min(dst[i], recv_buf[i]);
-        break;
-      case kRedMax:
-        for (uint64_t i = 0; i < recv_n; ++i)
-          dst[i] = std::max(dst[i], recv_buf[i]);
-        break;
-      case kRedProd:
-        for (uint64_t i = 0; i < recv_n; ++i) dst[i] *= recv_buf[i];
-        break;
-    }
+    combine(data + begin[recv_chunk], recv_buf.data(), recv_n, op);
   }
   // allgather ring: circulate the owned (fully reduced) chunks
   for (int step = 0; step < w - 1; ++step) {
@@ -459,6 +521,68 @@ int ring_allreduce_t(Comm* c, T* data, uint64_t count, int op) {
     if (duplex_exchange(c->ring_next, data + begin[send_chunk],
                         send_n * sizeof(T), c->ring_prev,
                         data + begin[recv_chunk], recv_n * sizeof(T)) != 0)
+      return -1;
+  }
+  return 0;
+}
+
+// True half-ring reduce-scatter (VERDICT r2 ask 6): w-1 ring steps, each
+// moving one chunk — (w-1)/w of the payload total, the optimal byte
+// count (the old fallback ran a full allreduce then sliced: 2x). After
+// the steps, rank r's chunk r region of `data` holds the full reduction;
+// it is copied to `out`.
+template <typename T>
+int ring_reducescatter_t(Comm* c, T* data, uint64_t count, int op, T* out) {
+  if (op < kRedSum || op > kRedProd) return -1;
+  const int w = c->world, r = c->rank;
+  uint64_t own_b = chunk_begin(count, w, r);
+  uint64_t own_n = chunk_begin(count, w, r + 1) - own_b;
+  if (w == 1 || count == 0) {
+    std::memcpy(out, data + own_b, own_n * sizeof(T));
+    return 0;
+  }
+  uint64_t max_chunk = 0;
+  for (int i = 0; i < w; ++i)
+    max_chunk = std::max(max_chunk,
+                         chunk_begin(count, w, i + 1) - chunk_begin(count, w, i));
+  std::vector<T> recv_buf(max_chunk);
+  // shifted by one vs the allreduce phase so the final owned chunk is
+  // chunk `rank` (the reduce-scatter output convention), not rank+1
+  for (int step = 0; step < w - 1; ++step) {
+    int send_chunk = (r - step - 1 + 2 * w) % w;
+    int recv_chunk = (r - step - 2 + 2 * w) % w;
+    uint64_t sb = chunk_begin(count, w, send_chunk);
+    uint64_t sn = chunk_begin(count, w, send_chunk + 1) - sb;
+    uint64_t rb = chunk_begin(count, w, recv_chunk);
+    uint64_t rn = chunk_begin(count, w, recv_chunk + 1) - rb;
+    if (duplex_exchange(c->ring_next, data + sb, sn * sizeof(T),
+                        c->ring_prev, recv_buf.data(),
+                        rn * sizeof(T)) != 0)
+      return -1;
+    combine(data + rb, recv_buf.data(), rn, op);
+  }
+  std::memcpy(out, data + own_b, own_n * sizeof(T));
+  return 0;
+}
+
+// Pairwise all-to-all over the full mesh (VERDICT r2 ask 6): w-1 rounds;
+// in round k every rank sends its (r+k)-th chunk to rank r+k while
+// receiving chunk r from rank r-k — every byte crosses exactly one link
+// ((w-1)/w of the payload total; the old fallback star-allgathered
+// everything to everyone: Wx). Chunks are equal-sized byte blocks.
+int pairwise_alltoall(Comm* c, const char* in, char* out,
+                      uint64_t chunk_bytes) {
+  const int w = c->world, r = c->rank;
+  std::memcpy(out + static_cast<uint64_t>(r) * chunk_bytes,
+              in + static_cast<uint64_t>(r) * chunk_bytes, chunk_bytes);
+  for (int k = 1; k < w; ++k) {
+    int to = (r + k) % w;
+    int from = (r - k + w) % w;
+    if (duplex_exchange(c->mesh[to],
+                        in + static_cast<uint64_t>(to) * chunk_bytes,
+                        chunk_bytes, c->mesh[from],
+                        out + static_cast<uint64_t>(from) * chunk_bytes,
+                        chunk_bytes) != 0)
       return -1;
   }
   return 0;
@@ -475,12 +599,14 @@ extern "C" {
 // Bumped whenever an exported signature changes (the Python binding
 // refuses to drive a stale prebuilt .so whose symbols still resolve but
 // whose ABI differs — e.g. the op argument added to the ring kernels).
-int hvdnet_abi_version() { return 2; }
+// v3: full data mesh + true reduce-scatter / pairwise alltoall kernels.
+int hvdnet_abi_version() { return 3; }
 
 void* hvdnet_init(int rank, int world, const char* coord_host, int coord_port,
                   int timeout_ms) {
   Comm* c = new Comm();
   if (comm_init(c, rank, world, coord_host, coord_port, timeout_ms) != 0) {
+    comm_close(c);  // release any sockets a partial setup established
     delete c;
     return nullptr;
   }
@@ -496,6 +622,13 @@ void hvdnet_finalize(void* h) {
 
 int hvdnet_rank(void* h) { return static_cast<Comm*>(h)->rank; }
 int hvdnet_world(void* h) { return static_cast<Comm*>(h)->world; }
+
+// Cumulative data-plane bytes this process sent through the collective
+// kernels (ring allreduce / reduce-scatter / pairwise alltoall).
+uint64_t hvdnet_data_bytes_sent(void* h) {
+  (void)h;
+  return g_data_bytes_sent;
+}
 
 int hvdnet_barrier(void* h) { return barrier(static_cast<Comm*>(h)); }
 
@@ -557,6 +690,44 @@ int hvdnet_allreduce_i32(void* h, int32_t* data, uint64_t count, int op) {
 
 int hvdnet_allreduce_i64(void* h, int64_t* data, uint64_t count, int op) {
   return ring_allreduce_t<int64_t>(static_cast<Comm*>(h), data, count, op);
+}
+
+// Half-ring reduce-scatter: `data` (count elements, all ranks equal
+// shape) is consumed as scratch; rank r's fully-reduced chunk r lands in
+// `out` (chunk sizes follow the same near-equal split as the ring
+// allreduce). (w-1)/w of the payload crosses each link — optimal.
+int hvdnet_reducescatter_f32(void* h, float* data, uint64_t count, int op,
+                             float* out) {
+  return ring_reducescatter_t<float>(static_cast<Comm*>(h), data, count, op,
+                                     out);
+}
+
+int hvdnet_reducescatter_f64(void* h, double* data, uint64_t count, int op,
+                             double* out) {
+  return ring_reducescatter_t<double>(static_cast<Comm*>(h), data, count, op,
+                                      out);
+}
+
+int hvdnet_reducescatter_i32(void* h, int32_t* data, uint64_t count, int op,
+                             int32_t* out) {
+  return ring_reducescatter_t<int32_t>(static_cast<Comm*>(h), data, count,
+                                       op, out);
+}
+
+int hvdnet_reducescatter_i64(void* h, int64_t* data, uint64_t count, int op,
+                             int64_t* out) {
+  return ring_reducescatter_t<int64_t>(static_cast<Comm*>(h), data, count,
+                                       op, out);
+}
+
+// Pairwise all-to-all: `in` holds world equal chunks of chunk_bytes
+// (chunk j destined for rank j); `out` receives world chunks in source
+// rank order. Dtype-agnostic (pure byte movement, no reduction).
+int hvdnet_alltoall(void* h, const void* in, void* out,
+                    uint64_t chunk_bytes) {
+  return pairwise_alltoall(static_cast<Comm*>(h),
+                           static_cast<const char*>(in),
+                           static_cast<char*>(out), chunk_bytes);
 }
 
 // Allgatherv over the star: gather blobs to rank 0, then broadcast the

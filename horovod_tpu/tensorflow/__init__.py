@@ -143,35 +143,41 @@ def broadcast_object(obj, root_rank=0, name=None):
     return pickle.loads(buf.numpy().tobytes())
 
 
-# each grads-allreduce closure gets a process-stable sequence number so
-# two wrappers' wire names never collide; cross-rank consistency needs
-# wrappers constructed in the same order on every rank — the same
-# program-order assumption as auto-named ops
-_grads_fn_counter = [0]
-
-
 @_cache
 def _make_allreduce_grads_fn(name, device_dense, device_sparse,
                              compression, sparse_as_dense):
     """Closure that allreduces a gradient list (reference:
     __init__.py:195-215). Each gradient gets a STABLE wire name
-    (``<name>.<seq>.grad.<i>``) so the response cache hits and the
+    (``<name>.sig<k>.grad.<i>``) so the response cache hits and the
     runtime can fuse across steps — fresh auto-names would churn the
     cache and re-negotiate every step. The ``@_cache`` matters for the
     same reason: users re-wrap the tape every training step, and the
     cache hands every same-config wrapper the same closure (and thus the
-    same wire names). In eager mode the closure is compiled into one
-    tf.function so the per-gradient collectives overlap instead of
-    serializing (reference: __init__.py:212-215)."""
-    seq = _grads_fn_counter[0]
-    _grads_fn_counter[0] += 1
-    prefix = f"{name}.{seq}"
+    same wire names).
+
+    ``sig<k>`` distinguishes distinct gradient SIGNATURES (the
+    shapes/dtypes list) sharing one closure — without it, two
+    same-config wrappers over different models (a GAN's generator and
+    discriminator tapes) would alternate different shapes under the same
+    wire names and renegotiate every step. Signature indices are
+    assigned at trace time in first-seen order, which all ranks share
+    under the same program-order assumption as auto-named ops.
+
+    In eager mode the closure is compiled into one tf.function so the
+    per-gradient collectives overlap instead of serializing (reference:
+    __init__.py:212-215)."""
+    signature_ids = {}
 
     def allreduce_grads(grads):
         if sparse_as_dense:
             grads = [tf.convert_to_tensor(g)
                      if g is not None and isinstance(g, tf.IndexedSlices)
                      else g for g in grads]
+        # runs at trace time (shape changes retrace), so the dict stays
+        # tiny: one entry per distinct model/signature
+        sig = tuple((tuple(g.shape), str(g.dtype)) if g is not None
+                    else None for g in grads)
+        prefix = f"{name}.sig{signature_ids.setdefault(sig, len(signature_ids))}"
         return [allreduce(g, device_dense=device_dense,
                           device_sparse=device_sparse,
                           compression=compression,

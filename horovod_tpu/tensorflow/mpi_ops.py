@@ -136,6 +136,10 @@ def allgather(tensor, name=None):
     is this rank's slice of the summed gradient (reference:
     mpi_ops.py:122-145)."""
     tensor = tf.convert_to_tensor(tensor)
+    if tensor.shape.rank == 0:
+        raise ValueError(
+            "allgather requires a tensor of rank >= 1 (the concatenation "
+            "axis); reshape the scalar to [1] first")
     if size() == 1:
         return tf.identity(tensor)
     wire_name = _op_name("allgather", name)
