@@ -72,6 +72,17 @@ int recv_all(int fd, void* buf, size_t n) {
 // counts of the reduce-scatter ((w-1)/w) and pairwise alltoall ((w-1)/w)
 // instead of trusting the algorithm comment.
 uint64_t g_data_bytes_sent = 0;
+// Number of duplex_exchange invocations (ring/mesh steps) — fusion's
+// dispatch win (K tensors in one fused buffer = 1/K the ring launches)
+// is this counter's delta, a deterministic protocol metric independent
+// of box speed.
+uint64_t g_exchange_calls = 0;
+// Control-plane bytes sent over the star (negotiation gathers/bcasts +
+// cache-bit syncs) — the response cache's amortization is the per-op
+// delta of this counter: a fresh name costs a packed request+response
+// round trip, a steady name amortizes one fixed-width bit sync per
+// cycle.
+uint64_t g_ctrl_bytes_sent = 0;
 
 // Full-duplex exchange: send `sn` bytes to `sfd` while receiving `rn` bytes
 // from `rfd`, making progress on whichever direction is ready. Required for
@@ -81,6 +92,7 @@ uint64_t g_data_bytes_sent = 0;
 int duplex_exchange(int sfd, const void* send_buf, size_t sn, int rfd,
                     void* recv_buf, size_t rn) {
   g_data_bytes_sent += sn;
+  g_exchange_calls += 1;
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   while (sn > 0 || rn > 0) {
@@ -397,6 +409,7 @@ int gatherv(Comm* c, const void* in, uint64_t in_len,
     }
     return 0;
   }
+  g_ctrl_bytes_sent += in_len + 8;
   return send_frame(c->star[0], in, in_len);
 }
 
@@ -405,6 +418,7 @@ int bcast(Comm* c, std::vector<char>* data) {
   if (c->world == 1) return 0;
   if (c->rank == 0) {
     for (int r = 1; r < c->world; ++r) {
+      g_ctrl_bytes_sent += data->size() + 8;
       if (send_frame(c->star[r], data->data(), data->size()) != 0) return -1;
     }
     return 0;
@@ -430,12 +444,14 @@ int bit_and_or(Comm* c, uint64_t* words, uint64_t nwords, uint64_t* out_and,
       }
     }
     for (int r = 1; r < c->world; ++r) {
+      g_ctrl_bytes_sent += 2 * nwords * 8;
       if (send_all(c->star[r], out_and, nwords * 8) != 0 ||
           send_all(c->star[r], out_or, nwords * 8) != 0)
         return -1;
     }
     return 0;
   }
+  g_ctrl_bytes_sent += nwords * 8;
   if (send_all(c->star[0], words, nwords * 8) != 0) return -1;
   if (recv_all(c->star[0], out_and, nwords * 8) != 0) return -1;
   return recv_all(c->star[0], out_or, nwords * 8);
@@ -628,6 +644,21 @@ int hvdnet_world(void* h) { return static_cast<Comm*>(h)->world; }
 uint64_t hvdnet_data_bytes_sent(void* h) {
   (void)h;
   return g_data_bytes_sent;
+}
+
+// Cumulative ring/mesh kernel steps (duplex exchanges) — fusion's
+// dispatch-count win is this counter's delta.
+uint64_t hvdnet_exchange_calls(void* h) {
+  (void)h;
+  return g_exchange_calls;
+}
+
+// Cumulative control-plane (star) bytes this process sent — negotiation
+// gathers/bcasts and cache-bit syncs; the response cache's byte
+// amortization is this counter's per-op delta.
+uint64_t hvdnet_ctrl_bytes_sent(void* h) {
+  (void)h;
+  return g_ctrl_bytes_sent;
 }
 
 int hvdnet_barrier(void* h) { return barrier(static_cast<Comm*>(h)); }

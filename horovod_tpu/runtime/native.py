@@ -146,6 +146,10 @@ def _bind_symbols(lib) -> None:
                                     ctypes.c_void_p, ctypes.c_uint64]
     lib.hvdnet_data_bytes_sent.restype = ctypes.c_uint64
     lib.hvdnet_data_bytes_sent.argtypes = [ctypes.c_void_p]
+    lib.hvdnet_exchange_calls.restype = ctypes.c_uint64
+    lib.hvdnet_exchange_calls.argtypes = [ctypes.c_void_p]
+    lib.hvdnet_ctrl_bytes_sent.restype = ctypes.c_uint64
+    lib.hvdnet_ctrl_bytes_sent.argtypes = [ctypes.c_void_p]
     lib.hvdnet_allgatherv.restype = ctypes.c_int64
     lib.hvdnet_allgatherv.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
@@ -373,6 +377,19 @@ class NetComm:
         optimality instead of trusting comments."""
         with self._lock:
             return int(self._lib.hvdnet_data_bytes_sent(self._h))
+
+    def exchange_calls(self) -> int:
+        """Cumulative ring/mesh kernel steps — fusion's dispatch-count
+        win is this counter's delta (deterministic, box-independent)."""
+        with self._lock:
+            return int(self._lib.hvdnet_exchange_calls(self._h))
+
+    def ctrl_bytes_sent(self) -> int:
+        """Cumulative control-plane (star) bytes sent — negotiation
+        gathers/bcasts + cache-bit syncs; the response cache's byte
+        amortization is this counter's per-op delta."""
+        with self._lock:
+            return int(self._lib.hvdnet_ctrl_bytes_sent(self._h))
 
     def reducescatter(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
         """Half-ring reduce-scatter: returns this rank's fully-reduced

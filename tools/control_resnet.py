@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Independent ResNet-50 control run — NO framework code.
+
+VERDICT r2 asked for an external control on the "15% MFU is
+XLA-structural" claim: an independent ResNet-50 train step that does NOT
+go through horovod_tpu (no flax, no optax, no framework imports — every
+layer, the batch-norm, and the SGD-momentum update are hand-rolled on
+raw jax/lax), same batch/dtype/layout/protocol as bench.py. If this
+lands at ~the same img/s, the framework's data path is exonerated on
+silicon; if it lands higher, the framework has a bug to find.
+
+Protocol identical to bench.py: NHWC, bf16 compute / f32 params+stats,
+batch 128, 224x224, one compiled lax.scan of 20 steps per round, scalar
+readback per round, mean over 10 timed rounds. Prints one JSON line.
+"""
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BATCH = 128
+IMAGE = 224
+STEPS_PER_ROUND = 20
+WARMUP_ROUNDS = 1
+TIMED_ROUNDS = 10
+DTYPE = jnp.bfloat16
+
+STAGES = [3, 4, 6, 3]  # ResNet-50 bottleneck counts
+FILTERS = [64, 128, 256, 512]
+
+
+# ---------------------------------------------------------------------------
+# layers (hand-rolled)
+# ---------------------------------------------------------------------------
+
+def conv(x, w, stride=1, padding="SAME"):
+    return lax.conv_general_dilated(
+        x.astype(DTYPE), w.astype(DTYPE), (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bn_train(x, p, s):
+    """Batch norm, training mode: f32 batch stats over (N,H,W), bf16
+    apply, running-stat EMA update (momentum 0.9) — the same traffic
+    pattern as any standard BN implementation."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2))
+    var = jnp.var(xf, axis=(0, 1, 2))
+    inv = lax.rsqrt(var + 1e-5) * p["scale"]
+    y = (xf - mean) * inv + p["bias"]
+    new_s = {"mean": 0.9 * s["mean"] + 0.1 * mean,
+             "var": 0.9 * s["var"] + 0.1 * var}
+    return y.astype(DTYPE), new_s
+
+
+def max_pool(x, window=3, stride=2):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        (1, window, window, 1), (1, stride, stride, 1),
+        [(0, 0), (1, 1), (1, 1), (0, 0)])
+
+
+# ---------------------------------------------------------------------------
+# parameter construction
+# ---------------------------------------------------------------------------
+
+def _conv_p(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * np.sqrt(2.0 / fan_in))
+
+
+def _bn_p(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _bn_s(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def build_params(key):
+    keys = iter(jax.random.split(key, 200))
+    params = {"conv_init": _conv_p(next(keys), 7, 7, 3, 64),
+              "bn_init": _bn_p(64)}
+    stats = {"bn_init": _bn_s(64)}
+    cin = 64
+    for i, n_blocks in enumerate(STAGES):
+        f = FILTERS[i]
+        for j in range(n_blocks):
+            name = f"s{i}b{j}"
+            block = {
+                "conv1": _conv_p(next(keys), 1, 1, cin, f),
+                "bn1": _bn_p(f),
+                "conv2": _conv_p(next(keys), 3, 3, f, f),
+                "bn2": _bn_p(f),
+                "conv3": _conv_p(next(keys), 1, 1, f, f * 4),
+                "bn3": _bn_p(f * 4),
+            }
+            bstat = {"bn1": _bn_s(f), "bn2": _bn_s(f), "bn3": _bn_s(f * 4)}
+            if j == 0:  # projection shortcut on every first block
+                block["conv_proj"] = _conv_p(next(keys), 1, 1, cin, f * 4)
+                block["bn_proj"] = _bn_p(f * 4)
+                bstat["bn_proj"] = _bn_s(f * 4)
+            params[name] = block
+            stats[name] = bstat
+            cin = f * 4
+    params["dense_w"] = (jax.random.normal(next(keys), (2048, 1000),
+                                           jnp.float32) * 0.01)
+    params["dense_b"] = jnp.zeros((1000,), jnp.float32)
+    return params, stats
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def bottleneck(x, p, s, stride):
+    y, s1 = bn_train(conv(x, p["conv1"]), p["bn1"], s["bn1"])
+    y = jax.nn.relu(y)
+    y, s2 = bn_train(conv(y, p["conv2"], stride), p["bn2"], s["bn2"])
+    y = jax.nn.relu(y)
+    y, s3 = bn_train(conv(y, p["conv3"]), p["bn3"], s["bn3"])
+    new_s = {"bn1": s1, "bn2": s2, "bn3": s3}
+    if "conv_proj" in p:
+        res, sp = bn_train(conv(x, p["conv_proj"], stride), p["bn_proj"],
+                           s["bn_proj"])
+        new_s["bn_proj"] = sp
+    else:
+        res = x
+    return jax.nn.relu(res + y), new_s
+
+
+def forward(params, stats, images):
+    x = conv(images, params["conv_init"], 2)
+    x, s0 = bn_train(x, params["bn_init"], stats["bn_init"])
+    new_stats = {"bn_init": s0}
+    x = jax.nn.relu(x)
+    x = max_pool(x)
+    for i, n_blocks in enumerate(STAGES):
+        for j in range(n_blocks):
+            name = f"s{i}b{j}"
+            stride = 2 if (i > 0 and j == 0) else 1
+            x, ns = bottleneck(x, params[name], stats[name], stride)
+            new_stats[name] = ns
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x @ params["dense_w"] + params["dense_b"]
+    return logits, new_stats
+
+
+def loss_fn(params, stats, images, labels):
+    logits, new_stats = forward(params, stats, images)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    return loss, new_stats
+
+
+# ---------------------------------------------------------------------------
+# hand-rolled SGD momentum + the scanned round
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def train_round(params, stats, momentum, images, labels):
+    def step(carry, _):
+        params, stats, momentum = carry
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, stats, images, labels)
+        momentum = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g, momentum, grads)
+        params = jax.tree_util.tree_map(
+            lambda p, m: p - 0.01 * m, params, momentum)
+        return (params, new_stats, momentum), loss
+
+    (params, stats, momentum), losses = lax.scan(
+        step, (params, stats, momentum), None, length=STEPS_PER_ROUND)
+    return params, stats, momentum, losses[-1]
+
+
+def main():
+    print(f"devices: {jax.devices()}", file=sys.stderr, flush=True)
+    params, stats = build_params(jax.random.PRNGKey(0))
+    momentum = jax.tree_util.tree_map(jnp.zeros_like, params)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(
+        rng.uniform(-1, 1, (BATCH, IMAGE, IMAGE, 3)).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, (BATCH,)).astype(np.int32))
+
+    t0 = time.perf_counter()
+    for _ in range(WARMUP_ROUNDS):
+        params, stats, momentum, loss = train_round(
+            params, stats, momentum, images, labels)
+    jax.block_until_ready(loss)
+    print(f"warmup {time.perf_counter() - t0:.1f}s loss={float(loss):.3f}",
+          file=sys.stderr, flush=True)
+
+    rates = []
+    for r in range(TIMED_ROUNDS):
+        t0 = time.perf_counter()
+        params, stats, momentum, loss = train_round(
+            params, stats, momentum, images, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rates.append(BATCH * STEPS_PER_ROUND / dt)
+        print(f"round {r}: {rates[-1]:.1f} img/s", file=sys.stderr,
+              flush=True)
+
+    print(json.dumps({
+        "metric": "images/sec/chip (ResNet-50 CONTROL, no framework)",
+        "value": round(float(np.mean(rates)), 2),
+        "unit": "images/sec/chip",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
