@@ -53,6 +53,7 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     broadcast_async,
     broadcast_async_,
     poll,
+    sparse_allreduce_async,
     synchronize,
 )
 
@@ -68,9 +69,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     """
 
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step=1):
+                 backward_passes_per_step=1, sparse_as_dense=False):
         super(self.__class__, self).__init__(params)
         self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -129,6 +131,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _allreduce_grad_async(self, p):
         name = self._parameter_names.get(p)
+        if p.grad.is_sparse:
+            # embedding-style sparse grads: allgather exchange (BASELINE
+            # config #5) unless the user asked to densify
+            if self._sparse_as_dense:
+                p.grad = p.grad.to_dense()
+            else:
+                return sparse_allreduce_async(p.grad, average=True,
+                                              name=name)
         return allreduce_async_(p.grad, average=True, name=name,
                                 compression=self._compression)
 
@@ -172,7 +182,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 continue
             output = synchronize(handle)
             self._allreduce_delay[p] = self.backward_passes_per_step
-            if output is not p.grad:
+            if output.is_sparse:
+                # sparse result replaces the grad wholesale (no dense
+                # storage to copy into)
+                p.grad = output.to(p.grad.dtype)
+            elif output is not p.grad:
                 p.grad.data = output.to(p.grad.dtype)
         self._handles.clear()
         self._synchronized = True
@@ -215,13 +229,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
-                         backward_passes_per_step=1):
+                         backward_passes_per_step=1,
+                         sparse_as_dense=False):
     """Wrap a torch optimizer for distributed gradient averaging
-    (reference: horovod/torch/__init__.py:205-253)."""
+    (reference: horovod/torch/__init__.py:205-253). Sparse gradients
+    (``nn.Embedding(sparse=True)``) are exchanged by allgather of
+    values+indices — BASELINE config #5's embedding exchange — unless
+    ``sparse_as_dense`` densifies them first."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step)
+               backward_passes_per_step, sparse_as_dense)
 
 
 def broadcast_parameters(params, root_rank=0):

@@ -187,6 +187,60 @@ def allreduce_async_(tensor, average=True, name=None, compression=None):
     return TorchHandle(inner, tensor, post)
 
 
+class SparseHandle:
+    """Completion future for a sparse (COO) allreduce — the allgather
+    exchange of BASELINE config #5 (values+indices travel; duplicates
+    sum on coalesce, so densify(allgather(sparse)) ==
+    allreduce(densify(sparse)); reference: the TF binding's
+    IndexedSlices gather path, horovod/tensorflow/__init__.py:64-75 —
+    the reference's torch binding never grew this and densifies
+    instead)."""
+
+    __slots__ = ("_h_idx", "_h_vals", "_shape", "_average", "_done",
+                 "_result")
+
+    def __init__(self, h_idx, h_vals, shape, average):
+        self._h_idx = h_idx
+        self._h_vals = h_vals
+        self._shape = shape
+        self._average = average
+        self._done = False
+        self._result = None
+
+    def poll(self) -> bool:
+        return self._done or (self._h_idx.poll() and self._h_vals.poll())
+
+    def wait(self) -> torch.Tensor:
+        if not self._done:
+            idx = synchronize(self._h_idx)     # (nnz_total, sparse_ndim)
+            vals = synchronize(self._h_vals)   # (nnz_total, *dense_dims)
+            if self._average:
+                vals = vals / _world_size()
+            self._result = torch.sparse_coo_tensor(
+                idx.t().contiguous(), vals, self._shape).coalesce()
+            self._done = True
+        return self._result
+
+
+def sparse_allreduce_async(tensor, average=True, name=None):
+    """Async allreduce of a torch sparse COO tensor via the allgather
+    exchange: every rank's (indices, values) are gathered (ragged dim 0),
+    duplicates sum on coalesce — an exact allreduce of the represented
+    dense tensor without densifying it (BASELINE config #5's
+    allgather/sparse embedding exchange)."""
+    t = tensor.coalesce()
+    name = _op_name("sparse_allreduce", name)
+    if _world_size() == 1:
+        # average over one rank is identity, so values pass through
+        return _ReadyHandle(torch.sparse_coo_tensor(
+            t.indices(), t.values(), t.shape).coalesce())
+    h_idx = allgather_async(t.indices().t().contiguous(),
+                            name=f"{name}.indices")
+    h_vals = allgather_async(t.values().contiguous(),
+                             name=f"{name}.values")
+    return SparseHandle(h_idx, h_vals, t.shape, average)
+
+
 def allgather_async(tensor, name=None):
     """Async allgather: concatenates each worker's tensor along dim 0
     (reference: horovod/torch/mpi_ops.py:219-246). Supports ragged dim 0."""

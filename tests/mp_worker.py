@@ -803,6 +803,59 @@ def main():
         got = thvd.broadcast_object(obj, root_rank=0, name="t/obj")
         assert got == {"epoch": 7, "rank_was": 0}, got
 
+        # sparse embedding exchange (BASELINE config #5): each rank
+        # touches different rows; the allgather-based sparse allreduce
+        # must equal the dense average
+        emb = torch.nn.Embedding(10, 4, sparse=True)
+        thvd.broadcast_parameters(emb.state_dict(), root_rank=0)
+        ids = torch.tensor([rank, rank + 1, 5])  # overlap on 5
+        opt2 = thvd.DistributedOptimizer(
+            torch.optim.SGD(emb.parameters(), lr=1.0),
+            named_parameters=emb.named_parameters())
+        w_before = emb.weight.detach().clone()
+        emb(ids).sum().backward()
+        opt2.synchronize()
+        g = emb.weight.grad.coalesce().to_dense()
+        dense = torch.zeros(10, 4)
+        for r in range(world):
+            for row in (r, r + 1, 5):
+                dense[row] += 1.0
+        np.testing.assert_allclose(np.asarray(g), np.asarray(dense / world),
+                                   rtol=1e-6)
+        with opt2.skip_synchronize():
+            opt2.step()
+        # sparse SGD applies the averaged rows; all ranks identical
+        dig = thvd.allgather(emb.weight.detach().reshape(1, -1),
+                             name="t/emb")
+        for r in range(1, world):
+            assert torch.equal(dig[0], dig[r]), "embedding diverged"
+        np.testing.assert_allclose(
+            np.asarray(emb.weight.detach()),
+            np.asarray(w_before - dense / world), rtol=1e-5)
+
+    elif scenario == "lane_hazard":
+        # The user-owned-global-program interleaving hazard (VERDICT r2
+        # ask 8): rank 0 has a named op in flight while "its caller
+        # thread runs its own global program" (simulated by sleeping —
+        # the runtime only sees silence); rank 1 never announces the
+        # tensor. The lane watchdog must print its diagnostic within
+        # one stall-check period (the test asserts on our output).
+        import time as _time
+
+        # both ranks bring the runtime up (the comm is created lazily on
+        # first use) and agree on a warmup tensor first
+        hvd.allreduce(np.ones(2, np.float32), name="hazard/warm")
+        if rank == 0:
+            h = hvd.allreduce_async(np.ones(4, np.float32),
+                                    name="hazard/x")
+            _time.sleep(2.5)  # > 2 stall periods of 0.5s
+            try:
+                hvd.synchronize(h)
+            except Exception:
+                pass  # peers shut down; the hang became an error — fine
+        else:
+            _time.sleep(2.5)
+
     elif scenario == "tensorflow":
         # The TF binding end-to-end under a real multi-process world
         # (reference: test/test_tensorflow.py run under mpirun): eager

@@ -107,6 +107,21 @@ def test_torch_binding_across_processes(world):
         assert "OK rank=" in out
 
 
+def test_lane_hazard_watchdog_diagnoses_user_program_interleave():
+    """Named op in flight + silent enqueue side (the caller 'busy in its
+    own global program') must print the specific lane-hazard diagnostic
+    within one stall-check period — the hazard _lane_check cannot
+    intercept (VERDICT r2 ask 8)."""
+    procs, outs = _launch(
+        "lane_hazard", 2,
+        extra_env={"HOROVOD_STALL_CHECK_TIME_SECONDS": "0.5"},
+        timeout=120)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    assert any("interleaved in different orders across ranks" in out
+               and "hazard/x" in out for out in outs), outs
+
+
 def test_stall_triggers_global_shutdown():
     procs, outs = _launch(
         "stall_shutdown", 2,

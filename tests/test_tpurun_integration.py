@@ -61,6 +61,23 @@ def test_tpurun_torch_sink(extra_args):
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+def test_tpurun_bert_large_sparse_example():
+    """BASELINE config #5's example under the real launcher: BERT-Large
+    torch model (CI-sized layer count, full d_model/heads) with the
+    sparse embedding allgather exchange; the example itself asserts the
+    cross-rank lockstep invariant."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", "--no-jax-distributed", sys.executable,
+         os.path.join(REPO, "examples", "pytorch_bert_large_sparse.py"),
+         "--layers", "2", "--seq", "32", "--batch", "4", "--steps", "2"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "lockstep OK" in result.stdout
+
+
 def test_tpurun_ring_attention_cross_process():
     """Sequence parallelism over a process-spanning mesh: ring attention's
     ppermute crosses real process boundaries and matches dense attention."""
