@@ -132,7 +132,9 @@ def main():
         rates.append(global_batch * BATCHES_PER_ROUND / dt)
         log(f"round {r}: {rates[-1]:.1f} img/s")
 
-    imgs_per_sec = float(np.mean(rates))
+    # median, not mean: a single tunnel hiccup (reconnect mid-round) can
+    # make one round read 20x slow — a transport artifact, not the chip
+    imgs_per_sec = float(np.median(rates))
     per_chip = imgs_per_sec / n_chips
     result = {
         "metric": "images/sec/chip (ResNet-50 synthetic, bf16, "
@@ -229,7 +231,7 @@ def transformer_main(family: str):
         rates.append(global_batch * seq * BATCHES_PER_ROUND / dt)
         log(f"round {r}: {rates[-1]:.0f} tokens/s")
 
-    tokens_per_sec = float(np.mean(rates))
+    tokens_per_sec = float(np.median(rates))  # robust to tunnel hiccups
     per_chip = tokens_per_sec / n_chips
     result = {
         "metric": f"tokens/sec/chip ({label}, bf16, seq {seq}, "

@@ -18,6 +18,7 @@ from functools import partial
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
@@ -79,6 +80,36 @@ class BottleneckBlock(nn.Module):
         return self.act(residual + y)
 
 
+class SpaceToDepthConvInit(nn.Module):
+    """The 7x7/2 input conv, reparametrized exactly for the MXU: 2x2
+    space-to-depth the image to (112,112,12) and fold the 7x7 stride-2
+    kernel into a 4x4 stride-1 kernel over 12 channels with asymmetric
+    [(2,1),(2,1)] padding — identical output, 4x the contraction depth
+    per MXU pass (the classic TPU MLPerf ResNet transform; measured
+    1.43x on this layer, tools/conv0_s2d.py). The parameter KEEPS the
+    canonical (7,7,3,filters) shape — checkpoints interchange freely
+    with the direct path — and the fold is a tiny reshape per step."""
+
+    filters: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        w7 = self.param("kernel", nn.initializers.he_normal(),
+                        (7, 7, 3, self.filters), jnp.float32)
+        # fold: pad to (8,8), then w4[th,tw, 3*(2uh+uw)+c] =
+        # w7[2th+uh-1, 2tw+uw-1, c] (zeros where out of range)
+        w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w4 = w8.reshape(4, 2, 4, 2, 3, self.filters) \
+            .transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 12, self.filters)
+        n, h, w, c = x.shape
+        y = x.reshape(n, h // 2, 2, w // 2, 2, c) \
+            .transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+        return jax.lax.conv_general_dilated(
+            y.astype(self.dtype), w4.astype(self.dtype), (1, 1),
+            [(2, 1), (2, 1)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -86,6 +117,9 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     act: Callable = nn.relu
+    # exact MXU-friendly reparametrization of the input conv (above);
+    # disable to get the textbook direct 7x7/2 convolution
+    space_to_depth: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -96,8 +130,13 @@ class ResNet(nn.Module):
                        param_dtype=jnp.float32)
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="conv_init")(x)
+        if self.space_to_depth and x.shape[1] % 2 == 0 \
+                and x.shape[2] % 2 == 0 and x.shape[3] == 3:
+            x = SpaceToDepthConvInit(self.num_filters, self.dtype,
+                                     name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

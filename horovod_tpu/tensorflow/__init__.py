@@ -87,24 +87,39 @@ def allreduce(tensor, average=True, device_dense="", device_sparse="",
     return summed / horovod_size if average else summed
 
 
-@_cache
-def _make_broadcast_group_fn():
-    # one tf.function holding every per-variable broadcast so the eager
-    # executor can run them concurrently; the runtime then fuses them
-    # into negotiation cycles (reference: __init__.py:86-101)
-    def broadcast_group(variables, root_rank):
-        for var in variables:
-            var.assign(broadcast(var, root_rank))
-
-    if _executing_eagerly():
-        return _make_subgraph(broadcast_group)
-    return broadcast_group
-
-
 def broadcast_variables(variables, root_rank):
     """Broadcast variables from ``root_rank`` to all ranks — consistent
-    init / resume-from-checkpoint (reference: __init__.py:104-113)."""
-    return _make_broadcast_group_fn()(variables, root_rank)
+    init / resume-from-checkpoint (reference: __init__.py:86-113).
+
+    All broadcasts are enqueued ASYNC first and synchronized after, so
+    the runtime negotiates and fuses them in few cycles instead of one
+    round trip per variable (the reference wraps a tf.function for the
+    same concurrency; an eager enqueue burst is the equivalent here and
+    also works with Keras 3's backend Variables, which do not survive
+    tf.function argument passing)."""
+    from horovod_tpu.ops import collectives as _c
+
+    variables = list(variables)
+    if size() == 1 or not variables:
+        return
+    handles = []
+    for i, var in enumerate(variables):
+        arr = np.ascontiguousarray(var.numpy())
+        # 64-bit payloads would be silently narrowed on the x32 JAX data
+        # plane (int64 step counters wrap, float64 loses precision);
+        # bitcast to int32 pairs instead — broadcast moves bits, not
+        # numbers, so the reassembled value is exact
+        orig_dtype = arr.dtype
+        if orig_dtype in (np.int64, np.uint64, np.float64):
+            arr = arr.reshape(-1).view(np.int32)
+        handles.append((var, orig_dtype, _c.broadcast_async(
+            arr, root_rank, name=f"broadcast_variables.{i}")))
+    for var, orig_dtype, handle in handles:
+        value = np.asarray(_c.synchronize(handle))
+        if value.dtype != orig_dtype:
+            value = np.ascontiguousarray(value).reshape(-1) \
+                .view(orig_dtype)
+        var.assign(value.reshape(var.shape))
 
 
 def broadcast_global_variables(root_rank):
@@ -229,25 +244,27 @@ if _LegacyOptimizer is not None:
             return self._optimizer.variables(*args, **kwargs)
 
 
-def _make_keras_optimizer(optimizer, name, device_dense, device_sparse,
-                          compression, sparse_as_dense):
-    """Keras optimizer wrapper: apply_gradients averages the incoming
-    gradients across ranks first — the TF2-idiomatic placement of the
-    reference's compute_gradients override (reference:
-    __init__.py:245-259; Keras 3 optimizers have no compute_gradients).
+def _wrap_keras_optimizer_class(base_cls, name=None, device_dense="",
+                                device_sparse="",
+                                compression=Compression.none,
+                                sparse_as_dense=False):
+    """Dynamic ``Distributed<Base>`` Keras optimizer class:
+    apply_gradients averages the incoming gradients across ranks first —
+    the TF2-idiomatic placement of the reference's compute_gradients
+    override (reference: __init__.py:245-259; Keras 3 optimizers have no
+    compute_gradients).
 
-    The wrapper is a REAL dynamic subclass of the optimizer's own class,
-    rebuilt from its config (the reference re-parents the same way,
-    __init__.py:368-369): the result passes Keras' isinstance checks
-    (``model.compile`` accepts it) and attribute writes like
-    ``opt.learning_rate = ...`` hit the real optimizer state — a
-    delegating proxy would take the write on the proxy and silently
+    A REAL subclass of the optimizer's own class (the reference
+    re-parents the same way, __init__.py:368-369): it passes Keras'
+    isinstance checks (``model.compile`` accepts it) and attribute
+    writes like ``opt.learning_rate = ...`` hit real optimizer state —
+    a delegating proxy would take the write on the proxy and silently
     leave the inner optimizer untouched."""
     allreduce_grads = _make_allreduce_grads_fn(
-        name or f"Distributed{type(optimizer).__name__}", device_dense,
+        name or f"Distributed{base_cls.__name__}", device_dense,
         device_sparse, compression, sparse_as_dense)
 
-    class DistributedKerasOptimizer(optimizer.__class__):
+    class DistributedKerasOptimizer(base_cls):
         _hvd_allreduce_grads = staticmethod(allreduce_grads)
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
@@ -259,9 +276,33 @@ def _make_keras_optimizer(optimizer, name, device_dense, device_sparse,
             return super().apply_gradients(grads_and_vars, *args,
                                            **kwargs)
 
-    DistributedKerasOptimizer.__name__ = (
-        f"Distributed{type(optimizer).__name__}")
-    return DistributedKerasOptimizer.from_config(optimizer.get_config())
+    DistributedKerasOptimizer.__name__ = f"Distributed{base_cls.__name__}"
+    return DistributedKerasOptimizer
+
+
+def _make_keras_optimizer(optimizer, name, device_dense, device_sparse,
+                          compression, sparse_as_dense):
+    cls = _wrap_keras_optimizer_class(
+        optimizer.__class__, name, device_dense, device_sparse,
+        compression, sparse_as_dense)
+    return cls.from_config(optimizer.get_config())
+
+
+def __getattr__(attr):
+    """Resolve ``Distributed<Opt>`` classes for Keras deserialization: a
+    model saved with a wrapped optimizer records class_name
+    'DistributedSGD' (etc.) against this module, and loading rebuilds
+    the same wrapper around the stock Keras class (the reference solves
+    this with a custom_objects registry in load_model,
+    keras/__init__.py:123-157; a module __getattr__ covers every
+    optimizer without enumeration)."""
+    prefix = "Distributed"
+    if attr.startswith(prefix) and hasattr(tf.keras.optimizers,
+                                           attr[len(prefix):]):
+        return _wrap_keras_optimizer_class(
+            getattr(tf.keras.optimizers, attr[len(prefix):]))
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {attr!r}")
 
 
 def DistributedOptimizer(optimizer, name=None, use_locking=False,

@@ -1,0 +1,99 @@
+"""horovod_tpu.tensorflow.keras — tf.keras surface over the TF binding.
+
+Rebuild of the reference's TF-Keras binding (reference:
+horovod/tensorflow/keras/__init__.py:41-157 and the shared
+implementations in horovod/_keras/callbacks.py:20-185): a Keras-native
+``DistributedOptimizer``, value-level collective helpers, the canonical
+callback trio (broadcast-on-start, metric averaging, LR warmup /
+schedule), and ``load_model`` that rewraps the deserialized optimizer.
+
+All collectives ride the same enqueue runtime as
+``horovod_tpu.tensorflow`` — this module only adapts the surface to the
+tf.keras training loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as _hvd_tf
+from horovod_tpu.tensorflow import (  # noqa: F401 — re-exported lifecycle
+    Compression,
+    broadcast_variables,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.tensorflow.keras import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, name=None, device_dense="",
+                         device_sparse="", compression=Compression.none,
+                         sparse_as_dense=False):
+    """Keras optimizer whose apply_gradients averages gradients across
+    ranks first (reference: keras/__init__.py:41-67 — there via a
+    get_gradients override; Keras 3 optimizers apply, not get)."""
+    return _hvd_tf.DistributedOptimizer(
+        optimizer, name=name, device_dense=device_dense,
+        device_sparse=device_sparse, compression=compression,
+        sparse_as_dense=sparse_as_dense)
+
+
+def broadcast_global_variables(root_rank):
+    """reference: keras/__init__.py:70-77 — eager Keras has no globals
+    collection; broadcast a model/optimizer's variables explicitly."""
+    return _hvd_tf.broadcast_global_variables(root_rank)
+
+
+def allreduce(value, name=None, average=True):
+    """Average a value (tensor or numpy) over all ranks (reference:
+    keras/__init__.py:80-91)."""
+    tensor = tf.convert_to_tensor(value)
+    out = _hvd_tf.allreduce(tensor, average=average, name=name)
+    return out.numpy() if isinstance(value, (np.ndarray, float, int)) \
+        else out
+
+
+def allgather(value, name=None):
+    """reference: keras/__init__.py:94-106."""
+    tensor = tf.convert_to_tensor(value)
+    out = _hvd_tf.allgather(tensor, name=name)
+    return out.numpy() if isinstance(value, np.ndarray) else out
+
+
+def broadcast(value, root_rank, name=None):
+    """reference: keras/__init__.py:109-120."""
+    tensor = tf.convert_to_tensor(value)
+    out = _hvd_tf.broadcast(tensor, root_rank, name=name)
+    return out.numpy() if isinstance(value, np.ndarray) else out
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a Keras model saved with a wrapped optimizer: the
+    ``Distributed<Opt>`` classes are provided as custom objects for
+    every stock Keras optimizer (plus any ``custom_optimizers``), so
+    the restored model resumes distributed without re-wrapping
+    (reference: keras/__init__.py:123-157, same wrap_optimizer registry
+    idea)."""
+    from horovod_tpu.tensorflow import _wrap_keras_optimizer_class
+
+    objects = {}
+    base_classes = [getattr(tf.keras.optimizers, attr)
+                    for attr in dir(tf.keras.optimizers)]
+    base_classes = [cls for cls in base_classes
+                    if isinstance(cls, type)
+                    and issubclass(cls, tf.keras.optimizers.Optimizer)]
+    for cls in base_classes + list(custom_optimizers or []):
+        wrapped = _wrap_keras_optimizer_class(cls,
+                                              compression=compression)
+        objects[wrapped.__name__] = wrapped
+    objects.update(custom_objects or {})
+    return tf.keras.models.load_model(filepath, custom_objects=objects)

@@ -1,0 +1,161 @@
+"""tf.keras callbacks for distributed training.
+
+Rebuild of the reference's shared Keras callback implementations
+(reference: horovod/_keras/callbacks.py:20-185, surfaced via
+horovod/tensorflow/keras/callbacks.py): broadcast-on-start, cross-rank
+metric averaging, and the LR schedule/warmup pair that scales the
+learning rate with world size — the canonical distributed-Keras recipe
+(reference: docs and examples/keras_mnist_advanced.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast model + optimizer state from ``root_rank`` at the end
+    of the FIRST batch, so random inits / restored checkpoints agree
+    across ranks (reference: _keras/callbacks.py:20-43, same hook
+    point: optimizer slot variables only exist after the first
+    apply_gradients, and the batch-0 broadcast overwrites whatever that
+    one divergent step produced)."""
+
+    def __init__(self, root_rank=0, device=""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        variables = list(self.model.variables)
+        if self.model.optimizer is not None:
+            variables += list(self.model.optimizer.variables)
+        hvd.broadcast_variables(variables, self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Average epoch metrics over ranks before other callbacks (early
+    stopping, checkpointing, LR plateaus) read them (reference:
+    _keras/callbacks.py:46-85)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs:
+            for name in sorted(logs):
+                value = logs[name]
+                if isinstance(value, (int, float, np.floating, np.integer)):
+                    logs[name] = float(hvd.allreduce(
+                        tf.constant(float(value)),
+                        average=True, name=f"metric.{name}").numpy())
+
+
+class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
+    """Multiply the base LR by ``multiplier(epoch)`` within
+    [start_epoch, end_epoch) (reference: _keras/callbacks.py:87-163;
+    ``staircase`` applies per-epoch, otherwise per-batch with
+    fractional epochs)."""
+
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = None
+        self.current_epoch = 0
+        self._restore_momentum = None
+        if not callable(multiplier):
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _in_range(self, epoch):
+        return (epoch >= self.start_epoch
+                and (self.end_epoch is None or epoch < self.end_epoch))
+
+    def _set_lr(self, epoch):
+        if not self._in_range(epoch):
+            return
+        opt = self.model.optimizer
+        old_lr = float(tf.keras.backend.get_value(opt.learning_rate))
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        opt.learning_rate = new_lr
+        if self.momentum_correction and hasattr(opt, "momentum") \
+                and old_lr > 0:
+            # scale the accumulated momentum by the lr ratio for the
+            # step the new lr first applies to, then restore (Goyal et
+            # al. 2017; reference: _keras/callbacks.py:120-134)
+            self._restore_momentum = float(
+                tf.keras.backend.get_value(opt.momentum))
+            opt.momentum = self._restore_momentum * new_lr / old_lr
+
+    def _restore_momentum_if_needed(self):
+        if self._restore_momentum is not None:
+            self.model.optimizer.momentum = self._restore_momentum
+            self._restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        if self.initial_lr is None:
+            self.initial_lr = float(
+                tf.keras.backend.get_value(
+                    self.model.optimizer.learning_rate))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase:
+            self._set_lr(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase:
+            if self.steps_per_epoch is None:
+                raise ValueError(
+                    "steps_per_epoch is required for non-staircase "
+                    "schedules (the reference autodetects it from the "
+                    "TF1 params dict, which eager Keras no longer "
+                    "carries)")
+            self._set_lr(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._restore_momentum_if_needed()
+        if logs is not None:
+            logs["lr"] = float(tf.keras.backend.get_value(
+                self.model.optimizer.learning_rate))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Ramp the LR from 1x to size()x over ``warmup_epochs`` — the
+    gradual-warmup recipe for large effective batches (reference:
+    _keras/callbacks.py:166-185, after Goyal et al. 2017)."""
+
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            # epoch may be fractional (per-batch ramp)
+            return 1.0 / hvd.size() * (
+                epoch * (hvd.size() - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.warmup_epochs - 1 and self.verbose \
+                and hvd.rank() == 0:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self.initial_lr}.")

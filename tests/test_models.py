@@ -28,6 +28,26 @@ class TestResNet:
         # canonical ResNet-50 ImageNet size: ~25.5M params
         assert 25_000_000 < n_params < 26_000_000
 
+    def test_space_to_depth_conv_init_is_exact(self, hvd_flat):
+        """The MXU-friendly input-conv reparametrization must compute
+        the SAME function as the direct 7x7/2 conv on the same
+        (7,7,3,64) parameter — checkpoint-interchangeable by
+        construction (tools/conv0_s2d.py measures the 1.43x layer
+        speedup on chip)."""
+        from horovod_tpu.models.resnet import ResNet50
+
+        x = jnp.asarray(np.random.RandomState(0).uniform(
+            -1, 1, (2, 64, 64, 3)), jnp.float32)
+        s2d = ResNet50(num_classes=10, dtype=jnp.float32)
+        direct = ResNet50(num_classes=10, dtype=jnp.float32,
+                          space_to_depth=False)
+        variables = s2d.init(jax.random.PRNGKey(0), x[:1], train=False)
+        # identical param trees (same names/shapes) serve both models
+        out_a = s2d.apply(variables, x, train=False)
+        out_b = direct.apply(variables, x, train=False)
+        np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                                   rtol=1e-5, atol=1e-5)
+
 
 class TestTrainStep:
     def test_mnist_train_step_runs_and_learns(self, hvd):

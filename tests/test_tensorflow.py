@@ -196,6 +196,18 @@ def test_broadcast_variables(hvd_tf):
     np.testing.assert_allclose(v2.numpy(), [[3.0]])
 
 
+def test_broadcast_variables_64bit_exact(hvd_tf):
+    """int64 step counters >= 2^31 and float64 values must round-trip
+    EXACTLY — the x32 JAX data plane would silently narrow them, so
+    they travel as int32 bit pairs."""
+    big = 2**40 + 12345
+    v_step = tf.Variable(np.int64(big))
+    v_f64 = tf.Variable(np.float64(1.0 + 2**-40))
+    hvd_tf.broadcast_variables([v_step, v_f64], root_rank=0)
+    assert int(v_step.numpy()) == big
+    assert float(v_f64.numpy()) == 1.0 + 2**-40
+
+
 def test_broadcast_global_variables_raises_eager(hvd_tf):
     with pytest.raises(RuntimeError, match="eager execution"):
         hvd_tf.broadcast_global_variables(0)
@@ -214,6 +226,58 @@ def test_ops_inside_tf_function(hvd_tf):
     out = step(tf.constant([5.0]))  # second call reuses the trace
     np.testing.assert_allclose(out.numpy(), [5.0 * hvd_tf.size()])
     assert len(calls) == 1
+
+
+def test_keras_binding_fit_callbacks_and_reload(hvd_tf, tmp_path):
+    """The tf.keras sub-binding end-to-end (reference:
+    horovod/tensorflow/keras + _keras/callbacks.py): DistributedOptimizer
+    under model.fit, broadcast + metric-average + LR-warmup callbacks,
+    rank-0 save and rewrapping load_model."""
+    import horovod_tpu.tensorflow.keras as hvd_keras
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 4).astype(np.int64)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(16, activation="relu", input_shape=(8,)),
+        tf.keras.layers.Dense(2),
+    ])
+    opt = hvd_keras.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+    model.compile(optimizer=opt, loss=tf.keras.losses.
+                  SparseCategoricalCrossentropy(from_logits=True),
+                  metrics=["accuracy"])
+    steps = 128 // 32
+    history = model.fit(
+        x, y, batch_size=32, epochs=3, verbose=0,
+        callbacks=[
+            hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvd_keras.callbacks.MetricAverageCallback(),
+            hvd_keras.callbacks.LearningRateWarmupCallback(
+                warmup_epochs=2, steps_per_epoch=steps),
+        ])
+    assert history.history["loss"][-1] < history.history["loss"][0]
+    # warmup ramps toward the base LR by the end of epoch 2
+    assert history.history["lr"][-1] > history.history["lr"][0] / 10
+
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+    restored = hvd_keras.load_model(path)
+    assert type(restored.optimizer).__name__ == "DistributedSGD"
+    np.testing.assert_allclose(
+        model.predict(x[:4], verbose=0),
+        restored.predict(x[:4], verbose=0), rtol=1e-6)
+
+
+def test_keras_value_helpers(hvd_tf):
+    import horovod_tpu.tensorflow.keras as hvd_keras
+
+    out = hvd_keras.allreduce(np.asarray([2.0, 4.0], np.float32),
+                              average=True)
+    np.testing.assert_allclose(out, [2.0, 4.0])
+    out = hvd_keras.broadcast(np.asarray([1.0], np.float32), 0)
+    np.testing.assert_allclose(out, [1.0])
+    g = hvd_keras.allgather(np.ones((2, 2), np.float32))
+    assert g.shape == (2 * hvd_keras.size(), 2)
 
 
 def test_lifecycle_surface(hvd_tf):

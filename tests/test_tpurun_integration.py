@@ -61,6 +61,23 @@ def test_tpurun_torch_sink(extra_args):
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+def test_tpurun_tensorflow2_mnist_example():
+    """The flagship TF2 example under the real launcher at np=2: tape
+    averaging + broadcast_variables; the example asserts loss descent
+    and cross-rank lockstep itself."""
+    pytest.importorskip("tensorflow")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", "2", "--no-jax-distributed", sys.executable,
+         os.path.join(REPO, "examples", "tensorflow2_mnist.py"),
+         "--steps", "12"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "lockstep OK" in result.stdout
+
+
 def test_tpurun_bert_large_sparse_example():
     """BASELINE config #5's example under the real launcher: BERT-Large
     torch model (CI-sized layer count, full d_model/heads) with the

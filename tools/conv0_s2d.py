@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Space-to-depth transform for ResNet's input conv — worth it on v5e?
+
+The classic TPU MLPerf trick: a 7x7 stride-2 conv on (224,224,3) puts 3
+channels on a 128-lane MXU. Reparametrize EXACTLY: 2x2 space-to-depth
+the input to (112,112,12) and fold the 7x7/2 kernel into a 4x4/1 kernel
+over 12 channels with asymmetric [(2,1),(2,1)] padding — identical
+output, 4x the contraction depth per MXU pass.
+
+Derivation: o[i,j,k] = sum_{a=-3..3, c} x[2i+a, 2j+b, c] W[a+3,b+3,c,k].
+With 2i+a = 2(i+t-2)+u where a = 2(t-2)+u, u in {0,1}, t in [0,4):
+o = conv1(S2D(x), W')[i,j,k] with W'[t_h,t_w, c+3*(2*u_h+u_w), k] =
+W[2*t_h-4+u_h+3, 2*t_w-4+u_w+3, c, k] (zero where out of range).
+
+Measures both forms isolated (salted slope protocol) and checks
+numerical equality. If the win is real, the model grows a
+use_space_to_depth flag.
+"""
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BATCH = 128
+ITERS_SHORT, ITERS_LONG, ROUNDS = 50, 200, 6
+FLOPS = 2 * BATCH * 112 * 112 * 49 * 3 * 64  # identical both ways
+
+
+def s2d(x):
+    """2x2 space-to-depth, NHWC: (N,H,W,C) -> (N,H/2,W/2,4C) with the
+    channel order c + C*(2*u_h + u_w)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # n, h/2, w/2, uh, uw, c
+    return x.reshape(n, h // 2, w // 2, 4 * c)
+
+
+def fold_kernel(w7):
+    """(7,7,3,64) stride-2 kernel -> (4,4,12,64) stride-1 kernel over
+    the s2d channel order (c + 3*(2*u_h + u_w))."""
+    w4 = np.zeros((4, 4, 12, 64), w7.dtype)
+    for th in range(4):
+        for uh in range(2):
+            ah = 2 * th - 4 + uh + 3
+            if not 0 <= ah < 7:
+                continue
+            for tw in range(4):
+                for uw in range(2):
+                    aw = 2 * tw - 4 + uw + 3
+                    if not 0 <= aw < 7:
+                        continue
+                    w4[th, tw, 3 * (2 * uh + uw):3 * (2 * uh + uw) + 3] \
+                        = w7[ah, aw]
+    return w4
+
+
+def conv0_direct(x, w7):
+    return lax.conv_general_dilated(
+        x, w7, (2, 2), [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def conv0_s2d(y, w4):
+    return lax.conv_general_dilated(
+        y, w4, (1, 1), [(2, 1), (2, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@partial(jax.jit, static_argnames="iters")
+def chain_direct(x, w7, salt, iters):
+    x = x + salt.astype(x.dtype)
+
+    def body(x, _):
+        y = conv0_direct(x, w7)
+        return x + 1e-6 * jnp.mean(y).astype(x.dtype), ()
+
+    x, _ = lax.scan(body, x, None, length=iters)
+    return jnp.sum(x[0, 0, 0, :].astype(jnp.float32))
+
+
+@partial(jax.jit, static_argnames="iters")
+def chain_s2d(x, w4, salt, iters):
+    x = x + salt.astype(x.dtype)
+
+    def body(x, _):
+        y = conv0_s2d(s2d(x), w4)  # includes the s2d data movement
+        return x + 1e-6 * jnp.mean(y).astype(x.dtype), ()
+
+    x, _ = lax.scan(body, x, None, length=iters)
+    return jnp.sum(x[0, 0, 0, :].astype(jnp.float32))
+
+
+_salt = [0]
+
+
+def fresh():
+    _salt[0] += 1
+    return jnp.float32(_salt[0] * 1e-7)
+
+
+def slope(fn, *args):
+    for it in (ITERS_SHORT, ITERS_LONG):
+        float(fn(*args, fresh(), iters=it))
+    out = []
+    for _ in range(ROUNDS):
+        t0 = time.perf_counter()
+        float(fn(*args, fresh(), iters=ITERS_SHORT))
+        t1 = time.perf_counter()
+        float(fn(*args, fresh(), iters=ITERS_LONG))
+        t2 = time.perf_counter()
+        out.append(((t2 - t1) - (t1 - t0)) / (ITERS_LONG - ITERS_SHORT))
+    return float(np.median(out))
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(-1, 1, (BATCH, 224, 224, 3)),
+                    dtype=jnp.bfloat16)
+    w7 = rng.uniform(-0.1, 0.1, (7, 7, 3, 64)).astype(np.float32)
+    w4 = jnp.asarray(fold_kernel(w7), jnp.bfloat16)
+    w7 = jnp.asarray(w7, jnp.bfloat16)
+
+    # exactness check
+    a = np.asarray(conv0_direct(x, w7), np.float32)
+    b = np.asarray(conv0_s2d(s2d(x), w4), np.float32)
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+    print("numerics ok", file=sys.stderr, flush=True)
+
+    t_direct = slope(chain_direct, x, w7)
+    t_s2d = slope(chain_s2d, x, w4)
+    print(json.dumps({
+        "direct_us": round(t_direct * 1e6, 1),
+        "s2d_us": round(t_s2d * 1e6, 1),
+        "direct_mfu": round(FLOPS / t_direct / 197e12, 4),
+        "s2d_mfu": round(FLOPS / t_s2d / 197e12, 4),
+        "speedup_x": round(t_direct / t_s2d, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
