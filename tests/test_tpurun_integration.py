@@ -61,16 +61,18 @@ def test_tpurun_torch_sink(extra_args):
     assert result.returncode == 0, result.stdout + result.stderr
 
 
-def test_tpurun_tensorflow2_mnist_example():
-    """The flagship TF2 example under the real launcher at np=2: tape
-    averaging + broadcast_variables; the example asserts loss descent
-    and cross-rank lockstep itself."""
+@pytest.mark.parametrize("extra_args", [["--no-jax-distributed"], []],
+                         ids=["socket-controller", "jax-distributed"])
+def test_tpurun_tensorflow2_mnist_example(extra_args):
+    """The flagship TF2 example under the real launcher at np=2, both
+    launch modes: tape averaging + broadcast_variables; the example
+    asserts loss descent and cross-rank lockstep itself."""
     pytest.importorskip("tensorflow")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     result = subprocess.run(
         [sys.executable, os.path.join(REPO, "bin", "tpurun"),
-         "-np", "2", "--no-jax-distributed", sys.executable,
+         "-np", "2", *extra_args, sys.executable,
          os.path.join(REPO, "examples", "tensorflow2_mnist.py"),
          "--steps", "12"],
         capture_output=True, text=True, timeout=420, env=env)
