@@ -166,8 +166,11 @@ def transformer_main(family: str):
     causal = family == "gpt2"
     large = family == "bert-large"
     seq = int(os.environ.get("BENCH_BERT_SEQ", "1024" if causal else "512"))
+    # v5e sweet spots from sweeps: BERT-Base 32 (r2: 16->46.5%,
+    # 32->50.8%, 64->47.7%); BERT-Large 8 (r3: 4->47.4%, 8->56.4%,
+    # 16->53.1%, 24->48.5%, 32->OOM); GPT-2 16
     batch = int(os.environ.get(
-        "BENCH_BERT_BATCH", "16" if (causal or large) else "32"))
+        "BENCH_BERT_BATCH", "8" if large else "16" if causal else "32"))
     vocab = 50257 if causal else 30522
     global_batch = batch * n_chips
     label = ("GPT-2-small causal LM" if causal
