@@ -68,7 +68,7 @@ def main():
             # after the first step (variables now exist), align every
             # rank to rank 0 (reference: tensorflow2_mnist.py step hook)
             hvd.broadcast_variables(
-                model.variables + list(opt.variables), root_rank=0)
+                model.variables + hvd.optimizer_variables(opt), root_rank=0)
             first_loss = float(loss)
         last_loss = float(loss)
         if step % 10 == 0 and hvd.rank() == 0:

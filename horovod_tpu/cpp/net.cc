@@ -22,10 +22,13 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <sys/resource.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -67,32 +70,98 @@ int recv_all(int fd, void* buf, size_t n) {
   return 0;
 }
 
-// Data-plane bytes sent by this process through duplex_exchange (the
-// ring/mesh collective kernels). Lets tests assert the optimal byte
-// counts of the reduce-scatter ((w-1)/w) and pairwise alltoall ((w-1)/w)
-// instead of trusting the algorithm comment.
-uint64_t g_data_bytes_sent = 0;
-// Number of duplex_exchange invocations (ring/mesh steps) — fusion's
-// dispatch win (K tensors in one fused buffer = 1/K the ring launches)
-// is this counter's delta, a deterministic protocol metric independent
-// of box speed.
-uint64_t g_exchange_calls = 0;
-// Control-plane bytes sent over the star (negotiation gathers/bcasts +
-// cache-bit syncs) — the response cache's amortization is the per-op
-// delta of this counter: a fresh name costs a packed request+response
-// round trip, a steady name amortizes one fixed-width bit sync per
-// cycle.
-uint64_t g_ctrl_bytes_sent = 0;
+// Monotonic wall clock in milliseconds (deadline arithmetic for the
+// accept loops; CLOCK_MONOTONIC so a wall-clock step can't extend or
+// collapse a timeout budget).
+int64_t mono_ms() {
+  timespec ts;
+  ::clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+// recv_all bounded by an absolute monotonic deadline — for the
+// handshake reads right after an accept: a peer whose connect completed
+// but who died (SIGKILL, host partition) before sending its hello emits
+// no RST, and an unbounded recv would hang init forever even with the
+// accept itself bounded.
+int recv_all_deadline(int fd, void* buf, size_t n, int64_t deadline_ms) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    int64_t remain = deadline_ms - mono_ms();
+    if (remain <= 0) return -1;
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(std::min<int64_t>(remain, 1000)));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pr == 0) continue;  // re-check the deadline
+    ssize_t k = ::recv(fd, p, n, MSG_DONTWAIT);
+    if (k == 0) return -1;  // peer closed
+    if (k < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return -1;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return 0;
+}
+
+// accept(2) bounded by an absolute monotonic deadline: poll the listen fd
+// for readability with the remaining budget before accepting, so a peer
+// that dies between rendezvous and dial fails this rank's init with an
+// error instead of hanging it forever (blocking ::accept has no timeout;
+// tcp_connect_retry bounds only the outbound dials).
+int accept_deadline(int listen_fd, int64_t deadline_ms) {
+  for (;;) {
+    int64_t remain = deadline_ms - mono_ms();
+    if (remain <= 0) return -1;
+    pollfd pfd{listen_fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(std::min<int64_t>(remain, 1000)));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pr == 0) continue;  // re-check the deadline
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+    return fd;
+  }
+}
+
+// Per-communicator protocol counters (deterministic metrics independent of
+// box speed). Atomic so concurrent use of one handle from several threads
+// counts correctly; per-handle so two Comm instances in one process don't
+// conflate (advisor r3). Defined outside Comm because duplex_exchange is
+// layered below the communicator.
+struct ProtoCounters {
+  // Data-plane bytes sent through duplex_exchange (the ring/mesh
+  // collective kernels). Lets tests assert the optimal byte counts of the
+  // reduce-scatter ((w-1)/w) and pairwise alltoall ((w-1)/w) instead of
+  // trusting the algorithm comment.
+  std::atomic<uint64_t> data_bytes_sent{0};
+  // Number of duplex_exchange invocations (ring/mesh steps) — fusion's
+  // dispatch win (K tensors in one fused buffer = 1/K the ring launches)
+  // is this counter's delta.
+  std::atomic<uint64_t> exchange_calls{0};
+  // Control-plane bytes sent over the star (negotiation gathers/bcasts +
+  // cache-bit syncs) — the response cache's amortization is the per-op
+  // delta of this counter: a fresh name costs a packed request+response
+  // round trip, a steady name amortizes one fixed-width bit sync per
+  // cycle.
+  std::atomic<uint64_t> ctrl_bytes_sent{0};
+};
 
 // Full-duplex exchange: send `sn` bytes to `sfd` while receiving `rn` bytes
 // from `rfd`, making progress on whichever direction is ready. Required for
 // the ring steps: every rank sends and receives a chunk simultaneously, so a
 // blocking send of a chunk larger than the kernel socket buffers would
 // deadlock the whole ring (all ranks stuck in send, nobody draining).
-int duplex_exchange(int sfd, const void* send_buf, size_t sn, int rfd,
-                    void* recv_buf, size_t rn) {
-  g_data_bytes_sent += sn;
-  g_exchange_calls += 1;
+int duplex_exchange(ProtoCounters* ctr, int sfd, const void* send_buf,
+                    size_t sn, int rfd, void* recv_buf, size_t rn) {
+  ctr->data_bytes_sent += sn;
+  ctr->exchange_calls += 1;
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   while (sn > 0 || rn > 0) {
@@ -152,7 +221,12 @@ int64_t recv_frame(int fd, std::vector<char>& out) {
 }
 
 int tcp_listen(int* port_inout) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  // SOCK_NONBLOCK: accept_deadline's poll-then-accept would otherwise
+  // race — a connection aborted (RST) between poll() reporting POLLIN
+  // and ::accept running is removed from the queue and a blocking
+  // accept parks forever, the exact hang the deadline exists to
+  // prevent. Accepted fds do NOT inherit the flag on Linux.
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
   int one = 1;
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -216,6 +290,7 @@ struct Comm {
   // ring aliases into mesh (not separately owned)
   int ring_next = -1;
   int ring_prev = -1;
+  ProtoCounters counters;
   std::string error;
 };
 
@@ -252,20 +327,31 @@ int mesh_build(Comm* c, int listen_fd, const std::vector<RingAddr>& addrs,
     }
     c->mesh[s] = fd;
   }
-  for (int n = 0; n < w - 1 - r; ++n) {
-    int fd = ::accept(listen_fd, nullptr, nullptr);
+  // The accept phase gets its own timeout_ms budget (the dials above each
+  // had theirs): a higher-ranked peer that died after rendezvous would
+  // otherwise park this rank in a blocking accept forever.
+  const int64_t deadline = mono_ms() + timeout_ms;
+  for (int got = 0; got < w - 1 - r;) {
+    int fd = accept_deadline(listen_fd, deadline);
     if (fd < 0) return -1;
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // A stray dialer (port scanner, LB health check) must not kill the
+    // job: its handshake gets a short budget — not the loop's whole
+    // remaining deadline — and on any mismatch the fd is dropped and
+    // the loop keeps accepting real peers.
+    const int64_t conn_deadline = std::min(deadline, mono_ms() + 5000);
     uint32_t magic = 0;
     int32_t peer = -1;
-    if (recv_all(fd, &magic, sizeof(magic)) != 0 || magic != KMESH ||
-        recv_all(fd, &peer, sizeof(peer)) != 0 || peer <= r || peer >= w ||
-        c->mesh[peer] != -1) {
+    if (recv_all_deadline(fd, &magic, sizeof(magic), conn_deadline) != 0 ||
+        magic != KMESH ||
+        recv_all_deadline(fd, &peer, sizeof(peer), conn_deadline) != 0 ||
+        peer <= r || peer >= w || c->mesh[peer] != -1) {
       ::close(fd);
-      return -1;
+      continue;
     }
     c->mesh[peer] = fd;
+    ++got;
   }
   c->ring_next = c->mesh[(r + 1) % w];
   c->ring_prev = c->mesh[(r - 1 + w) % w];
@@ -282,6 +368,35 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
   }
   c->star.assign(world, -1);
   if (world == 1) return 0;
+
+  // Fail fast on fd exhaustion: every process holds world-1 mesh sockets
+  // plus its star link and listeners, and the COORDINATOR additionally
+  // holds world-1 star sockets (~2x world total there), plus whatever
+  // Python has open. At large worlds a default `ulimit -n` of 1024 dies
+  // mid-rendezvous with a confusing EMFILE; check up front (and try the
+  // soft->hard raise first) so the error is actionable. Sized for the
+  // coordinator's worst case on every rank — uniform, and a rank's
+  // margin is harmless.
+  {
+    rlimit rl{};
+    const rlim_t need = 2 * static_cast<rlim_t>(world) + 64;
+    if (::getrlimit(RLIMIT_NOFILE, &rl) == 0 && rl.rlim_cur < need) {
+      rlimit want = rl;
+      want.rlim_cur = std::min<rlim_t>(std::max<rlim_t>(need, rl.rlim_cur),
+                                       rl.rlim_max);
+      if (want.rlim_cur > rl.rlim_cur) ::setrlimit(RLIMIT_NOFILE, &want);
+      if (::getrlimit(RLIMIT_NOFILE, &rl) != 0 || rl.rlim_cur < need) {
+        c->error = "open-file limit too low for the data mesh: world " +
+                   std::to_string(world) + " needs ~" + std::to_string(need) +
+                   " fds per process (world-1 mesh sockets + star link(s) —"
+                   " the coordinator holds world-1 of those — + listeners +"
+                   " margin) but RLIMIT_NOFILE is " +
+                   std::to_string(rl.rlim_cur) +
+                   "; raise it (`ulimit -n` / LimitNOFILE) before launch";
+        return -1;
+      }
+    }
+  }
 
   // --- star setup + rendezvous of ring listen ports ---
   int ring_listen_port = 0;
@@ -304,31 +419,48 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
     std::snprintf(ring_addrs[0].ip, sizeof(ring_addrs[0].ip), "%s",
                   coord_host);
     ring_addrs[0].port = ring_listen_port;
-    for (int i = 1; i < world; ++i) {
+    const int64_t hello_deadline = mono_ms() + timeout_ms;
+    for (int got = 1; got < world;) {
+      int fd = accept_deadline(lfd, hello_deadline);
+      if (fd < 0) {
+        c->error = "accept failed (worker hello timeout after " +
+                   std::to_string(timeout_ms) + "ms: " +
+                   std::to_string(world - got) + " of " +
+                   std::to_string(world - 1) + " workers never dialed)";
+        return -1;
+      }
       sockaddr_in peer_addr{};
       socklen_t peer_len = sizeof(peer_addr);
-      int fd = ::accept(lfd, reinterpret_cast<sockaddr*>(&peer_addr),
-                        &peer_len);
-      if (fd < 0) {
-        c->error = "accept failed";
-        return -1;
+      if (::getpeername(fd, reinterpret_cast<sockaddr*>(&peer_addr),
+                        &peer_len) != 0) {
+        ::close(fd);
+        continue;
       }
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // stray dialers get a short handshake budget and are skipped, not
+      // fatal (see mesh_build) — a rendezvous port is reachable by
+      // anything on the network
+      const int64_t conn_deadline =
+          std::min(hello_deadline, mono_ms() + 5000);
       uint32_t magic = 0;
       int32_t peer_rank = -1, peer_ring_port = 0;
-      if (recv_all(fd, &magic, sizeof(magic)) != 0 || magic != KHELLO ||
-          recv_all(fd, &peer_rank, sizeof(peer_rank)) != 0 ||
-          recv_all(fd, &peer_ring_port, sizeof(peer_ring_port)) != 0 ||
-          peer_rank <= 0 || peer_rank >= world) {
-        c->error = "bad hello";
+      if (recv_all_deadline(fd, &magic, sizeof(magic),
+                            conn_deadline) != 0 || magic != KHELLO ||
+          recv_all_deadline(fd, &peer_rank, sizeof(peer_rank),
+                            conn_deadline) != 0 ||
+          recv_all_deadline(fd, &peer_ring_port, sizeof(peer_ring_port),
+                            conn_deadline) != 0 ||
+          peer_rank <= 0 || peer_rank >= world ||
+          c->star[peer_rank] != -1) {
         ::close(fd);
-        return -1;
+        continue;
       }
       c->star[peer_rank] = fd;
       ::inet_ntop(AF_INET, &peer_addr.sin_addr, ring_addrs[peer_rank].ip,
                   sizeof(ring_addrs[peer_rank].ip));
       ring_addrs[peer_rank].port = peer_ring_port;
+      ++got;
     }
     ::close(lfd);
     // broadcast the mesh address book
@@ -409,7 +541,7 @@ int gatherv(Comm* c, const void* in, uint64_t in_len,
     }
     return 0;
   }
-  g_ctrl_bytes_sent += in_len + 8;
+  c->counters.ctrl_bytes_sent += in_len + 8;
   return send_frame(c->star[0], in, in_len);
 }
 
@@ -418,7 +550,7 @@ int bcast(Comm* c, std::vector<char>* data) {
   if (c->world == 1) return 0;
   if (c->rank == 0) {
     for (int r = 1; r < c->world; ++r) {
-      g_ctrl_bytes_sent += data->size() + 8;
+      c->counters.ctrl_bytes_sent += data->size() + 8;
       if (send_frame(c->star[r], data->data(), data->size()) != 0) return -1;
     }
     return 0;
@@ -444,14 +576,14 @@ int bit_and_or(Comm* c, uint64_t* words, uint64_t nwords, uint64_t* out_and,
       }
     }
     for (int r = 1; r < c->world; ++r) {
-      g_ctrl_bytes_sent += 2 * nwords * 8;
+      c->counters.ctrl_bytes_sent += 2 * nwords * 8;
       if (send_all(c->star[r], out_and, nwords * 8) != 0 ||
           send_all(c->star[r], out_or, nwords * 8) != 0)
         return -1;
     }
     return 0;
   }
-  g_ctrl_bytes_sent += nwords * 8;
+  c->counters.ctrl_bytes_sent += nwords * 8;
   if (send_all(c->star[0], words, nwords * 8) != 0) return -1;
   if (recv_all(c->star[0], out_and, nwords * 8) != 0) return -1;
   return recv_all(c->star[0], out_or, nwords * 8);
@@ -522,7 +654,7 @@ int ring_allreduce_t(Comm* c, T* data, uint64_t count, int op) {
     int recv_chunk = (c->rank - step - 1 + w) % w;
     uint64_t send_n = begin[send_chunk + 1] - begin[send_chunk];
     uint64_t recv_n = begin[recv_chunk + 1] - begin[recv_chunk];
-    if (duplex_exchange(c->ring_next, data + begin[send_chunk],
+    if (duplex_exchange(&c->counters, c->ring_next, data + begin[send_chunk],
                         send_n * sizeof(T), c->ring_prev, recv_buf.data(),
                         recv_n * sizeof(T)) != 0)
       return -1;
@@ -534,7 +666,7 @@ int ring_allreduce_t(Comm* c, T* data, uint64_t count, int op) {
     int recv_chunk = (c->rank - step + w) % w;
     uint64_t send_n = begin[send_chunk + 1] - begin[send_chunk];
     uint64_t recv_n = begin[recv_chunk + 1] - begin[recv_chunk];
-    if (duplex_exchange(c->ring_next, data + begin[send_chunk],
+    if (duplex_exchange(&c->counters, c->ring_next, data + begin[send_chunk],
                         send_n * sizeof(T), c->ring_prev,
                         data + begin[recv_chunk], recv_n * sizeof(T)) != 0)
       return -1;
@@ -571,7 +703,7 @@ int ring_reducescatter_t(Comm* c, T* data, uint64_t count, int op, T* out) {
     uint64_t sn = chunk_begin(count, w, send_chunk + 1) - sb;
     uint64_t rb = chunk_begin(count, w, recv_chunk);
     uint64_t rn = chunk_begin(count, w, recv_chunk + 1) - rb;
-    if (duplex_exchange(c->ring_next, data + sb, sn * sizeof(T),
+    if (duplex_exchange(&c->counters, c->ring_next, data + sb, sn * sizeof(T),
                         c->ring_prev, recv_buf.data(),
                         rn * sizeof(T)) != 0)
       return -1;
@@ -594,7 +726,7 @@ int pairwise_alltoall(Comm* c, const char* in, char* out,
   for (int k = 1; k < w; ++k) {
     int to = (r + k) % w;
     int from = (r - k + w) % w;
-    if (duplex_exchange(c->mesh[to],
+    if (duplex_exchange(&c->counters, c->mesh[to],
                         in + static_cast<uint64_t>(to) * chunk_bytes,
                         chunk_bytes, c->mesh[from],
                         out + static_cast<uint64_t>(from) * chunk_bytes,
@@ -642,23 +774,20 @@ int hvdnet_world(void* h) { return static_cast<Comm*>(h)->world; }
 // Cumulative data-plane bytes this process sent through the collective
 // kernels (ring allreduce / reduce-scatter / pairwise alltoall).
 uint64_t hvdnet_data_bytes_sent(void* h) {
-  (void)h;
-  return g_data_bytes_sent;
+  return static_cast<Comm*>(h)->counters.data_bytes_sent.load();
 }
 
 // Cumulative ring/mesh kernel steps (duplex exchanges) — fusion's
 // dispatch-count win is this counter's delta.
 uint64_t hvdnet_exchange_calls(void* h) {
-  (void)h;
-  return g_exchange_calls;
+  return static_cast<Comm*>(h)->counters.exchange_calls.load();
 }
 
 // Cumulative control-plane (star) bytes this process sent — negotiation
 // gathers/bcasts and cache-bit syncs; the response cache's byte
 // amortization is this counter's per-op delta.
 uint64_t hvdnet_ctrl_bytes_sent(void* h) {
-  (void)h;
-  return g_ctrl_bytes_sent;
+  return static_cast<Comm*>(h)->counters.ctrl_bytes_sent.load();
 }
 
 int hvdnet_barrier(void* h) { return barrier(static_cast<Comm*>(h)); }

@@ -47,21 +47,19 @@ class DiscoveryResult:
 
 
 def _client_for(addresses: List[Tuple[str, int]], key: bytes,
-                probe_timeout: float = 3.0,
-                call_timeout: Optional[float] = None) -> ServiceClient:
+                probe_timeout: float = 3.0) -> ServiceClient:
     """Client bound to the first address that answers an authenticated
     ping (a task registers ALL its candidate addresses; the driver may
     only be able to route to some of them). Each candidate dial is bounded
-    by ``probe_timeout``; the returned client uses ``call_timeout``
-    (default: ``probe_timeout``) — callers whose next request makes the
-    task dial further peers must size it to cover those serial dials."""
+    by ``probe_timeout``; the VERIFIED client is returned — callers whose
+    next request makes the task dial further peers pass a longer
+    per-call ``timeout=`` to ``ServiceClient.call`` instead of getting a
+    second, unverified client."""
     last_exc: Optional[Exception] = None
     for addr in addresses:
         client = ServiceClient(tuple(addr), key, timeout=probe_timeout)
         try:
             client.call(ProbeAddressesRequest([]))
-            if call_timeout is not None and call_timeout != probe_timeout:
-                return ServiceClient(tuple(addr), key, timeout=call_timeout)
             return client
         except Exception as exc:  # noqa: BLE001 — try the next candidate
             last_exc = exc
@@ -122,11 +120,11 @@ def _ring_probe(task_addresses: Dict[int, List[Tuple[str, int]]],
         # probe_timeout, so the driver's wait on this one request must
         # cover ALL those dials, not a single one
         call_timeout = probe_timeout * max(1, len(task_addresses[succ])) + 5.0
-        client = _client_for(task_addresses[index], key, probe_timeout,
-                             call_timeout=call_timeout)
+        client = _client_for(task_addresses[index], key, probe_timeout)
         reachable = client.call(
             ProbeAddressesRequest(task_addresses[succ],
-                                  dial_timeout=probe_timeout))
+                                  dial_timeout=probe_timeout),
+            timeout=call_timeout)
         return [tuple(a) for a in reachable]
 
     host_routable: Dict[int, List[Tuple[str, int]]] = {}

@@ -323,8 +323,15 @@ class ServiceClient:
         self._wire = util.Wire(key)
         self._timeout = timeout
 
-    def call(self, req):
-        with socket.create_connection(self._addr, timeout=self._timeout) as s:
+    def call(self, req, timeout: float = None):
+        """One request/response round trip. ``timeout`` overrides the
+        client default for this call only — a probe-verified client can
+        issue a longer follow-up request (e.g. one that makes the task
+        dial further peers) without constructing a second, unverified
+        client (advisor r3)."""
+        if timeout is None:
+            timeout = self._timeout
+        with socket.create_connection(self._addr, timeout=timeout) as s:
             rfile = s.makefile("rb")
             wfile = s.makefile("wb")
             self._wire.write(req, wfile)

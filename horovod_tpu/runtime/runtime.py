@@ -51,6 +51,12 @@ class RuntimeHandle:
         self._event.set()
 
     def poll(self) -> bool:
+        # a poll loop is as much "waiting on the lane" as a parked wait()
+        # — stamp the runtime so the lane-hazard watchdog doesn't read a
+        # busy-polling caller as a silent one (advisor r3)
+        rt = self._runtime
+        if rt is not None:
+            rt._last_poll_time = time.monotonic()
         return self._event.is_set()
 
     def wait(self, timeout: Optional[float] = None):
@@ -197,6 +203,7 @@ class Runtime:
         self._last_enqueue_time = time.monotonic()
         self._lane_last_warn = 0.0
         self._waiters = 0  # callers parked in RuntimeHandle.wait()
+        self._last_poll_time = 0.0  # callers spinning on RuntimeHandle.poll()
         self._stop = threading.Event()
         self._woken = threading.Event()
         self._thread = threading.Thread(
@@ -338,10 +345,12 @@ class Runtime:
             return
         now = time.monotonic()
         with self._inflight_lock:
-            if not self._inflight_names or self._waiters > 0:
-                # a caller parked in synchronize() is waiting on the
-                # lane, not racing it — a slow peer there is the stall
-                # inspector's diagnosis, not a lane hazard
+            if (not self._inflight_names or self._waiters > 0
+                    or now - self._last_poll_time < ins.warning_time):
+                # a caller parked in synchronize() — or spinning on the
+                # public poll() API — is waiting on the lane, not racing
+                # it; a slow peer there is the stall inspector's
+                # diagnosis, not a lane hazard
                 return
             oldest = min(self._inflight_names.values())
             quiet = now - self._last_enqueue_time

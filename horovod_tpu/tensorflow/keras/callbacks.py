@@ -16,6 +16,7 @@ import tensorflow as tf
 import horovod_tpu.tensorflow as hvd
 
 
+
 class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
     """Broadcast model + optimizer state from ``root_rank`` at the end
     of the FIRST batch, so random inits / restored checkpoints agree
@@ -34,7 +35,7 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
             return
         variables = list(self.model.variables)
         if self.model.optimizer is not None:
-            variables += list(self.model.optimizer.variables)
+            variables += hvd.optimizer_variables(self.model.optimizer)
         hvd.broadcast_variables(variables, self.root_rank)
         self.broadcast_done = True
 

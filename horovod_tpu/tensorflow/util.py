@@ -25,6 +25,15 @@ def _make_subgraph(fn):
     return tf.function(fn)
 
 
+def optimizer_variables(optimizer) -> list:
+    """Optimizer state variables across Keras generations: Keras 3
+    exposes ``optimizer.variables`` as a property (a list), Keras 2
+    (TF<=2.15) as a bound method — calling ``list(...)`` on the latter
+    raises ``TypeError: 'method' object is not iterable``."""
+    v = optimizer.variables
+    return list(v() if callable(v) else v)
+
+
 def _cache(fn):
     """Memoize on hashable positional args (the reference caches its
     closure factories the same way so tf.function tracing happens once

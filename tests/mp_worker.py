@@ -946,6 +946,52 @@ def main():
         got = tfhvd.broadcast_object(obj, root_rank=0, name="tf/obj")
         assert got == {"epoch": 7, "rank_was": 0}, got
 
+    elif scenario == "tensorflow_graph":
+        # TF1 graph-mode path across a real multi-process world
+        # (reference: horovod/tensorflow/__init__.py:125-192 —
+        # broadcast_global_variables + BroadcastGlobalVariablesHook under
+        # MonitoredTrainingSession): per-rank divergent initializers must
+        # converge to rank 0's values through the session-run broadcast.
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as tfhvd
+
+        g = tf.Graph()
+        with g.as_default():
+            assert not tf.executing_eagerly()
+            v1 = tf.compat.v1.get_variable(
+                "v1", initializer=np.full((3, 2), float(rank + 1),
+                                          np.float32))
+            v2 = tf.compat.v1.get_variable(
+                "v2", initializer=np.asarray([10.0 * (rank + 1)],
+                                             np.float32))
+            # int64 variable: exercises the 64-bit bit-pair path through
+            # the graph bridge
+            step = tf.compat.v1.get_variable(
+                "global_step", initializer=np.int64(1000 + rank),
+                dtype=tf.int64)
+            hook = tfhvd.BroadcastGlobalVariablesHook(root_rank=0)
+            with tf.compat.v1.train.MonitoredTrainingSession(
+                    hooks=[hook]) as sess:
+                got1, got2, gots = sess.run([v1, v2, step])
+            np.testing.assert_allclose(got1, np.full((3, 2), 1.0))
+            np.testing.assert_allclose(got2, [10.0])
+            assert gots == 1000, gots
+
+        # direct graph op (no hook): explicit broadcast_variables from a
+        # NON-zero root inside a plain compat.v1 Session
+        g2 = tf.Graph()
+        with g2.as_default():
+            w = tf.compat.v1.get_variable(
+                "w", initializer=np.arange(4, dtype=np.float32) + rank)
+            op = tfhvd.broadcast_variables([w], root_rank=1)
+            with tf.compat.v1.Session() as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                sess.run(op)
+                got = sess.run(w)
+            np.testing.assert_allclose(got,
+                                       np.arange(4, dtype=np.float32) + 1)
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

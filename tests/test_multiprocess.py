@@ -97,6 +97,19 @@ def test_tensorflow_binding_across_processes(world):
         assert "OK rank=" in out
 
 
+@pytest.mark.parametrize("world", [2])
+def test_tensorflow_graph_mode_across_processes(world):
+    """TF1 graph-mode surface under a real multi-process world:
+    BroadcastGlobalVariablesHook under MonitoredTrainingSession and the
+    broadcast_variables graph op (reference:
+    horovod/tensorflow/__init__.py:125-192)."""
+    pytest.importorskip("tensorflow")
+    procs, outs = _launch("tensorflow_graph", world, timeout=300)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK rank=" in out
+
+
 @pytest.mark.parametrize("world", [2, 3])
 def test_torch_binding_across_processes(world):
     """Torch DistributedOptimizer + broadcasts under a real multi-process

@@ -430,12 +430,11 @@ def test_ring_probe_runs_concurrently(monkeypatch):
         def __init__(self, addrs):
             self.addrs = addrs
 
-        def call(self, request):
+        def call(self, request, timeout=None):
             time.sleep(dial_delay)  # the task->successor probe
             return request.addresses
 
-    def fake_client_for(addresses, key, probe_timeout=3.0,
-                        call_timeout=None):
+    def fake_client_for(addresses, key, probe_timeout=3.0):
         time.sleep(dial_delay)  # the driver->task dial
         return FakeClient(addresses)
 
