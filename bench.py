@@ -66,8 +66,10 @@ BATCHES_PER_ROUND = int(os.environ.get("BENCH_BATCHES_PER_ROUND", "60"))
 # spots per model.
 CNN_CONFIGS = {
     "resnet50": ("ResNet-50", 224, 128, 8.234e9),
-    "inception": ("Inception-V3", 299, 64, 11.137e9),
-    "vgg": ("VGG-16", 224, 64, 30.342e9),
+    # r4 sweeps: Inception 16/32/48/64 -> 32 best; VGG 32/64/128/192/256
+    # -> 1021/1084/1432/1310/1455 img/s, 256 best (128 within 2%)
+    "inception": ("Inception-V3", 299, 32, 11.137e9),
+    "vgg": ("VGG-16", 224, 256, 30.342e9),
 }
 
 # bf16 peak by device kind (jax.devices()[0].device_kind prefix match) —
@@ -202,7 +204,9 @@ def transformer_main(family: str, allow_env: bool = True):
 
     from horovod_tpu.models.transformer import (BertBase, BertLarge,
                                                 GPT2Small, causal_lm_loss,
-                                                masked_lm_loss)
+                                                masked_lm_loss,
+                                                masked_lm_loss_gathered,
+                                                sample_masked_positions)
 
     hvd.init()
     n_chips = hvd.size()
@@ -222,14 +226,31 @@ def transformer_main(family: str, allow_env: bool = True):
     label = ("GPT-2-small causal LM" if causal
              else "BERT-Large MLM" if large else "BERT-Base MLM")
 
+    # MLM benches default to the gather-before-projection path (r4): the
+    # vocab matrix projects only the masked positions (the standard BERT
+    # max_predictions_per_seq data layout), so the (batch, seq, vocab)
+    # f32 logits tensor never exists. BENCH_MLM_GATHER=0 restores the
+    # full-logits r1-r3 protocol for A/B.
+    gather = (not causal) and (
+        os.environ.get("BENCH_MLM_GATHER", "1") == "1" if allow_env
+        else True)
+    # BENCH_ADAM_MU_BF16=1: adamw first moment in bf16 (optimizer-state
+    # HBM traffic counter-move; A/B knob, default off)
+    mu_bf16 = allow_env and os.environ.get("BENCH_ADAM_MU_BF16") == "1"
+
     cls = GPT2Small if causal else BertLarge if large else BertBase
     model = cls(vocab_size=vocab, max_seq=seq, dtype=jnp.bfloat16)
     rng = np.random.RandomState(0)
     tokens = rng.randint(0, vocab, (global_batch, seq)).astype(np.int32)
     mask = (rng.rand(global_batch, seq) < 0.15).astype(np.int32)
+    n_pred = max(1, round(0.15 * seq))  # 76 at seq 512 (BERT's layout)
+    positions = sample_masked_positions(
+        np.random.default_rng(0), global_batch, seq, n_pred)
+    labels = np.take_along_axis(tokens, positions, axis=1)
 
     params = model.init(jax.random.PRNGKey(0), tokens[:1], train=False)
-    opt = hvd.DistributedOptimizer(_optax.adamw(1e-4))
+    opt = hvd.DistributedOptimizer(_optax.adamw(
+        1e-4, mu_dtype=jnp.bfloat16 if mu_bf16 else None))
     opt_state = opt.init(params)
 
     n_params = sum(int(np.prod(p.shape))
@@ -240,21 +261,34 @@ def transformer_main(family: str, allow_env: bool = True):
     # math; at this seq/block config the kernel executes full masked
     # blocks, i.e. hardware FLOPs are higher, which only makes the
     # reported MFU conservative about the hardware's utilization).
+    # Gathered MLM: the tied vocab matmul runs at n_pred of seq
+    # positions, so its 6*|E| term scales by n_pred/seq — counting the
+    # full 6*|E| against the faster step would inflate MFU with FLOPs
+    # the model no longer executes. (The input lookup and pos_embed are
+    # gathers either way; their overcount — <1% — is shared by every
+    # published 6N number.)
     l_layers, d_model = (24, 1024) if large else (12, 768)
     attn = 12 * l_layers * seq * d_model
-    flops_per_token = 6 * n_params + (attn // 2 if causal else attn)
+    n_eff = n_params
+    if gather:
+        n_embed = vocab * d_model
+        n_eff = n_params - n_embed + n_embed * n_pred // seq
+    flops_per_token = 6 * n_eff + (attn // 2 if causal else attn)
 
-    def loss_fn(p, toks, msk):
-        logits = model.apply(p, toks, train=True)
+    def loss_fn(p, toks, msk, pos, lab):
         if causal:
-            return causal_lm_loss(logits, toks)
-        return masked_lm_loss(logits, toks, msk)
+            return causal_lm_loss(model.apply(p, toks, train=True), toks)
+        if gather:
+            hidden = model.apply(p, toks, train=True, output="hidden")
+            emb = p["params"]["token_embed"]["embedding"]
+            return masked_lm_loss_gathered(hidden, emb, pos, lab)
+        return masked_lm_loss(model.apply(p, toks, train=True), toks, msk)
 
     @jax.jit
-    def round_fn(p, s, toks, msk):
+    def round_fn(p, s, toks, msk, pos, lab):
         def body(carry, _):
             p, s = carry
-            loss, g = jax.value_and_grad(loss_fn)(p, toks, msk)
+            loss, g = jax.value_and_grad(loss_fn)(p, toks, msk, pos, lab)
             upd, s = opt.update(g, s, p)
             p = _optax.apply_updates(p, upd)
             return (p, s), loss
@@ -264,9 +298,12 @@ def transformer_main(family: str, allow_env: bool = True):
         return p, s, losses[-1]
 
     log(f"{label} seq {seq} batch {batch}/chip "
-        f"({n_params / 1e6:.0f}M params), compiling...")
+        f"({n_params / 1e6:.0f}M params"
+        f"{', gathered MLM head' if gather else ''}"
+        f"{', bf16 adam mu' if mu_bf16 else ''}), compiling...")
     t0 = time.perf_counter()
-    params, opt_state, loss = round_fn(params, opt_state, tokens, mask)
+    params, opt_state, loss = round_fn(params, opt_state, tokens, mask,
+                                       positions, labels)
     jax.block_until_ready(loss)
     log(f"warmup done in {time.perf_counter() - t0:.1f}s "
         f"(loss={float(loss):.3f})")
@@ -274,7 +311,8 @@ def transformer_main(family: str, allow_env: bool = True):
     rates = []
     for r in range(TIMED_ROUNDS):
         t0 = time.perf_counter()
-        params, opt_state, loss = round_fn(params, opt_state, tokens, mask)
+        params, opt_state, loss = round_fn(params, opt_state, tokens,
+                                           mask, positions, labels)
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
         rates.append(global_batch * seq * BATCHES_PER_ROUND / dt)

@@ -125,7 +125,12 @@ int accept_deadline(int listen_fd, int64_t deadline_ms) {
     }
     if (pr == 0) continue;  // re-check the deadline
     int fd = ::accept(listen_fd, nullptr, nullptr);
-    if (fd < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+    // ECONNABORTED/EPROTO: the queued connection was reset by the dialer
+    // (port scanners do this) — keep accepting real peers
+    if (fd < 0 && (errno == EINTR || errno == EAGAIN ||
+                   errno == EWOULDBLOCK || errno == ECONNABORTED ||
+                   errno == EPROTO))
+      continue;
     return fd;
   }
 }
@@ -491,7 +496,10 @@ int comm_init(Comm* c, int rank, int world, const char* coord_host,
       return -1;
     }
     std::vector<RingAddr> ring_addrs(world);
-    if (recv_all(fd, ring_addrs.data(), sizeof(RingAddr) * world) != 0) {
+    // bounded: a coordinator that accepted our hello then died (no RST)
+    // must fail this rank's init, not hang it forever
+    if (recv_all_deadline(fd, ring_addrs.data(), sizeof(RingAddr) * world,
+                          mono_ms() + timeout_ms) != 0) {
       c->error = "address book recv failed";
       return -1;
     }
@@ -774,20 +782,23 @@ int hvdnet_world(void* h) { return static_cast<Comm*>(h)->world; }
 // Cumulative data-plane bytes this process sent through the collective
 // kernels (ring allreduce / reduce-scatter / pairwise alltoall).
 uint64_t hvdnet_data_bytes_sent(void* h) {
-  return static_cast<Comm*>(h)->counters.data_bytes_sent.load();
+  Comm* c = static_cast<Comm*>(h);  // null after close(): report 0,
+  return c ? c->counters.data_bytes_sent.load() : 0;  // don't crash
 }
 
 // Cumulative ring/mesh kernel steps (duplex exchanges) — fusion's
 // dispatch-count win is this counter's delta.
 uint64_t hvdnet_exchange_calls(void* h) {
-  return static_cast<Comm*>(h)->counters.exchange_calls.load();
+  Comm* c = static_cast<Comm*>(h);
+  return c ? c->counters.exchange_calls.load() : 0;
 }
 
 // Cumulative control-plane (star) bytes this process sent — negotiation
 // gathers/bcasts and cache-bit syncs; the response cache's byte
 // amortization is this counter's per-op delta.
 uint64_t hvdnet_ctrl_bytes_sent(void* h) {
-  return static_cast<Comm*>(h)->counters.ctrl_bytes_sent.load();
+  Comm* c = static_cast<Comm*>(h);
+  return c ? c->counters.ctrl_bytes_sent.load() : 0;
 }
 
 int hvdnet_barrier(void* h) { return barrier(static_cast<Comm*>(h)); }

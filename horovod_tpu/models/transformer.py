@@ -127,13 +127,23 @@ class Transformer(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, token_ids, train: bool = True, pos_offset=0):
+    def __call__(self, token_ids, train: bool = True, pos_offset=0,
+                 output: str = "logits"):
         """``pos_offset`` is the global position of the first token — under
         sequence parallelism each device passes its shard's offset (e.g.
         ``lax.axis_index(axis) * seq_local``) so position embeddings stay
         global; it may be a traced scalar. ``max_seq`` must cover the
         GLOBAL sequence (``pos_offset + seq``); with a traced offset this
-        cannot be checked at trace time, so size ``max_seq`` accordingly."""
+        cannot be checked at trace time, so size ``max_seq`` accordingly.
+
+        ``output="hidden"`` returns the final-norm hidden states
+        (batch, seq, d_model) WITHOUT the tied vocab projection — the
+        MLM training path projects only the masked positions
+        (:func:`masked_lm_loss_gathered`), so the (batch, seq, vocab)
+        float32 logits tensor (0.5 GB at BERT-Large bench shapes) never
+        exists; its HBM round trip through projection + softmax + its
+        backward was measured at ~23% of the whole step
+        (docs/perf_experiments.md round 4)."""
         if token_ids.ndim != 2:
             raise ValueError("expected (batch, seq) int token ids")
         seq = token_ids.shape[1]
@@ -175,6 +185,8 @@ class Transformer(nn.Module):
 
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="final_norm")(x)
+        if output == "hidden":
+            return x
         logits = embed.attend(x)  # tied output projection
         return logits.astype(jnp.float32)
 
@@ -198,6 +210,47 @@ def masked_lm_loss(logits, labels, mask):
     loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
     mask = mask.astype(loss.dtype)
     return (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def masked_lm_loss_gathered(hidden, embed_matrix, positions, labels,
+                            weights=None):
+    """BERT MLM objective over a FIXED set of masked positions, vocab
+    projection applied AFTER gathering — the standard BERT data layout
+    (``max_predictions_per_seq``: positions/labels/weights per row).
+
+    ``hidden``: (batch, seq, d) from ``model(..., output="hidden")``;
+    ``embed_matrix``: the tied (vocab, d) token embedding
+    (``params["params"]["token_embed"]["embedding"]``);
+    ``positions``: (batch, M) int32; ``labels``: (batch, M) int32;
+    ``weights``: (batch, M) 0/1 mask for rows with fewer than M real
+    predictions (None = all real).
+
+    Projecting only the M≈0.15*seq masked positions instead of all seq
+    keeps the (batch, seq, vocab) f32 logits tensor from ever existing:
+    at BERT-Large bench shapes that is 0.5 GB of HBM written + re-read
+    in softmax fwd AND bwd — measured ~23% of the step
+    (docs/perf_experiments.md round 4). FLOPs of the projection drop
+    the same way; MFU accounting must use the gathered count."""
+    gathered = jnp.take_along_axis(hidden, positions[..., None], axis=1)
+    logits = (gathered @ embed_matrix.astype(gathered.dtype).T
+              ).astype(jnp.float32)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    if weights is None:
+        return loss.mean()
+    w = weights.astype(loss.dtype)
+    return (loss * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def sample_masked_positions(rng: np.random.Generator, batch: int,
+                            seq: int, num_predictions: int):
+    """Fixed-count masked-position sampling (BERT's
+    ``max_predictions_per_seq`` layout): per row, ``num_predictions``
+    distinct positions, sorted. Returns an int32 (batch, M) array of
+    positions (labels are the input tokens at those positions; gather
+    them with ``np.take_along_axis``)."""
+    pos = np.stack([rng.choice(seq, size=num_predictions, replace=False)
+                    for _ in range(batch)])
+    return np.sort(pos, axis=1).astype(np.int32)
 
 
 def causal_lm_loss(logits, token_ids):

