@@ -237,21 +237,60 @@ def transformer_main(family: str, allow_env: bool = True):
     # BENCH_ADAM_MU_BF16=1: adamw first moment in bf16 (optimizer-state
     # HBM traffic counter-move; A/B knob, default off)
     mu_bf16 = allow_env and os.environ.get("BENCH_ADAM_MU_BF16") == "1"
+    # BENCH_QKV_FUSED=1: single (d, 3d) QKV projection per layer
+    # (counter-move A/B knob, default off)
+    qkv_fused = allow_env and os.environ.get("BENCH_QKV_FUSED") == "1"
+    # BENCH_ACCUM=N: gradient accumulation over N micro-batches per
+    # optimizer update (effective batch = N*batch, identical gradients
+    # to a single N*batch step). The r4 decomposition measured the f32
+    # adamw pass at 16.2 ms — 21% of the BERT-Large step and batch-
+    # independent — so keeping the micro-batch at the activation sweet
+    # spot and amortizing the update is the large-batch training
+    # configuration this chip actually favors. BERT-Large defaults to
+    # the measured winner x8 (r4 sweep: x2 +0%, x4 +7%, x8 +10.8%,
+    # x16 see docs/perf_experiments.md); BERT-Base to x4 (+1.6%); GPT-2
+    # measured a wash (122.1k -> 121.3k at x4) and stays at 1.
+    default_accum = "8" if large else "1" if causal else "4"
+    if allow_env and os.environ.get("BENCH_FUSED_ADAMW") == "1":
+        default_accum = "1"  # the fused-adamw A/B runs un-accumulated
+    accum = int(os.environ.get("BENCH_ACCUM", default_accum)
+                if allow_env else default_accum)
+    # BENCH_FUSED_ADAMW=1: the Pallas single-pass adamw
+    # (ops/pallas/fused_adamw.py) instead of optax's transform chain —
+    # targets the measured 16.2 ms / 21%-of-step optimizer pass
+    fused_opt = allow_env and os.environ.get("BENCH_FUSED_ADAMW") == "1"
+    if fused_opt and accum > 1:
+        raise SystemExit("BENCH_FUSED_ADAMW and BENCH_ACCUM are separate "
+                         "A/B knobs; combine them once either wins alone")
 
     cls = GPT2Small if causal else BertLarge if large else BertBase
-    model = cls(vocab_size=vocab, max_seq=seq, dtype=jnp.bfloat16)
+    model = cls(vocab_size=vocab, max_seq=seq, dtype=jnp.bfloat16,
+                fused_qkv=qkv_fused)
     rng = np.random.RandomState(0)
-    tokens = rng.randint(0, vocab, (global_batch, seq)).astype(np.int32)
-    mask = (rng.rand(global_batch, seq) < 0.15).astype(np.int32)
+    rows = global_batch * accum
+    tokens = rng.randint(0, vocab, (rows, seq)).astype(np.int32)
+    mask = (rng.rand(rows, seq) < 0.15).astype(np.int32)
     n_pred = max(1, round(0.15 * seq))  # 76 at seq 512 (BERT's layout)
     positions = sample_masked_positions(
-        np.random.default_rng(0), global_batch, seq, n_pred)
+        np.random.default_rng(0), rows, seq, n_pred)
     labels = np.take_along_axis(tokens, positions, axis=1)
+    if accum > 1:
+        reshape = lambda a: a.reshape((accum, global_batch) + a.shape[1:])
+        tokens, mask, positions, labels = map(
+            reshape, (tokens, mask, positions, labels))
 
-    params = model.init(jax.random.PRNGKey(0), tokens[:1], train=False)
-    opt = hvd.DistributedOptimizer(_optax.adamw(
-        1e-4, mu_dtype=jnp.bfloat16 if mu_bf16 else None))
-    opt_state = opt.init(params)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        (tokens[0] if accum > 1 else tokens)[:1], train=False)
+    if fused_opt:
+        from horovod_tpu.ops.pallas import fused_adamw as _fused_adamw
+        fopt = _fused_adamw(1e-4)
+        opt = None
+        opt_state = fopt.init(params)
+    else:
+        opt = hvd.DistributedOptimizer(_optax.adamw(
+            1e-4, mu_dtype=jnp.bfloat16 if mu_bf16 else None))
+        opt_state = opt.init(params)
 
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
@@ -275,6 +314,14 @@ def transformer_main(family: str, allow_env: bool = True):
         n_eff = n_params - n_embed + n_embed * n_pred // seq
     flops_per_token = 6 * n_eff + (attn // 2 if causal else attn)
 
+    # Round sizing under accumulation: the tunnel charges a fixed
+    # ~150 ms per round (measured r4: 56/120/480 micros per round ->
+    # 55.3/56.4/57.3 k tokens/s at accum 8), so rounds should stay as
+    # LONG as possible — but rounds beyond ~40 s trip the tunnel's RPC
+    # deadline (accum 16 x 60 updates = 74 s rounds died reliably).
+    # Cap micro-steps per round at 512 (~35 s at BERT-Large shapes).
+    updates_per_round = max(1, min(BATCHES_PER_ROUND, 512 // accum))
+
     def loss_fn(p, toks, msk, pos, lab):
         if causal:
             return causal_lm_loss(model.apply(p, toks, train=True), toks)
@@ -286,21 +333,47 @@ def transformer_main(family: str, allow_env: bool = True):
 
     @jax.jit
     def round_fn(p, s, toks, msk, pos, lab):
-        def body(carry, _):
-            p, s = carry
-            loss, g = jax.value_and_grad(loss_fn)(p, toks, msk, pos, lab)
+        def one_update(p, s):
+            if accum == 1:
+                loss, g = jax.value_and_grad(loss_fn)(p, toks, msk, pos,
+                                                      lab)
+                if fused_opt:
+                    from horovod_tpu.parallel.dp import allreduce_gradients
+                    g = allreduce_gradients(g, average=True)
+                    p, s = fopt.apply(p, s, g)
+                    return p, s, loss
+            else:
+                # accumulate over micro-batches: mean grad == the grad of
+                # one accum*batch step, at batch-8 activation footprint
+                def micro(g_sum, mb):
+                    t, m, po, la = mb
+                    loss, g = jax.value_and_grad(loss_fn)(p, t, m, po, la)
+                    return jax.tree_util.tree_map(jnp.add, g_sum, g), loss
+                g0 = jax.tree_util.tree_map(jnp.zeros_like, p)
+                g, mlosses = jax.lax.scan(micro, g0,
+                                          (toks, msk, pos, lab))
+                g = jax.tree_util.tree_map(lambda a: a / accum, g)
+                loss = mlosses.mean()
             upd, s = opt.update(g, s, p)
             p = _optax.apply_updates(p, upd)
+            return p, s, loss
+
+        def body(carry, _):
+            p, s = carry
+            p, s, loss = one_update(p, s)
             return (p, s), loss
 
         (p, s), losses = jax.lax.scan(body, (p, s), None,
-                                      length=BATCHES_PER_ROUND)
+                                      length=updates_per_round)
         return p, s, losses[-1]
 
     log(f"{label} seq {seq} batch {batch}/chip "
         f"({n_params / 1e6:.0f}M params"
         f"{', gathered MLM head' if gather else ''}"
-        f"{', bf16 adam mu' if mu_bf16 else ''}), compiling...")
+        f"{', bf16 adam mu' if mu_bf16 else ''}"
+        f"{', fused qkv' if qkv_fused else ''}"
+        f"{f', {accum}x grad accumulation' if accum > 1 else ''}"
+        f"{', fused pallas adamw' if fused_opt else ''}), compiling...")
     t0 = time.perf_counter()
     params, opt_state, loss = round_fn(params, opt_state, tokens, mask,
                                        positions, labels)
@@ -315,14 +388,16 @@ def transformer_main(family: str, allow_env: bool = True):
                                            mask, positions, labels)
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
-        rates.append(global_batch * seq * BATCHES_PER_ROUND / dt)
+        rates.append(global_batch * accum * seq * updates_per_round / dt)
         log(f"round {r}: {rates[-1]:.0f} tokens/s")
 
     tokens_per_sec = float(np.median(rates))  # robust to tunnel hiccups
     per_chip = tokens_per_sec / n_chips
+    batch_label = (f"batch {batch}/chip" if accum == 1 else
+                   f"batch {batch}x{accum} accum/chip")
     result = {
         "metric": f"tokens/sec/chip ({label}, bf16, seq {seq}, "
-                  f"batch {batch}/chip, flash attention)",
+                  f"{batch_label}, flash attention)",
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": None,  # the reference publishes no absolute

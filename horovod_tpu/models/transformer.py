@@ -46,6 +46,7 @@ class SelfAttention(nn.Module):
     causal: bool = False
     dtype: Dtype = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    fused_qkv: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -59,9 +60,18 @@ class SelfAttention(nn.Module):
                         param_dtype=jnp.float32)
 
         qkv_shape = (self.num_heads, head_dim)
-        q = dense(features=qkv_shape, name="query")(x)
-        k = dense(features=qkv_shape, name="key")(x)
-        v = dense(features=qkv_shape, name="value")(x)
+        if self.fused_qkv:
+            # one (d_model, 3*d_model) matmul instead of three separate
+            # (d_model, d_model) ones: reads the activations from HBM
+            # once and gives XLA a single taller MXU tile. Changes the
+            # checkpoint layout (param "qkv" replaces query/key/value),
+            # so it is opt-in.
+            qkv = dense(features=(3,) + qkv_shape, name="qkv")(x)
+            q, k, v = (qkv[..., i, :, :] for i in range(3))
+        else:
+            q = dense(features=qkv_shape, name="query")(x)
+            k = dense(features=qkv_shape, name="key")(x)
+            v = dense(features=qkv_shape, name="value")(x)
         # (batch, seq, heads, head_dim) -> (batch, heads, seq, head_dim)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
@@ -95,13 +105,15 @@ class TransformerLayer(nn.Module):
     causal: bool = False
     dtype: Dtype = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    fused_qkv: bool = False
 
     @nn.compact
     def __call__(self, x):
         ln = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
         x = x + SelfAttention(
             num_heads=self.num_heads, causal=self.causal, dtype=self.dtype,
-            attention_fn=self.attention_fn, name="attention")(ln()(x))
+            attention_fn=self.attention_fn, fused_qkv=self.fused_qkv,
+            name="attention")(ln()(x))
         x = x + Mlp(d_ff=self.d_ff, dtype=self.dtype, name="mlp")(ln()(x))
         return x
 
@@ -125,6 +137,7 @@ class Transformer(nn.Module):
     dtype: Dtype = jnp.bfloat16
     remat: bool = False
     attention_fn: Optional[Callable] = None
+    fused_qkv: bool = False
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True, pos_offset=0,
@@ -141,9 +154,9 @@ class Transformer(nn.Module):
         MLM training path projects only the masked positions
         (:func:`masked_lm_loss_gathered`), so the (batch, seq, vocab)
         float32 logits tensor (0.5 GB at BERT-Large bench shapes) never
-        exists; its HBM round trip through projection + softmax + its
-        backward was measured at ~23% of the whole step
-        (docs/perf_experiments.md round 4)."""
+        exists. Measured on the BERT-Large bench shape: the full-logits
+        head costs ~2.9 ms of a 79.2 ms step — the gathered path is
+        +3.8% tokens/s end to end (docs/perf_experiments.md round 4)."""
         if token_ids.ndim != 2:
             raise ValueError("expected (batch, seq) int token ids")
         seq = token_ids.shape[1]
@@ -181,6 +194,7 @@ class Transformer(nn.Module):
             x = layer(num_heads=self.num_heads, d_ff=self.d_ff,
                       causal=self.causal, dtype=self.dtype,
                       attention_fn=self.attention_fn,
+                      fused_qkv=self.fused_qkv,
                       name=f"layer_{i}")(x)
 
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
@@ -228,9 +242,10 @@ def masked_lm_loss_gathered(hidden, embed_matrix, positions, labels,
     Projecting only the M≈0.15*seq masked positions instead of all seq
     keeps the (batch, seq, vocab) f32 logits tensor from ever existing:
     at BERT-Large bench shapes that is 0.5 GB of HBM written + re-read
-    in softmax fwd AND bwd — measured ~23% of the step
-    (docs/perf_experiments.md round 4). FLOPs of the projection drop
-    the same way; MFU accounting must use the gathered count."""
+    in softmax fwd AND bwd — measured ~2.9 ms of the 79.2 ms step,
+    +3.8% tokens/s end to end (docs/perf_experiments.md round 4). FLOPs
+    of the projection drop the same way; MFU accounting must use the
+    gathered count."""
     gathered = jnp.take_along_axis(hidden, positions[..., None], axis=1)
     logits = (gathered @ embed_matrix.astype(gathered.dtype).T
               ).astype(jnp.float32)

@@ -6,10 +6,13 @@ from horovod_tpu.ops.pallas.flash_attention import (
     flash_attention_partial,
     merge_partials,
 )
+from horovod_tpu.ops.pallas.fused_adamw import FusedAdamW, fused_adamw
 
 __all__ = [
     "flash_attention",
     "flash_attention_partial",
     "merge_partials",
     "attention_reference",
+    "fused_adamw",
+    "FusedAdamW",
 ]

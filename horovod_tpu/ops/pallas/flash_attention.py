@@ -54,6 +54,22 @@ def _use_interpret() -> bool:
     return env_mod._get_bool("HOROVOD_PALLAS_INTERPRET", default)
 
 
+def _mxu_bf16(*refs) -> bool:
+    """``FLASH_MXU_BF16=1``: feed the MXU dots bf16 operands (f32
+    accumulation) instead of up-casting everything to f32 first — the
+    standard TPU flash-kernel layout (softmax max/exp2/normalise stays f32
+    on the VPU; dot operands, including the probability/ds intermediates,
+    round to bf16). Measured on the BERT-Large bench shape (B8 H16 S512
+    D64): NO speedup — 24-layer fwd 7.79→8.01 ms, fwd+bwd 13.12→13.24 ms
+    (docs/perf_experiments.md round 4) — the kernel's cost at this shape is
+    VPU/softmax-bound, not MXU-rate-bound, so the default stays the f32
+    path (better p/ds precision for free). Kept as a measured-excluded
+    counter-move and for A/B on future shapes where the MXU term dominates
+    (longer head_dim, causal long-seq)."""
+    return (env_mod._get_bool("FLASH_MXU_BF16", False)
+            and all(r.dtype == jnp.bfloat16 for r in refs))
+
+
 def _vma(*arrays) -> frozenset:
     """Union of the inputs' varying-mesh-axes, so pallas_call outputs carry
     the right vma under ``shard_map(check_vma=True)``."""
@@ -102,12 +118,22 @@ def _fwd_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def update(masked):
         # Scores and the running max are tracked in base 2 (pre-scaled by
         # LOG2E) so the inner loop uses exp2, which is cheaper on the VPU.
-        q = q_ref[0, 0, :, :].astype(jnp.float32) * (sm_scale * LOG2E)
-        k = k_ref[0, 0, :, :].astype(jnp.float32)  # (bk, d)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        bf16 = _mxu_bf16(q_ref, k_ref, v_ref)
+        if bf16:
+            # bf16 operands straight from HBM; scale moves after the dot
+            # (algebraically identical — the accumulator is f32 either way)
+            q = q_ref[0, 0, :, :]
+            k = k_ref[0, 0, :, :]
+            v = v_ref[0, 0, :, :]
+        else:
+            q = q_ref[0, 0, :, :].astype(jnp.float32) * (sm_scale * LOG2E)
+            k = k_ref[0, 0, :, :].astype(jnp.float32)  # (bk, d)
+            v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (bq, bk)
+        if bf16:
+            s = s * (sm_scale * LOG2E)
         if masked:
             q_ids = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -125,7 +151,8 @@ def _fwd_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp2(s - m_safe[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(jnp.bfloat16) if bf16 else p, v,
+            (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jax.lax.broadcast_in_dim(m_new, m_ref.shape, (0,))
         l_ref[...] = jax.lax.broadcast_in_dim(l_new, l_ref.shape, (0,))
@@ -233,15 +260,18 @@ def _bwd_dq_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
     def update(masked):
-        q = q_ref[0, 0, :, :].astype(jnp.float32)
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        bf16 = _mxu_bf16(q_ref, k_ref, v_ref, do_ref)
+        cast = (lambda r: r[0, 0, :, :]) if bf16 else \
+            (lambda r: r[0, 0, :, :].astype(jnp.float32))
+        q = cast(q_ref)
+        do = cast(do_ref)
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         # Fully-masked rows have lse = -inf and all s = -inf; shifting by 0
         # instead of -inf keeps exp(s - lse) at 0 rather than NaN.
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse) * LOG2E
-        k = k_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        k = cast(k_ref)
+        v = cast(v_ref)
         s = (sm_scale * LOG2E) * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -257,7 +287,8 @@ def _bwd_dq_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
         dq_acc_ref[...] = dq_acc_ref[...] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(jnp.bfloat16) if bf16 else ds, k,
+            (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -291,10 +322,13 @@ def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
     def update(masked):
-        k = k_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
-        q = q_ref[0, 0, :, :].astype(jnp.float32)
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        bf16 = _mxu_bf16(q_ref, k_ref, v_ref, do_ref)
+        cast = (lambda r: r[0, 0, :, :]) if bf16 else \
+            (lambda r: r[0, 0, :, :].astype(jnp.float32))
+        k = cast(k_ref)
+        v = cast(v_ref)
+        q = cast(q_ref)
+        do = cast(do_ref)
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse) * LOG2E
@@ -308,15 +342,17 @@ def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, NEG_INF)
         p = jnp.exp2(s - lse_safe[:, None])
+        pcast = p.astype(jnp.bfloat16) if bf16 else p
         dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pcast, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
         dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(jnp.bfloat16) if bf16 else ds, q,
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:

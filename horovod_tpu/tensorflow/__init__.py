@@ -279,21 +279,27 @@ def _make_allreduce_grads_fn(name, device_dense, device_sparse,
         # per gradient when TF's inter-op pool is small, defeating
         # fusion entirely (measured 48/48 unfused cycles; the grouped
         # path hits 2). IndexedSlices keep the per-gradient gather path.
-        dense_idx = [i for i, g in enumerate(grads)
-                     if g is not None
-                     and not isinstance(g, tf.IndexedSlices)]
+        dense_idx = []
+        for i, g in enumerate(grads):
+            if g is None or isinstance(g, tf.IndexedSlices):
+                continue
+            if not (g.dtype.is_floating or g.dtype.is_complex):
+                # same guard as allreduce(): int / size would silently
+                # promote to float64
+                raise ValueError(
+                    "average is not supported for integer tensors; "
+                    "integer gradients cannot flow through "
+                    "DistributedGradientTape averaging")
+            dense_idx.append(i)
         out = list(grads)
         if dense_idx:
             compressed, ctxs = zip(*(compression.compress(grads[i])
                                      for i in dense_idx))
             summed = mpi_ops.grouped_allreduce(
                 list(compressed), name=f"{prefix}.grads")
-            horovod_size = None
             for i, s, ctx in zip(dense_idx, summed, ctxs):
                 s = compression.decompress(s, ctx)
-                if horovod_size is None:
-                    horovod_size = tf.cast(size(), s.dtype)
-                out[i] = s / tf.cast(horovod_size, s.dtype)
+                out[i] = s / tf.cast(size(), s.dtype)
         for i, g in enumerate(grads):
             if g is not None and isinstance(g, tf.IndexedSlices):
                 out[i] = allreduce(g, device_dense=device_dense,

@@ -19,6 +19,16 @@ os.environ["XLA_FLAGS"] = (
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Launcher-driven tests spawn `tpurun ... python examples/foo.py`
+# subprocesses that import horovod_tpu from PYTHONPATH (pytest's rootdir
+# insertion only covers THIS process). Prepend the repo so the tests are
+# hermetic whether or not the package is pip-installed.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in os.environ.get("PYTHONPATH", "").split(os.pathsep):
+    os.environ["PYTHONPATH"] = (
+        _REPO + os.pathsep + os.environ.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

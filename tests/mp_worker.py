@@ -1167,10 +1167,8 @@ def main():
             ckpt.save(ckpt_dir, {"step": 5, "jnp_w": jnp_w}, step=5)
             # everyone waits until the save is published before dying —
             # an allreduce doubles as the barrier
-            hvd.allreduce_async(np.ones(1, np.float32),
-                                name="soak/barrier")
             h = hvd.allreduce_async(np.ones(1, np.float32),
-                                    name="soak/barrier2")
+                                    name="soak/barrier")
             hvd.synchronize(h)
             print(f"CKPT_SAVED rank={rank}", flush=True)
             sys.stdout.flush()

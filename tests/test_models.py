@@ -187,6 +187,33 @@ class TestTransformer:
         np.testing.assert_allclose(float(gathered), float(full),
                                    rtol=1e-6)
 
+    def test_fused_qkv_matches_unfused(self, hvd_flat):
+        """fused_qkv=True is the same function: stacking the unfused
+        query/key/value kernels (and biases) into the fused 'qkv' param
+        must reproduce the unfused model's logits exactly."""
+        rng = np.random.RandomState(5)
+        tokens = jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32)
+
+        unfused = self._tiny(causal=False)
+        fused = self._tiny(causal=False, fused_qkv=True)
+        uv = unfused.init(jax.random.PRNGKey(0), tokens, train=False)
+        fv = fused.init(jax.random.PRNGKey(0), tokens, train=False)
+        fparams = jax.tree_util.tree_map(np.asarray, fv)
+        for lyr in ("layer_0", "layer_1"):
+            at = uv["params"][lyr]["attention"]
+            dst = fparams["params"][lyr]["attention"]["qkv"]
+            dst["kernel"] = np.stack(
+                [np.asarray(at[n]["kernel"]) for n in
+                 ("query", "key", "value")], axis=1)  # (d, 3, h, hd)
+            dst["bias"] = np.stack(
+                [np.asarray(at[n]["bias"]) for n in
+                 ("query", "key", "value")], axis=0)  # (3, h, hd)
+
+        a = unfused.apply(uv, tokens, train=False)
+        b = fused.apply(fparams, tokens, train=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5)
+
     def test_bert_large_param_count(self, hvd_flat):
         from horovod_tpu.models.transformer import BertLarge
 

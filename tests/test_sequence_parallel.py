@@ -57,6 +57,37 @@ def test_flash_grads_match_reference(causal):
         np.testing.assert_allclose(a, b, atol=5e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_mxu_path(causal, monkeypatch):
+    """FLASH_MXU_BF16=1 feeds the MXU dots bf16 operands (f32
+    accumulation). Outputs and grads must match the f32 reference
+    computed on the same (bf16-rounded) inputs to bf16-appropriate
+    tolerance; the default (flag off) keeps the f32-cast path."""
+    q, k, v = _qkv(7, dtype=jnp.bfloat16)
+    ref = attention_reference(q, k, v, causal=causal).astype(jnp.float32)
+
+    # default: f32-cast path
+    o_f32 = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(o_f32, np.float32), ref,
+                               atol=2e-2)
+
+    monkeypatch.setenv("FLASH_MXU_BF16", "1")
+    o = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(o, np.float32), ref, atol=2e-2)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(attention_reference), argnums=(0, 1, 2))(q, k, v)
+    scale = max(float(jnp.abs(x).max()) for x in gr)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=3e-2 * scale)
+
+
 def test_flash_cross_offsets():
     """Offsets shift the causal mask to global positions."""
     q, k, v = _qkv(2)

@@ -32,6 +32,7 @@ over N extra iterations, so compile, dispatch, and readback cancel.
 
 import argparse
 import json
+import os
 import sys
 import time
 from functools import partial
@@ -41,7 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 from horovod_tpu.models.transformer import BertLarge, masked_lm_loss  # noqa: E402
 from horovod_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
@@ -66,7 +68,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default=None,
                     choices=["vocab", "fwd", "grad", "full", "attn",
-                             "attn_grad"],
+                             "attn_grad", "opt"],
                     help="measure ONE phase (a tunnel hiccup then only "
                          "loses one variant; drive the set from a shell "
                          "loop)")
@@ -157,6 +159,26 @@ def main():
                      jnp.bfloat16)
 
     @partial(jax.jit, static_argnames="iters")
+    def opt_chain(p, o, g0, salt, iters):
+        # adamw update alone, chained through the params (grads fixed):
+        # isolates the optimizer's HBM traffic (read p+mu+nu+g, write
+        # p+mu+nu) without the model in the program, so the compile is
+        # small enough to survive tunnel hiccups. bwd then falls out of
+        # full - fwd - opt when the grad phase is unavailable.
+        def body(carry, _):
+            p_c, o_c = carry
+            upd, o_c = tx.update(g0, o_c, p_c)
+            p_c = optax.apply_updates(p_c, upd)
+            p_c = jax.tree_util.tree_map(
+                lambda a: a + jnp.asarray(salt * 1e-12, a.dtype), p_c)
+            return (p_c, o_c), 0.0
+        (p_f, _), _ = jax.lax.scan(body, (p, o), None, length=iters)
+        # reduce over EVERY element — adamw is elementwise, so returning
+        # a single element would let XLA slice the whole update to one
+        # lane (measured: the step collapses to ~0)
+        return sum(jnp.sum(leaf) for leaf in jax.tree_util.tree_leaves(p_f))
+
+    @partial(jax.jit, static_argnames="iters")
     def attn_chain(q, k, v, salt, iters):
         def body(q_c, _):
             x = q_c
@@ -214,6 +236,10 @@ def main():
         "grad": lambda: measure(grad_chain, params, tokens, mask),
         "full": lambda: measure(full_chain, params, opt_state, tokens,
                                 mask),
+        "opt": lambda: measure(
+            opt_chain, params, opt_state,
+            jax.tree_util.tree_map(
+                lambda a: jnp.full_like(a, 1e-6), params)),
         "attn": lambda: measure(attn_chain, q0, k0, v0),
         "attn_grad": lambda: measure(attn_grad_chain, q0, k0, v0),
     }

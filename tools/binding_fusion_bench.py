@@ -81,9 +81,18 @@ def worker() -> None:
         for _ in range(STEPS):
             one_step()
         dt = time.perf_counter() - t0
+        d_ex = net.exchange_calls() - ex0
+        d_ctrl = net.ctrl_bytes_sent() - ctrl0
+        if d_ex < 0 or d_ctrl < 0:
+            # counters read 0 from a closed Comm handle — the world shut
+            # down mid-measure (a peer died); fail loudly, never report
+            # garbage deltas
+            raise RuntimeError(
+                f"{label}: counter went backwards (d_ex={d_ex}, "
+                f"d_ctrl={d_ctrl}) — world shut down mid-measure")
         results[label] = {
-            "exchanges_per_step": (net.exchange_calls() - ex0) / STEPS,
-            "ctrl_bytes_per_step": (net.ctrl_bytes_sent() - ctrl0) / STEPS,
+            "exchanges_per_step": d_ex / STEPS,
+            "ctrl_bytes_per_step": d_ctrl / STEPS,
             "ms_per_step": dt / STEPS * 1e3,
         }
 
@@ -124,6 +133,14 @@ def worker() -> None:
 
     measure("tf", tf_step)
 
+    # Quiesce before shutdown: shutdown is NOT a barrier (reference
+    # semantics match), so a rank that finishes first and closes its
+    # sockets kills a peer whose last burst completion is still in
+    # flight — observed as this tool's flaky negative-counter /
+    # shut-down-mid-measure failures. A synchronous allreduce returns
+    # only once every prior op on the ordered lane completed on ALL
+    # ranks, so after it no rank has in-flight work.
+    thvd.allreduce(torch.zeros(1), name="fb.quiesce")
     thvd.shutdown()
     if rank == 0:
         print("RESULTS " + json.dumps(results), flush=True)
