@@ -204,6 +204,7 @@ def transformer_main(family: str, allow_env: bool = True):
 
     from horovod_tpu.models.transformer import (BertBase, BertLarge,
                                                 GPT2Small, causal_lm_loss,
+                                                causal_lm_loss_chunked,
                                                 masked_lm_loss,
                                                 masked_lm_loss_gathered,
                                                 sample_masked_positions)
@@ -322,8 +323,20 @@ def transformer_main(family: str, allow_env: bool = True):
     # Cap micro-steps per round at 512 (~35 s at BERT-Large shapes).
     updates_per_round = max(1, min(BATCHES_PER_ROUND, 512 // accum))
 
+    # BENCH_LM_CHUNK=K: chunked causal loss — the vocab projection runs
+    # K seq positions at a time inside the loss, so the (batch, seq,
+    # vocab) f32 logits tensor (3.3 GB at GPT-2 bench shapes) never
+    # exists. 0 = full-logits (A/B knob; default per measurement below).
+    lm_chunk = int(os.environ.get("BENCH_LM_CHUNK", "0")
+                   if allow_env else "0")
+
     def loss_fn(p, toks, msk, pos, lab):
         if causal:
+            if lm_chunk:
+                hidden = model.apply(p, toks, train=True, output="hidden")
+                emb = p["params"]["token_embed"]["embedding"]
+                return causal_lm_loss_chunked(hidden, emb, toks,
+                                              chunk=lm_chunk)
             return causal_lm_loss(model.apply(p, toks, train=True), toks)
         if gather:
             hidden = model.apply(p, toks, train=True, output="hidden")
@@ -373,7 +386,9 @@ def transformer_main(family: str, allow_env: bool = True):
         f"{', bf16 adam mu' if mu_bf16 else ''}"
         f"{', fused qkv' if qkv_fused else ''}"
         f"{f', {accum}x grad accumulation' if accum > 1 else ''}"
-        f"{', fused pallas adamw' if fused_opt else ''}), compiling...")
+        f"{', fused pallas adamw' if fused_opt else ''}"
+        f"{f', chunked LM loss ({lm_chunk})' if lm_chunk else ''}"
+        "), compiling...")
     t0 = time.perf_counter()
     params, opt_state, loss = round_fn(params, opt_state, tokens, mask,
                                        positions, labels)
@@ -395,6 +410,8 @@ def transformer_main(family: str, allow_env: bool = True):
     per_chip = tokens_per_sec / n_chips
     batch_label = (f"batch {batch}/chip" if accum == 1 else
                    f"batch {batch}x{accum} accum/chip")
+    if lm_chunk:
+        batch_label += f", chunked LM loss ({lm_chunk})"
     result = {
         "metric": f"tokens/sec/chip ({label}, bf16, seq {seq}, "
                   f"{batch_label}, flash attention)",

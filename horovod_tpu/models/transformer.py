@@ -275,6 +275,56 @@ def causal_lm_loss(logits, token_ids):
     return loss.mean()
 
 
+def causal_lm_loss_chunked(hidden, embed_matrix, token_ids,
+                           chunk: int = 128):
+    """Next-token cross-entropy computed seq-chunk at a time, vocab
+    projection applied INSIDE the chunk loop — the (batch, seq, vocab)
+    float32 logits tensor never exists (3.3 GB at GPT-2 bench shapes;
+    unlike MLM, causal LM needs every position's logits, but never all
+    at once).
+
+    MEASURED (docs/perf_experiments.md round 4): 5.8-8.1% SLOWER than
+    the full-logits path on the GPT-2 bench — the chunk scan trades one
+    large efficient (B·S, d)x(d, vocab) matmul for several smaller
+    ones, and XLA streams the big tensor better than the hand loop.
+    Kept for memory-constrained configurations (long seq x large vocab
+    where the logits tensor itself OOMs), NOT as a throughput move.
+
+    ``hidden``: (batch, seq, d) from ``model(..., output="hidden")``;
+    ``embed_matrix``: the tied (vocab, d) token embedding;
+    ``token_ids``: (batch, seq) int labels. Exactly equals
+    ``causal_lm_loss(model.apply(...), token_ids)`` up to f32 summation
+    order (tested). ``chunk`` must divide seq."""
+    b, s, d = hidden.shape
+    if s % chunk:
+        raise ValueError(f"chunk ({chunk}) must divide seq ({s})")
+    emb = embed_matrix.astype(hidden.dtype)
+    # predictions at positions [0, s-1) predict tokens [1, s); weight the
+    # final position 0 so the scan body is uniform across chunks
+    labels = jnp.concatenate(
+        [token_ids[:, 1:], jnp.zeros((b, 1), token_ids.dtype)], axis=1)
+    valid = jnp.concatenate(
+        [jnp.ones((b, s - 1), jnp.float32), jnp.zeros((b, 1), jnp.float32)],
+        axis=1)
+
+    h_c = hidden.reshape(b, s // chunk, chunk, d).transpose(1, 0, 2, 3)
+    lab_c = labels.reshape(b, s // chunk, chunk).transpose(1, 0, 2)
+    w_c = valid.reshape(b, s // chunk, chunk).transpose(1, 0, 2)
+
+    # remat the body: without it, scan's backward stores each chunk's
+    # softmax residuals — stacked, that is the full (batch, seq, vocab)
+    # tensor again and the memory benefit evaporates under value_and_grad
+    @jax.checkpoint
+    def body(acc, xs):
+        h, lab, w = xs
+        logits = (h @ emb.T).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, lab)
+        return acc + jnp.sum(loss * w), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (h_c, lab_c, w_c))
+    return total / (b * (s - 1))
+
+
 def random_tokens(rng: np.random.Generator, batch: int, seq: int,
                   vocab_size: int) -> np.ndarray:
     """Synthetic token batch for benchmarks (uniform vocab draw)."""

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 
 class TestResNet:
@@ -186,6 +187,31 @@ class TestTransformer:
         gathered = masked_lm_loss_gathered(hidden, emb, positions, labels)
         np.testing.assert_allclose(float(gathered), float(full),
                                    rtol=1e-6)
+
+    def test_chunked_causal_loss_matches_full_logits(self, hvd_flat):
+        """causal_lm_loss_chunked (projection inside the chunk loop, no
+        full logits tensor) must equal causal_lm_loss on the same model
+        — an algebraic rearrangement, not an approximation."""
+        from horovod_tpu.models.transformer import (causal_lm_loss,
+                                                    causal_lm_loss_chunked)
+
+        model = self._tiny(causal=True)
+        rng = np.random.RandomState(11)
+        tokens = jnp.asarray(rng.randint(0, 64, (3, 16)), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens, train=False)
+
+        full = causal_lm_loss(
+            model.apply(variables, tokens, train=False), tokens)
+        hidden = model.apply(variables, tokens, train=False,
+                             output="hidden")
+        emb = variables["params"]["token_embed"]["embedding"]
+        for chunk in (4, 8, 16):
+            chunked = causal_lm_loss_chunked(hidden, emb, tokens,
+                                             chunk=chunk)
+            np.testing.assert_allclose(float(chunked), float(full),
+                                       rtol=1e-6)
+        with pytest.raises(ValueError):
+            causal_lm_loss_chunked(hidden, emb, tokens, chunk=5)
 
     def test_fused_qkv_matches_unfused(self, hvd_flat):
         """fused_qkv=True is the same function: stacking the unfused
