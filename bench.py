@@ -328,7 +328,7 @@ def transformer_main(family: str, allow_env: bool = True):
     # vocab) f32 logits tensor (3.3 GB at GPT-2 bench shapes) never
     # exists. 0 = full-logits (A/B knob; default per measurement below).
     lm_chunk = int(os.environ.get("BENCH_LM_CHUNK", "0")
-                   if allow_env else "0")
+                   if allow_env and causal else "0")
 
     def loss_fn(p, toks, msk, pos, lab):
         if causal:
