@@ -99,8 +99,12 @@ def main():
 
     @jax.jit
     def step(params, opt_state, data):
-        # micro-batches scan over the leading accum axis; mean grad ==
-        # one accum*batch step (the r4 headline accumulation recipe)
+        # micro-batches scan over the leading accum axis (the r4
+        # headline accumulation recipe). With --gathered the mean grad
+        # EXACTLY equals one accum*batch step (fixed n_pred masked
+        # positions per row); the random-mask path is a mean-of-means
+        # (each micro normalizes by its own mask count), the usual
+        # approximation when examples per micro-batch vary.
         def micro(g_sum, mb):
             loss, g = jax.value_and_grad(loss_fn)(params, *mb)
             return jax.tree_util.tree_map(jnp.add, g_sum, g), loss

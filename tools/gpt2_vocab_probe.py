@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Isolate the GPT-2 bench's vocab-projection + causal-loss cost.
+
+Completes the round-4 evidence for the causal headline: BERT-Large's
+decomposition (tools/bert_decompose.py) pinned its non-MXU time on the
+optimizer and attention; GPT-2's remaining large term is the tied vocab
+head — (B·S, 768) @ (768, 50257) plus the 3.3 GB f32 logits round trip
+through softmax-xent — which, unlike MLM, cannot be gathered away
+(every position is a prediction) and measured SLOWER when chunked
+(docs/perf_experiments.md). This probe slope-times that head alone on a
+fixed hidden tensor at the bench shape, fwd and fwd+bwd.
+"""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.models.transformer import causal_lm_loss  # noqa: E402
+
+B, S, D, VOCAB = 16, 1024, 768, 50257
+ITERS = 8
+ROUNDS = 6
+
+
+def main():
+    rng = np.random.RandomState(0)
+    hidden = jnp.asarray(rng.randn(B, S, D), jnp.bfloat16)
+    emb = jnp.asarray(rng.randn(VOCAB, D) * 0.02, jnp.float32)
+    tokens = jnp.asarray(rng.randint(0, VOCAB, (B, S)), jnp.int32)
+
+    def head(h, e):
+        logits = (h @ e.astype(h.dtype).T).astype(jnp.float32)
+        return causal_lm_loss(logits, tokens)
+
+    @partial(jax.jit, static_argnames="iters")
+    def fwd_chain(h, e, salt, iters):
+        def body(h_c, _):
+            loss = head(h_c, e)
+            return h_c * (1 + 1e-9 * (loss + salt)).astype(h_c.dtype), loss
+
+        _, losses = jax.lax.scan(body, h, None, length=iters)
+        return losses[-1]
+
+    @partial(jax.jit, static_argnames="iters")
+    def grad_chain(h, e, salt, iters):
+        def body(carry, _):
+            h_c, e_c = carry
+            loss, (gh, ge) = jax.value_and_grad(head, argnums=(0, 1))(
+                h_c, e_c)
+            h_c = h_c - 1e-9 * gh.astype(h_c.dtype)
+            e_c = e_c - 1e-9 * ge + salt * 1e-12
+            return (h_c, e_c), loss
+
+        _, losses = jax.lax.scan(body, (h, e), None, length=iters)
+        return losses[-1]
+
+    salt_n = [0]
+
+    def fresh_salt():
+        salt_n[0] += 1
+        return jnp.float32(salt_n[0] * 1e-7)
+
+    res = {"batch": B, "seq": S, "vocab": VOCAB}
+    for label, fn, fnargs in (("fwd", fwd_chain, (hidden, emb)),
+                              ("fwd_bwd", grad_chain, (hidden, emb))):
+        for iters in (ITERS, 2 * ITERS):
+            float(fn(*fnargs, fresh_salt(), iters=iters))
+        slopes = []
+        for _ in range(ROUNDS):
+            t0 = time.perf_counter()
+            float(fn(*fnargs, fresh_salt(), iters=ITERS))
+            t1 = time.perf_counter()
+            float(fn(*fnargs, fresh_salt(), iters=2 * ITERS))
+            t2 = time.perf_counter()
+            slopes.append(((t2 - t1) - (t1 - t0)) / ITERS)
+        res[f"{label}_ms"] = round(float(np.median(slopes)) * 1e3, 2)
+    print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()
