@@ -75,8 +75,21 @@ class SelfAttention(nn.Module):
         # (batch, seq, heads, head_dim) -> (batch, heads, seq, head_dim)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
-        attn = self.attention_fn or (
-            lambda q, k, v, causal: flash_attention(q, k, v, causal=causal))
+        if self.attention_fn is not None:
+            attn = self.attention_fn
+        elif self.is_initializing():
+            # init trace only shapes the params; the Pallas kernel can't
+            # lower off-TPU (and interpret mode is python-speed), so the
+            # once-only init uses the plain XLA attention — enabling
+            # host-side init (training.init_on_host) on remote chips
+            from horovod_tpu.ops.pallas.flash_attention import (
+                attention_reference)
+
+            attn = (lambda q, k, v, causal: attention_reference(
+                q, k, v, causal=causal))
+        else:
+            attn = (lambda q, k, v, causal: flash_attention(
+                q, k, v, causal=causal))
         o = attn(q, k, v, causal=self.causal)
         o = o.transpose(0, 2, 1, 3)  # back to (batch, seq, heads, head_dim)
         return dense(features=d_model, axis=(-2, -1), name="out")(o)

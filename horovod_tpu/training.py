@@ -49,8 +49,7 @@ def create_train_state(model, optimizer, input_shape,
     ``input_dtype=jnp.int32`` initializes token models (transformers)."""
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, jnp.zeros(input_shape, input_dtype),
-                           train=False)
+    variables = init_on_host(model, rng, input_shape, input_dtype)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     if broadcast:
@@ -59,6 +58,58 @@ def create_train_state(model, optimizer, input_shape,
     opt_state = optimizer.init(params)
     return TrainState(params=params, batch_stats=batch_stats,
                       opt_state=opt_state)
+
+
+def init_on_host_fn(build, x):
+    """Run a once-only init ``build(x)`` on the LOCAL CPU backend; the
+    results move to the accelerator on first use (device_put/jit
+    argument transfer).
+
+    Init runs exactly once, so paying a remote accelerator's full
+    compile+dispatch for it is pure overhead — on the axon-tunnel chip,
+    Inception-V3's init program cost ~4.5 min remote vs 42 s local CPU
+    + 6 s transfer (measured r5). On a CPU default backend this is the
+    ordinary path. Pallas kernels in the model (flash attention) cannot
+    lower for CPU — they run in interpret mode for this one trace
+    (param VALUES don't depend on the attention output); anything else
+    refusing CPU lowering falls back to the accelerator init."""
+    import os
+
+    if jax.default_backend() != "cpu":
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            cpu = None
+        if cpu is not None:
+            prev = os.environ.get("HOROVOD_PALLAS_INTERPRET")
+            os.environ["HOROVOD_PALLAS_INTERPRET"] = "1"
+            try:
+                with jax.default_device(cpu):
+                    return build(x)
+            except Exception:
+                # CPU-lowering refusals surface as ValueError,
+                # NotImplementedError, or XlaRuntimeError depending on
+                # the op — any failure here falls back to the
+                # accelerator init, where a genuine model bug will
+                # re-raise on its own terms
+                pass
+            finally:
+                if prev is None:
+                    os.environ.pop("HOROVOD_PALLAS_INTERPRET", None)
+                else:
+                    os.environ["HOROVOD_PALLAS_INTERPRET"] = prev
+    return build(x)
+
+
+def init_on_host(model, rng, input_shape, input_dtype=jnp.float32):
+    """``model.init`` on the local CPU backend (see init_on_host_fn)."""
+    import numpy as np
+
+    # a numpy sample is backend-neutral (a device-committed zeros array
+    # would fight the default_device context)
+    return init_on_host_fn(
+        lambda x: model.init(rng, x, train=False),
+        np.zeros(input_shape, np.dtype(input_dtype)))
 
 
 def _default_loss_fn(logits, labels):
