@@ -114,6 +114,56 @@ def assert_collective_lane_clear() -> None:
     _lane_check()
 
 
+def _to_plane(tensor):
+    """Bring an input onto the data plane WITHOUT narrowing 64-bit numpy
+    payloads: ``jnp.asarray`` under default x32 silently casts
+    int64/uint64/float64 down (2**40 becomes garbage, 1e300 becomes inf)
+    — exactly the corruption the reference's per-dtype op matrix guards
+    against (reference: test/test_torch.py dtype sweeps). 64-bit numpy
+    arrays stay numpy end-to-end: the host ring reduces them exactly
+    (``_widen_for_ring`` passes 64-bit through), and the
+    single-controller replicated math (``x * size`` etc.) is exact in
+    numpy. Everything else becomes a jax array as before."""
+    if isinstance(tensor, jax.Array):
+        return tensor
+    a = np.asarray(tensor)
+    if a.dtype.itemsize == 8 and a.dtype.kind in "iuf":
+        return a
+    return jnp.asarray(a)
+
+
+def _replicated_rs_a2a(kind: str, x, world: int, op):
+    """Single-controller emulation of reducescatter/alltoall for the
+    framework bindings (torch/tf): every worker holds ``x`` (the
+    replicated world model the bindings' other ops use), and the binding
+    returns worker 0's result — computed exactly in numpy (no device
+    round trip, so 64-bit payloads stay exact). Narrow ints widen for
+    the arithmetic and cast back, the same wrap-on-overflow semantics as
+    the host ring kernels (runtime/executor.py _widen_for_ring)."""
+    from horovod_tpu.runtime.executor import _widen_for_ring
+
+    if x.shape[0] % world:
+        # bindings check statically where they can; dynamic tf.function
+        # shapes bypass that, and flooring here would silently truncate
+        raise ValueError(
+            f"{kind} dim 0 ({x.shape[0]}) must divide evenly by "
+            f"size ({world})")
+    shard = x.shape[0] // world
+    if kind == "reducescatter":
+        head = x[:shard]
+        if op == Sum:
+            return (_widen_for_ring(head, copy=True) * world).astype(
+                head.dtype, copy=False)
+        if op == Product:
+            return (_widen_for_ring(head, copy=True) ** world).astype(
+                head.dtype, copy=False)
+        # average/min/max of `world` identical copies is the copy
+        return np.array(head, copy=True)
+    # alltoall: worker 0 receives chunk 0 from each of `world` identical
+    # workers -> tile of the first chunk
+    return np.concatenate([x[:shard]] * world, axis=0)
+
+
 def _resolve_op(average: Optional[bool], op: Optional[int]) -> int:
     if op is not None and average is not None:
         raise ValueError("specify either average or op, not both")
@@ -512,7 +562,7 @@ def allreduce(
         return compression.decompress(out, ctx)
 
     st = basics._ensure_init()
-    x = tensor_c if isinstance(tensor_c, jax.Array) else jnp.asarray(tensor_c)
+    x = _to_plane(tensor_c)
     if _is_worker_stacked(x):
         if (st.config.hierarchical_allreduce
                 and _hierarchical_enabled(st, red_op)):
@@ -541,7 +591,11 @@ def allreduce(
     else:
         # Replicated: every worker holds the same value.
         if red_op in (Average, Min, Max):
-            out = x
+            # never alias the caller's buffer: for 64-bit numpy inputs
+            # _to_plane is the identity, and returning the input object
+            # would let later in-place mutation corrupt the "result"
+            out = np.array(x, copy=True) \
+                if not isinstance(x, jax.Array) else x
         elif red_op == Sum:
             out = x * st.size
         elif red_op == Product:
@@ -576,8 +630,7 @@ def grouped_allreduce(
                           axis_name=axis_name) for t in tensors]
 
     st = basics._ensure_init()
-    arrays = [t if isinstance(t, jax.Array) else jnp.asarray(t)
-              for t in tensors]
+    arrays = [_to_plane(t) for t in tensors]
     out: list = [None] * len(arrays)
     groups: dict = {}
     plain: list = []
@@ -655,10 +708,14 @@ def allgather(tensor, name: Optional[str] = None, axis_name=None):
                 f"allgather tensors must match in all but the first "
                 f"dimension, got trailing shapes {sorted(shapes)}"
             )
-        out = jnp.concatenate([jnp.asarray(t) for t in tensor], axis=0)
+        parts = [_to_plane(t) for t in tensor]
+        if any(not isinstance(p, jax.Array) for p in parts):
+            # 64-bit payload: concat exactly on host (see _to_plane)
+            return np.concatenate([np.asarray(p) for p in parts], axis=0)
+        out = jnp.concatenate(parts, axis=0)
         return jax.device_put(out, _replicated(st.mesh))
 
-    x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
+    x = _to_plane(tensor)
     if _is_worker_stacked(x):
         if x.ndim < 2:
             raise ValueError(
@@ -684,6 +741,8 @@ def allgather(tensor, name: Optional[str] = None, axis_name=None):
             return _hierarchical_gather_stacked_fn(st.mesh)(stacked)
         return _gather_stacked_fn(st.mesh)(stacked)
     # Replicated: every worker contributes the same tensor.
+    if not isinstance(x, jax.Array):  # 64-bit numpy payload (_to_plane)
+        return np.concatenate([x] * st.size, axis=0)
     return jnp.concatenate([x] * st.size, axis=0)
 
 
@@ -709,7 +768,7 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None, axis_name=None
     st = basics._ensure_init()
     if not 0 <= root_rank < st.size:
         raise ValueError(f"root_rank {root_rank} out of range [0, {st.size})")
-    x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
+    x = _to_plane(tensor)
     if _is_worker_stacked(x):
         return _bcast_stacked_fn(st.mesh, root_rank)(x)
     if _multiprocess_world(st) and not _is_globally_replicated(x, st):
@@ -724,6 +783,8 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None, axis_name=None
             _process_local_stacked(x, st))
     # Single-controller: values are already globally consistent; force the
     # replicated layout over the mesh so downstream steps see it.
+    if not isinstance(x, jax.Array):  # 64-bit numpy payload (_to_plane)
+        return np.array(x, copy=True)
     return jax.device_put(x, _replicated(st.mesh))
 
 
@@ -758,7 +819,7 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[int] = No
         return reducer(got, axis=0)
 
     st = basics._ensure_init()
-    x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
+    x = _to_plane(tensor)
     if not _is_worker_stacked(x):
         if _multiprocess_world(st) and _runtime_capable(st):
             # per-process data: route through the runtime lane like
@@ -794,7 +855,7 @@ def alltoall(tensor, name: Optional[str] = None, axis_name=None):
         )
 
     st = basics._ensure_init()
-    x = tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor)
+    x = _to_plane(tensor)
     if not _is_worker_stacked(x):
         if _multiprocess_world(st) and _runtime_capable(st):
             from horovod_tpu.runtime.runtime import get_runtime
@@ -864,7 +925,7 @@ def allreduce_async(tensor, average=None, name=None, op=None,
         from horovod_tpu.runtime.runtime import get_runtime
 
         x, ctx = compression.compress(
-            tensor if isinstance(tensor, jax.Array) else jnp.asarray(tensor))
+            _to_plane(tensor))
         handle = get_runtime().enqueue_allreduce(
             name, x, reduce_op=_OP_NAMES[red_op], priority=priority)
         handle._decompress = (compression, ctx)  # applied in synchronize()
@@ -878,8 +939,7 @@ def allgather_async(tensor, name=None, priority=0):
         from horovod_tpu.runtime.runtime import get_runtime
 
         return get_runtime().enqueue_allgather(
-            name, tensor if isinstance(tensor, jax.Array)
-            else jnp.asarray(tensor), priority=priority)
+            name, _to_plane(tensor), priority=priority)
     return Handle(allgather(tensor))
 
 
@@ -888,8 +948,7 @@ def broadcast_async(tensor, root_rank, name=None, priority=0):
         from horovod_tpu.runtime.runtime import get_runtime
 
         return get_runtime().enqueue_broadcast(
-            name, tensor if isinstance(tensor, jax.Array)
-            else jnp.asarray(tensor), root_rank, priority=priority)
+            name, _to_plane(tensor), root_rank, priority=priority)
     return Handle(broadcast(tensor, root_rank))
 
 

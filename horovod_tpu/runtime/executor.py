@@ -201,7 +201,23 @@ class Executor:
             if response.response_type == types.ALLREDUCE:
                 if (self.net is not None and self._spmd_world
                         and self._proc_mesh is not None):
-                    self._execute_allreduce_spmd(entries, timeline)
+                    # 64-bit payloads can't ride the XLA sub-mesh under
+                    # x32 (device_put would narrow them — 2**40 becomes
+                    # garbage); they reduce exactly on the host ring
+                    # instead. The split is deterministic across ranks
+                    # (dtype is part of the negotiated response). Inspect
+                    # dtype via the tensor attribute — np.asarray on a
+                    # jax.Array would device_get every gradient just to
+                    # look at its dtype.
+                    wide, rest = [], []
+                    for e in entries:
+                        dt = e.tensor.dtype  # np.dtype for numpy AND jax
+                        (wide if dt.itemsize == 8 and dt.kind in "iuf"
+                         else rest).append(e)
+                    if rest:
+                        self._execute_allreduce_spmd(rest, timeline)
+                    if wide:
+                        self._execute_allreduce_host(wide, timeline)
                 elif self.net is not None:
                     self._execute_allreduce_host(entries, timeline)
                 else:

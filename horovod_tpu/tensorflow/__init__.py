@@ -26,10 +26,15 @@ from horovod_tpu.tensorflow import mpi_ops
 from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
     grouped_allreduce,
     Average,
+    Max,
+    Min,
+    Product,
     Sum,
     _allreduce,
     allgather,
+    alltoall,
     broadcast,
+    reducescatter,
     cross_rank,
     cross_size,
     ddl_built,

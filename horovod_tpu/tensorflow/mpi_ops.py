@@ -55,6 +55,9 @@ gloo_enabled = gloo_built
 
 Average = _c.Average
 Sum = _c.Sum
+Min = _c.Min
+Max = _c.Max
+Product = _c.Product
 
 # Per-process op counters for auto-generated names, shared convention
 # with the torch binding (torch/mpi_ops.py:33-43): all ranks must issue
@@ -242,6 +245,110 @@ def broadcast(tensor, root_rank, name=None):
             if rank() != root_rank:
                 return tf.zeros_like(summed)
             return summed
+
+        return result, grad
+
+    return fn(tensor)
+
+
+def _rs_a2a_launch(kind, wire_name, red_op=None):
+    """numpy-level launch for reducescatter/alltoall: the enqueue runtime
+    in a multi-process world, the replicated single-controller emulation
+    otherwise (same split as the torch binding — the core eager RS/A2A
+    accept only stacked per-worker input)."""
+    from horovod_tpu.core import basics
+
+    def launch(arr):
+        st = basics._ensure_init()
+        if _c._multiprocess_world(st) and _c._runtime_capable(st):
+            from horovod_tpu.runtime.runtime import get_runtime
+
+            if kind == "reducescatter":
+                h = get_runtime().enqueue_reducescatter(
+                    wire_name, _c._to_plane(arr),
+                    reduce_op=_c._OP_NAMES[red_op])
+            else:
+                h = get_runtime().enqueue_alltoall(
+                    wire_name, _c._to_plane(arr))
+            return np.asarray(_c.synchronize(h))
+        return np.asarray(_c._replicated_rs_a2a(
+            kind, np.asarray(arr), st.size, red_op))
+
+    return launch
+
+
+def reducescatter(tensor, name=None, op=Average):
+    """Reduce across ranks and keep this rank's shard of dim 0 (TPU
+    extension mirroring the core API; role reference:
+    ops/nccl_operations.cc:150-346). ``op`` defaults to Average — the
+    same omitted-op default as the core API (``_resolve_op``) and the
+    torch binding. dim 0 must divide evenly by the world size.
+    Differentiable for Sum/Average: grad(reducescatter) =
+    allgather(grad) (each rank's input slice j contributed to shard j's
+    reduction on its owner)."""
+    tensor = tf.convert_to_tensor(tensor)
+    if tensor.shape.rank == 0:
+        raise ValueError("reducescatter requires a tensor of rank >= 1")
+    if tensor.shape[0] is not None and tensor.shape[0] % size():
+        raise ValueError(
+            f"reducescatter dim 0 ({tensor.shape[0]}) must divide evenly "
+            f"by size ({size()})")
+    if size() == 1:
+        return tf.identity(tensor)
+    wire_name = _op_name("reducescatter", name)
+    out_shape = tf.TensorShape(
+        [None if tensor.shape[0] is None else tensor.shape[0] // size()]
+        + tensor.shape.as_list()[1:])
+
+    @tf.custom_gradient
+    def fn(t):
+        result = _run_collective(
+            _rs_a2a_launch("reducescatter", wire_name, red_op=op),
+            t, t.dtype, out_shape)
+
+        def grad(dy):
+            if op not in (Sum, Average):
+                # the allgather adjoint is only correct for the linear
+                # ops; Min/Max/Product would need argmax routing — fail
+                # loud rather than train on silently wrong gradients
+                raise NotImplementedError(
+                    "reducescatter gradient is defined for Sum/Average "
+                    "only")
+            g = allgather(dy, name=f"{wire_name}.grad")
+            if op == Average:
+                g = g / tf.cast(size(), g.dtype)
+            return g
+
+        return result, grad
+
+    return fn(tensor)
+
+
+def alltoall(tensor, name=None):
+    """Split dim 0 into ``size()`` chunks, send chunk j to rank j,
+    receive one chunk from every rank (TPU extension mirroring the core
+    API). dim 0 must divide evenly by the world size. Differentiable:
+    the exchange is its own adjoint, so grad(alltoall) =
+    alltoall(grad)."""
+    tensor = tf.convert_to_tensor(tensor)
+    if tensor.shape.rank == 0:
+        raise ValueError("alltoall requires a tensor of rank >= 1")
+    if tensor.shape[0] is not None and tensor.shape[0] % size():
+        raise ValueError(
+            f"alltoall dim 0 ({tensor.shape[0]}) must divide evenly by "
+            f"size ({size()})")
+    if size() == 1:
+        return tf.identity(tensor)
+    wire_name = _op_name("alltoall", name)
+
+    @tf.custom_gradient
+    def fn(t):
+        result = _run_collective(
+            _rs_a2a_launch("alltoall", wire_name),
+            t, t.dtype, t.shape)
+
+        def grad(dy):
+            return alltoall(dy, name=f"{wire_name}.grad")
 
         return result, grad
 

@@ -41,6 +41,9 @@ from horovod_tpu.core.basics import (  # noqa: F401 — re-exported lifecycle
 from horovod_tpu.torch.compression import Compression  # noqa: F401
 from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     Average,
+    Max,
+    Min,
+    Product,
     Sum,
     allgather,
     allgather_async,
@@ -48,11 +51,15 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     allreduce_,
     allreduce_async,
     allreduce_async_,
+    alltoall,
+    alltoall_async,
     broadcast,
     broadcast_,
     broadcast_async,
     broadcast_async_,
     poll,
+    reducescatter,
+    reducescatter_async,
     sparse_allreduce_async,
     synchronize,
 )

@@ -24,6 +24,9 @@ from horovod_tpu.ops import collectives as _c
 
 Average = _c.Average
 Sum = _c.Sum
+Min = _c.Min
+Max = _c.Max
+Product = _c.Product
 
 # Per-process op counters for auto-generated names (reference:
 # horovod/torch/mpi_ops_v2.cc GetOpName — "allreduce.noname.<handle>").
@@ -272,6 +275,80 @@ def broadcast_async_(tensor, root_rank, name=None):
     inner = _c.broadcast_async(_to_numpy(tensor), root_rank,
                                name=_op_name("broadcast", name))
     return TorchHandle(inner, tensor)
+
+
+def _multiprocess_runtime() -> bool:
+    from horovod_tpu.core import basics
+
+    st = basics._ensure_init()
+    return _c._multiprocess_world(st) and _c._runtime_capable(st)
+
+
+def reducescatter_async(tensor, op=None, name=None):
+    """Async reduce-scatter: reduce across workers, worker i keeps shard i
+    of dim 0 (TPU extension mirroring the core API — the reference's
+    binding has no reducescatter; role reference:
+    ops/nccl_operations.cc:150-346). ``op`` is one of
+    Sum/Average/Min/Max/Product; omitted means Average — the SAME
+    default as the core API's ``_resolve_op`` (a binding defaulting to
+    Sum would silently return world-times-larger results to code
+    migrating between surfaces). dim 0 must divide evenly by the world
+    size. In the single-controller world (replicated model) the result
+    is worker 0's shard."""
+    world = _world_size()
+    if tensor.shape[0] % world:
+        raise ValueError(
+            f"reducescatter dim 0 ({tensor.shape[0]}) must divide evenly "
+            f"by size ({world})")
+    if world == 1:
+        return _ReadyHandle(tensor.clone())
+    red_op = _c.Average if op is None else op
+    x = _to_numpy(tensor)
+    out_shape = (tensor.shape[0] // world,) + tuple(tensor.shape[1:])
+    if _multiprocess_runtime():
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        inner = get_runtime().enqueue_reducescatter(
+            _op_name("reducescatter", name), _c._to_plane(x),
+            reduce_op=_c._OP_NAMES[red_op])
+        return TorchHandle(inner,
+                           torch.empty(out_shape, dtype=tensor.dtype))
+    result = _c._replicated_rs_a2a("reducescatter", x, world, red_op)
+    return _ReadyHandle(_from_numpy(result, tensor))
+
+
+def reducescatter(tensor, op=None, name=None):
+    """Sync reduce-scatter (see :func:`reducescatter_async`)."""
+    return synchronize(reducescatter_async(tensor, op=op, name=name))
+
+
+def alltoall_async(tensor, name=None):
+    """Async all-to-all: split dim 0 into ``size`` chunks, send chunk j to
+    worker j, receive one chunk from every worker (TPU extension
+    mirroring the core API; enables Ulysses-style sequence exchange).
+    dim 0 must divide evenly by the world size. In the single-controller
+    world (replicated model) the result is worker 0's received tensor."""
+    world = _world_size()
+    if tensor.shape[0] % world:
+        raise ValueError(
+            f"alltoall dim 0 ({tensor.shape[0]}) must divide evenly by "
+            f"size ({world})")
+    if world == 1:
+        return _ReadyHandle(tensor.clone())
+    x = _to_numpy(tensor)
+    if _multiprocess_runtime():
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        inner = get_runtime().enqueue_alltoall(
+            _op_name("alltoall", name), _c._to_plane(x))
+        return TorchHandle(inner, torch.empty_like(tensor))
+    result = _c._replicated_rs_a2a("alltoall", x, world, None)
+    return _ReadyHandle(_from_numpy(result, tensor))
+
+
+def alltoall(tensor, name=None):
+    """Sync all-to-all (see :func:`alltoall_async`)."""
+    return synchronize(alltoall_async(tensor, name=name))
 
 
 # ---------------------------------------------------------------------------

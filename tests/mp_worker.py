@@ -219,6 +219,20 @@ def main():
         np.testing.assert_array_equal(
             np.asarray(hvd.synchronize(h)),
             int(np.prod(np.arange(2, world + 2, dtype=np.int64))))
+        # 64-bit payloads can't ride the x32 XLA sub-mesh; the executor
+        # must route them to the host ring EXACTLY (r5 — found live by
+        # the verify drive: 2**40 came back as garbage pre-fix)
+        h = hvd.allreduce_async(
+            np.array([(1 << 40) + hvd.rank()], np.int64),
+            name="spmd/i64", average=False)
+        out = np.asarray(hvd.synchronize(h))
+        assert out.dtype == np.int64
+        assert out[0] == (1 << 40) * world + world * (world - 1) // 2, out
+        h = hvd.allreduce_async(np.array([1e300], np.float64),
+                                name="spmd/f64", average=False)
+        out = np.asarray(hvd.synchronize(h))
+        assert out.dtype == np.float64 and np.isfinite(out[0]), out
+        np.testing.assert_allclose(out[0], 1e300 * world)
 
     elif scenario == "jit_train":
         # The canonical jax-surface-under-tpurun flow: jax.distributed has
@@ -721,6 +735,114 @@ def main():
             in_specs=(P(hvd.GLOBAL_AXES), P(), P(hvd.GLOBAL_AXES)),
             out_specs=P(), check_vma=False))(experts, gate_w, xe)
         assert np.isfinite(float(mse))
+
+    elif scenario == "dtype_matrix":
+        # Reference-breadth dtype x op sweep over the REAL wire (r5;
+        # reference: test/test_torch.py dtype sweeps ~1,382 LoC,
+        # test_tensorflow.py:152-649 fused many-small + variable-size
+        # allgather per dtype). Values deliberately include payloads
+        # that corrupt if anything narrows to 32-bit (2**40 int64,
+        # 1e300 float64) — the widening shim (runtime/executor.py
+        # _widen_for_ring) and the enqueue conversion (_to_plane) are
+        # exactly where such corruption would hide.
+        import ml_dtypes
+
+        dtypes = [np.uint8, np.int8, np.int16, np.uint16, np.int32,
+                  np.uint32, np.int64, np.float16, ml_dtypes.bfloat16,
+                  np.float32, np.float64, np.bool_]
+
+        def per_rank_value(dti, r):
+            if dti == np.bool_:
+                return bool(r % 2)
+            if dti.kind in "iu":
+                big = (1 << 40) if dti.itemsize == 8 else 0
+                return dti.type(big + 3 * (r + 1))
+            big = 1e300 if dti == np.float64 else 0.0
+            return dti.type(big + 1.5 * (r + 1))
+
+        for dt in dtypes:
+            dti = np.dtype(dt)
+            tag = dti.name
+            x = np.full((6,), per_rank_value(dti, rank), dti)
+            # -- allreduce sum (exact, computed wide then cast like the
+            #    ring kernels)
+            out = np.asarray(hvd.synchronize(hvd.allreduce_async(
+                x, name=f"dm/{tag}/ar", average=False)))
+            assert out.dtype == dti, (tag, out.dtype)
+            wide = np.int64 if dti.kind in "iu" else np.float64
+            expect = np.sum([np.asarray(per_rank_value(dti, r),
+                                        dtype=wide)
+                             for r in range(world)]).astype(dti)
+            np.testing.assert_array_equal(out, np.full((6,), expect),
+                                          err_msg=f"allreduce {tag}")
+            # -- allreduce min (op-generalized ring) for ordered dtypes
+            if dti != np.bool_:
+                out = np.asarray(hvd.synchronize(hvd.allreduce_async(
+                    x, name=f"dm/{tag}/min", op=hvd.Min)))
+                np.testing.assert_array_equal(
+                    out, np.full((6,), per_rank_value(dti, 0), dti),
+                    err_msg=f"min {tag}")
+            # -- broadcast root 1
+            out = np.asarray(hvd.synchronize(hvd.broadcast_async(
+                x, root_rank=1, name=f"dm/{tag}/bc")))
+            assert out.dtype == dti, (tag, out.dtype)
+            np.testing.assert_array_equal(
+                out, np.full((6,), per_rank_value(dti, 1), dti),
+                err_msg=f"broadcast {tag}")
+            # -- variable-size allgather: rank r contributes (r+1, 2)
+            out = np.asarray(hvd.synchronize(hvd.allgather_async(
+                np.full((rank + 1, 2), per_rank_value(dti, rank), dti),
+                name=f"dm/{tag}/agv")))
+            expect = np.concatenate(
+                [np.full((r + 1, 2), per_rank_value(dti, r), dti)
+                 for r in range(world)])
+            assert out.dtype == dti, (tag, out.dtype)
+            np.testing.assert_array_equal(out, expect,
+                                          err_msg=f"allgather {tag}")
+            if dti == np.bool_:
+                continue  # rs/a2a arithmetic on bool is not a contract
+            # -- reducescatter sum: dim 0 = world*2
+            data = np.stack([
+                (np.arange(world * 2 * 3) % 5 + 1).reshape(world * 2, 3)
+                .astype(wide) * np.asarray(per_rank_value(dti, r), wide)
+                for r in range(world)])
+            mine = data[rank].astype(dti)
+            out = np.asarray(hvd.reducescatter(mine, op=hvd.Sum))
+            assert out.dtype == dti, (tag, out.dtype)
+            full = np.sum([data[r].astype(wide) for r in range(world)],
+                          axis=0).astype(dti)
+            np.testing.assert_array_equal(
+                out, full[rank * 2:(rank + 1) * 2],
+                err_msg=f"reducescatter {tag}")
+            # -- alltoall
+            out = np.asarray(hvd.alltoall(mine, name=f"dm/{tag}/a2a"))
+            assert out.dtype == dti, (tag, out.dtype)
+            expect = np.concatenate(
+                [data[j].astype(dti)[rank * 2:(rank + 1) * 2]
+                 for j in range(world)])
+            np.testing.assert_array_equal(out, expect,
+                                          err_msg=f"alltoall {tag}")
+
+        # -- fused many-small ACROSS dtypes: every tensor enqueued before
+        #    any synchronize, so one cycle negotiates and bin-packs the
+        #    whole burst in per-dtype fusion groups (reference:
+        #    test_tensorflow.py fused many-small sweeps)
+        handles = []
+        for dt in dtypes:
+            dti = np.dtype(dt)
+            for i in range(6):
+                arr = np.full((4,), per_rank_value(dti, rank), dti)
+                handles.append((dti, i, hvd.allreduce_async(
+                    arr, name=f"dmf/{dti.name}/{i}", average=False)))
+        for dti, i, h in handles:
+            out = np.asarray(hvd.synchronize(h))
+            wide = np.int64 if dti.kind in "iu" else np.float64
+            expect = np.sum([np.asarray(per_rank_value(dti, r),
+                                        dtype=wide)
+                             for r in range(world)]).astype(dti)
+            np.testing.assert_array_equal(
+                out, np.full((4,), expect),
+                err_msg=f"fused burst {dti.name}/{i}")
 
     elif scenario == "torch_sink":
         # Torch hook-driven optimizer with gradient accumulation, eager

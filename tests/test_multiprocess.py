@@ -69,6 +69,20 @@ def test_collectives_across_processes(world):
 
 
 @pytest.mark.parametrize("world", [2, 3])
+def test_dtype_matrix_across_processes(world):
+    """Reference-breadth dtype x op sweep over the real wire (r5;
+    reference: test/test_torch.py dtype sweeps, test_tensorflow.py
+    fused many-small + variable-size allgather per dtype): 12 dtypes x
+    allreduce(sum,min)/broadcast/variable-size allgather/reducescatter/
+    alltoall, with 64-bit payloads that corrupt if anything narrows,
+    plus a fused many-small burst across every dtype."""
+    procs, outs = _launch("dtype_matrix", world, timeout=180)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK rank=" in out
+
+
+@pytest.mark.parametrize("world", [2, 3])
 def test_skewed_arrival_cycles(world):
     """Workers announcing the same tensor in different cycles — the
     scenario per-tensor negotiation exists for (uncached wait, deferred

@@ -52,6 +52,81 @@ class TestOps:
             assert out.dtype == dtype, dtype
             assert torch.equal(out, x * WORLD), dtype
 
+    ALL_DTYPES = [torch.uint8, torch.int8, torch.int16, torch.int32,
+                  torch.int64, torch.float16, torch.bfloat16,
+                  torch.float32, torch.float64]
+
+    @pytest.mark.parametrize(
+        "dtype", ALL_DTYPES, ids=lambda d: str(d).split(".")[-1])
+    def test_dtype_matrix(self, dtype):
+        """Reference-breadth dtype x op matrix (r5; reference:
+        test/test_torch.py sweeps every op across uint8..fp64). 64-bit
+        payloads carry values that corrupt if anything narrows to
+        32-bit on the data plane (the x32-jax hazard _to_plane guards)."""
+        big = (1 << 40) if dtype in (torch.int64, torch.float64) else 0
+        # position-dependent values (catch chunk-ordering bugs), plus a
+        # beyond-32-bit offset for the 64-bit dtypes
+        x = (torch.arange(WORLD * 2 * 3).reshape(WORLD * 2, 3) % 7
+             + 1 + big).to(dtype)
+        # allreduce sum: 8 identical workers
+        out = hvd.allreduce(x, average=False)
+        assert out.dtype == dtype
+        assert torch.equal(out, x * WORLD), dtype
+        # allgather tiles the replicated tensor
+        out = hvd.allgather(x)
+        assert out.dtype == dtype and out.shape == (WORLD * WORLD * 2, 3)
+        assert torch.equal(out, x.repeat(WORLD, 1))
+        # broadcast identity
+        out = hvd.broadcast(x, root_rank=0)
+        assert out.dtype == dtype
+        assert torch.equal(out, x)
+        # reducescatter sum: worker 0's shard of 8x
+        out = hvd.reducescatter(x, op=hvd.Sum)
+        assert out.dtype == dtype and out.shape == (2, 3)
+        assert torch.equal(out, x[:2] * WORLD), dtype
+        # reducescatter min of identical copies is the shard itself
+        out = hvd.reducescatter(x, op=hvd.Min)
+        assert torch.equal(out, x[:2])
+        # alltoall: worker 0 receives chunk 0 from all 8 identical workers
+        out = hvd.alltoall(x)
+        assert out.dtype == dtype and out.shape == x.shape
+        assert torch.equal(out, x[:2].repeat(WORLD, 1))
+
+    @pytest.mark.parametrize(
+        "dtype", [torch.int32, torch.int64, torch.float32, torch.float64],
+        ids=lambda d: str(d).split(".")[-1])
+    def test_fused_many_small_per_dtype(self, dtype):
+        """Many small async ops enqueued before any synchronize — the
+        runtime negotiates and fuses the burst (reference:
+        test_tensorflow.py fused many-small sweeps)."""
+        big = (1 << 40) if dtype in (torch.int64, torch.float64) else 0
+        handles = [
+            hvd.allreduce_async(
+                torch.full((4,), big + i, dtype=dtype), average=False,
+                name=f"torch_fuse/{str(dtype)}/{i}")
+            for i in range(12)]
+        for i, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            assert out.dtype == dtype
+            assert torch.equal(
+                out, torch.full((4,), (big + i) * WORLD, dtype=dtype)), i
+
+    def test_reducescatter_default_op_is_average(self):
+        """Omitted op means Average on EVERY surface (core _resolve_op,
+        torch, tf) — a binding defaulting to Sum would silently return
+        world-times-larger results to migrating code (r5 review)."""
+        x = torch.full((WORLD * 2, 3), 4.0)
+        out = hvd.reducescatter(x)  # avg of identical copies = the shard
+        assert torch.equal(out, x[:2])
+
+    def test_reducescatter_indivisible_raises(self):
+        with pytest.raises(ValueError, match="divide evenly"):
+            hvd.reducescatter(torch.ones(WORLD * 2 + 1, 3))
+
+    def test_alltoall_indivisible_raises(self):
+        with pytest.raises(ValueError, match="divide evenly"):
+            hvd.alltoall(torch.ones(WORLD + 3, 2))
+
     def test_allreduce_fp16_compression(self):
         x = torch.full((8,), 2.0)
         out = hvd.allreduce(x, compression=hvd.Compression.fp16)
