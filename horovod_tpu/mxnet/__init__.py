@@ -1,28 +1,30 @@
-"""horovod_tpu.mxnet — MXNet-shaped binding for the TPU-native framework.
+"""horovod_tpu.mxnet — MXNet-shaped binding surface (duck-typed).
 
-Rebuild of the reference's MXNet API (reference: horovod/mxnet/__init__.py
-:40-125, horovod/mxnet/mpi_ops.py:53-232): ``DistributedOptimizer`` folds
-the world-size average into ``rescale_grad`` and allreduces gradients with
-per-index names and priority hints; ``DistributedTrainer`` does the same for
-Gluon; ``broadcast_parameters`` syncs a parameter dict from the root. The
-reference pushes async ops into the MXNet engine with write-var
-dependencies and a ``priority`` ordering hint — here the ops ride the same
-data plane as every other binding (XLA collectives / the dynamic enqueue
-runtime), and ``priority`` orders tensors within a runtime cycle.
+Rebuild of the reference's MXNet API surface (reference:
+horovod/mxnet/__init__.py:40-125, horovod/mxnet/mpi_ops.py:53-232):
+``DistributedOptimizer`` folds the world-size average into
+``rescale_grad`` and allreduces gradients with per-index names and
+priority hints; ``broadcast_parameters`` syncs a parameter dict from the
+root. The reference pushes async ops into the MXNet engine with
+write-var dependencies and a ``priority`` ordering hint — here the ops
+ride the same data plane as every other binding (XLA collectives / the
+dynamic enqueue runtime), and ``priority`` orders tensors within a
+runtime cycle.
 
-MXNet itself is EOL and not part of the TPU stack, so the binding is
-duck-typed: ops accept ``mx.nd.NDArray`` when MXNet is importable and any
-numpy-convertible mutable array otherwise, and ``DistributedOptimizer``
-wraps any object with MXNet's optimizer protocol (``rescale_grad``,
-``update(index, weight, grad, state)``). ``DistributedTrainer`` requires
-real Gluon and raises ``ImportError`` without it.
+DELIBERATE LIMIT (PARITY.md "Deliberate limits"): MXNet is EOL
+(archived upstream) and absent from the TPU stack, so this binding is
+duck-typed, not an engine integration — ops accept any
+numpy-convertible mutable array, and ``DistributedOptimizer`` wraps any
+object with MXNet's optimizer protocol (``rescale_grad``,
+``update(index, weight, grad, state)``). The reference's Gluon
+``DistributedTrainer`` (horovod/mxnet/__init__.py:85-107) is NOT
+implemented: a subclass of a class that can never be imported here
+would be dead code no test or user could ever construct; the name
+raises ImportError with a pointer to the covered surfaces instead.
 """
 
 from __future__ import annotations
 
-import warnings
-
-import jax.numpy as jnp
 import numpy as np
 
 from horovod_tpu.core.basics import (  # noqa: F401 — re-exported lifecycle
@@ -49,21 +51,6 @@ from horovod_tpu.core.basics import (  # noqa: F401 — re-exported lifecycle
 from horovod_tpu.core import basics
 from horovod_tpu.ops import collectives as _coll
 
-try:  # pragma: no cover — mxnet absent from the TPU image
-    import mxnet as _mx
-except ImportError:
-    _mx = None
-
-
-def _is_mx(tensor) -> bool:
-    return _mx is not None and isinstance(tensor, _mx.nd.NDArray)
-
-
-def _to_device(tensor):
-    if _is_mx(tensor):  # pragma: no cover — mxnet absent
-        return jnp.asarray(tensor.asnumpy())
-    return jnp.asarray(np.asarray(tensor))
-
 
 def _run_async(kind: str, tensor, *, average: bool = True,
                root_rank: int = 0, name=None, priority: int = 0):
@@ -77,7 +64,7 @@ def _run_async(kind: str, tensor, *, average: bool = True,
     hold (dispatch is still async — the result is a future-backed array).
     """
     st = basics._ensure_init()
-    x = _to_device(tensor)
+    x = np.asarray(tensor)
     if _coll._multiprocess_world(st) and _coll._runtime_capable(st):
         if kind == "allreduce":
             return _coll.allreduce_async(
@@ -109,18 +96,13 @@ def _check_mutable(tensor) -> None:
     """Fail fast on misuse BEFORE the collective runs — an in-place op on
     an immutable input would otherwise waste a full negotiation + dispatch
     on every rank just to raise on write-back."""
-    if _is_mx(tensor):  # pragma: no cover — mxnet absent
-        return
     if not (isinstance(tensor, np.ndarray) and tensor.flags.writeable):
         raise TypeError(
-            "in-place collectives need a mutable array (numpy or "
-            f"mx.nd.NDArray), got {type(tensor)}")
+            "in-place collectives need a mutable numpy array, got "
+            f"{type(tensor)}")
 
 
 def _write_back(tensor, result) -> None:
-    if _is_mx(tensor):  # pragma: no cover — mxnet absent
-        tensor[:] = _mx.nd.array(np.asarray(result), dtype=tensor.dtype)
-        return
     # output dtype == input dtype, as in the reference (the device compute
     # may run narrower, e.g. f64 -> f32 with jax's default x64-off)
     tensor[...] = np.asarray(result).astype(tensor.dtype).reshape(
@@ -128,10 +110,7 @@ def _write_back(tensor, result) -> None:
 
 
 def _like(tensor, result):
-    out = np.asarray(result)
-    if _is_mx(tensor):  # pragma: no cover — mxnet absent
-        return _mx.nd.array(out, dtype=tensor.dtype)
-    return out.astype(np.asarray(tensor).dtype)
+    return np.asarray(result).astype(np.asarray(tensor).dtype)
 
 
 def allreduce(tensor, average=True, name=None, priority=0):
@@ -220,57 +199,26 @@ class DistributedOptimizer:
         self._optimizer.update_multi_precision(index, weight, grad, state)
 
 
-if _mx is not None:  # pragma: no cover — mxnet absent from the TPU image
+class DistributedTrainer:
+    """NOT implemented — deliberate limit, not a gap (see module
+    docstring and PARITY.md). The reference's Gluon trainer (reference:
+    horovod/mxnet/__init__.py:85-107) subclasses ``mx.gluon.Trainer``,
+    which cannot exist without real MXNet; its two behaviors (fold
+    world size into ``_scale``, exchange grads by sorted-name order with
+    priority hints) are covered by :class:`DistributedOptimizer` and
+    the other bindings' trainers."""
 
-    class DistributedTrainer(_mx.gluon.Trainer):
-        """Gluon trainer doing gradient exchange through the framework's
-        allreduce instead of kvstore push/pull (reference:
-        horovod/mxnet/__init__.py:85-107)."""
-
-        def __init__(self, params, optimizer, optimizer_params=None):
-            if isinstance(optimizer, DistributedOptimizer):
-                optimizer = optimizer._optimizer
-                warnings.warn(
-                    "DistributedTrainer does not take DistributedOptimizer "
-                    "as its optimizer. We have unwrapped it for you.")
-            super().__init__(params, optimizer,
-                             optimizer_params=optimizer_params, kvstore=None)
-            self._scale /= size()
-
-        def _allreduce_grads(self):
-            for i, param in enumerate(
-                    sorted(self._params, key=lambda p: p.name)):
-                if param.grad_req != "null":
-                    allreduce_(param.list_grad()[0], average=False,
-                               name=str(i), priority=-i)
-
-else:
-
-    class DistributedTrainer:  # type: ignore[no-redef]
-        """Placeholder: Gluon's Trainer needs real MXNet (reference:
-        horovod/mxnet/__init__.py:85-107). The optimizer-protocol surface
-        is covered by :class:`DistributedOptimizer`."""
-
-        def __init__(self, *args, **kwargs):
-            raise ImportError(
-                "DistributedTrainer requires mxnet, which is not "
-                "installed; use DistributedOptimizer (any MXNet-protocol "
-                "optimizer) or the jax/torch surfaces instead")
+    def __init__(self, *args, **kwargs):
+        raise ImportError(
+            "DistributedTrainer requires mxnet (EOL, not part of the TPU "
+            "stack — see PARITY.md 'Deliberate limits'); use "
+            "DistributedOptimizer (any MXNet-protocol optimizer) or the "
+            "jax/torch/tf surfaces instead")
 
 
 def broadcast_parameters(params, root_rank=0):
     """Broadcast a parameter dict (name → array) in place from
-    ``root_rank`` (reference: horovod/mxnet/__init__.py:118-125; the
-    reference also hooks Gluon ``Parameter._init_impl`` — with real MXNet,
-    pass ``Block.collect_params()`` and each parameter's data is synced).
-    """
-    if _mx is not None and hasattr(params, "items") and all(
-            hasattr(p, "list_data") for p in
-            params.values()):  # pragma: no cover — ParameterDict w/ mxnet
-        tensors = {name: p.data() for name, p in params.items()}
-        for name, t in sorted(tensors.items()):
-            broadcast_(t, root_rank=root_rank, name=name)
-        return
+    ``root_rank`` (reference: horovod/mxnet/__init__.py:118-125)."""
     if not hasattr(params, "items"):
         raise ValueError(f"invalid params of type: {type(params)}")
     for name, t in sorted(params.items()):
