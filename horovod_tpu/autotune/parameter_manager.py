@@ -116,8 +116,24 @@ class ParameterManager:
             alpha=max(gp_noise, 1e-6) * 1e-2)
         self._bo_remaining = bayes_opt_max_samples
 
+        # Every artifact names the knobs actually IN the sweep (r4 review
+        # weak #5: the hierarchical knobs silently leave the sweep on the
+        # socket data plane — correct, but only discoverable by reading
+        # the runtime constructor; the reference logs each trial's full
+        # param vector, parameter_manager.cc:256-307). Continuous knobs
+        # are always swept by the Bayesian phase; categoricals only when
+        # the data plane consults them.
+        self.swept_knobs = ("fusion_threshold_mb",
+                            "cycle_time_ms") + self._sweep
+        if self._rank == 0:  # coordinator only, like the CSV below
+            from horovod_tpu.utils.logging import get_logger
+            get_logger().info(
+                "autotune: sweeping %s (categorical knobs not listed are "
+                "frozen at their configured values on this data plane)",
+                ",".join(self.swept_knobs))
         if self._log_path and self._rank == 0:
             with open(self._log_path, "w") as f:
+                f.write("# swept: " + ",".join(self.swept_knobs) + "\n")
                 f.write("timestamp,fusion_threshold_mb,cycle_time_ms,"
                         "cache_enabled,hierarchical_allreduce,"
                         "hierarchical_allgather,score_bytes_per_us\n")

@@ -122,8 +122,22 @@ class TestParameterManager:
             pm.update(50_000, 0.001)
             guard += 1
         text = log.read_text().strip().splitlines()
-        assert text[0].startswith("timestamp,fusion_threshold_mb")
-        assert len(text) > 3  # one line per scored point
+        # r5: the artifact is self-describing — the first line names the
+        # knobs actually swept in THIS run (the hierarchical knobs leave
+        # the sweep on the socket data plane; r4 review weak #5)
+        assert text[0].startswith("# swept: ")
+        assert "fusion_threshold_mb" in text[0]
+        assert text[1].startswith("timestamp,fusion_threshold_mb")
+        assert len(text) > 4  # one line per scored point
+
+    def test_csv_log_names_swept_categoricals(self, tmp_path):
+        log = tmp_path / "autotune.csv"
+        pm = _mk_manager(log_path=str(log), sweep=("cache_enabled",))
+        header = log.read_text().splitlines()[0]
+        assert header == ("# swept: fusion_threshold_mb,cycle_time_ms,"
+                          "cache_enabled")
+        assert pm.swept_knobs == ("fusion_threshold_mb", "cycle_time_ms",
+                                  "cache_enabled")
 
     def test_params_blob_roundtrip(self):
         p = Params(12345678, 7.25, False, True, False, active=True)
