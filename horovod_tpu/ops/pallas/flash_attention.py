@@ -65,7 +65,13 @@ def _mxu_bf16(*refs) -> bool:
     VPU/softmax-bound, not MXU-rate-bound, so the default stays the f32
     path (better p/ds precision for free). Kept as a measured-excluded
     counter-move and for A/B on future shapes where the MXU term dominates
-    (longer head_dim, causal long-seq)."""
+    (longer head_dim, causal long-seq).
+
+    NOTE (r4 advisor): the env var is read at KERNEL TRACE time — step
+    functions already compiled under jax.jit keep the path they were
+    traced with (jit caches don't key on env). Toggle it before the
+    first call, or restart the process, for a clean A/B; the bench
+    scripts do this via fresh processes."""
     return (env_mod._get_bool("FLASH_MXU_BF16", False)
             and all(r.dtype == jnp.bfloat16 for r in refs))
 
@@ -149,10 +155,17 @@ def _fwd_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
         alpha = jnp.exp2(m_prev - m_safe)
         p = jnp.exp2(s - m_safe[:, None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        if bf16:
+            # the SAME bf16-rounded p feeds both the PV numerator and the
+            # l denominator (summed f32), so the softmax normalisation is
+            # exactly consistent (r4 advisor finding)
+            p = p.astype(jnp.bfloat16)
+            l_new = l_prev * alpha + jnp.sum(p.astype(jnp.float32),
+                                             axis=-1)
+        else:
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p.astype(jnp.bfloat16) if bf16 else p, v,
-            (((1,), (0,)), ((), ())),
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jax.lax.broadcast_in_dim(m_new, m_ref.shape, (0,))
         l_ref[...] = jax.lax.broadcast_in_dim(l_new, l_ref.shape, (0,))

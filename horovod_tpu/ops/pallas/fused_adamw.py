@@ -103,6 +103,12 @@ def _leaf_update(p, m, v, g, scalars, *, eps):
     block_rows = min(rows, _BLOCK // 128)
     while rows % block_rows:
         block_rows -= 1
+    if block_rows < 8:
+        # no decent divisor (e.g. a prime row count): a grid of ~rows
+        # 128-element kernel steps is correct but a severe perf cliff —
+        # the XLA elementwise chain is the better program for such
+        # leaves (r4 advisor finding)
+        return _jnp_leaf(p, m, v, g, scalars, eps)
     flat = lambda a: a.reshape((rows, 128))
     spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
     p2, m2, v2 = pl.pallas_call(

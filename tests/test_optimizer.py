@@ -60,6 +60,29 @@ class TestFusedAdamW:
                                    rtol=2e-5, atol=2e-6)
         assert int(state.count) == 4
 
+    def test_prime_row_leaf_takes_jnp_path(self):
+        """A leaf whose 128-lane row count is prime has no usable block
+        divisor — the r4 advisor flagged that searching down to
+        block_rows=1 builds a grid of per-row kernel steps (correct but a
+        cliff); such leaves must route to the XLA elementwise path and
+        still match optax."""
+        from horovod_tpu.ops.pallas import fused_adamw
+
+        rng = np.random.RandomState(1)
+        # 131 rows of 128 lanes: >= _MIN_PALLAS (16384), n % 128 == 0,
+        # prime row count
+        params = {"prime": jnp.asarray(rng.randn(131 * 128), jnp.float32)}
+        grads = {"prime": jnp.asarray(rng.randn(131 * 128), jnp.float32)}
+        lr, wd = 1e-2, 1e-3
+        ref_tx = optax.adamw(lr, weight_decay=wd)
+        upd, _ = ref_tx.update(grads, ref_tx.init(params), params)
+        ref_p = optax.apply_updates(params, upd)
+        fused = fused_adamw(lr, weight_decay=wd)
+        p, _ = fused.apply(params, fused.init(params), grads)
+        np.testing.assert_allclose(np.asarray(p["prime"]),
+                                   np.asarray(ref_p["prime"]),
+                                   rtol=2e-5, atol=2e-6)
+
 
 class TestDistributedOptimizer:
     def test_shard_map_training_converges(self, hvd):
