@@ -144,6 +144,85 @@ def pack2_attention(q, k, v, sm_scale, block_q=512):
 
 
 # ---------------------------------------------------------------------------
+# simple1: the pack2 kernel WITHOUT packing — one head per step, d=64,
+# single k-block, no online-softmax scratch, no lse output. Isolates how
+# much of pack2's win is the 128-deep contraction vs the single-block
+# simplification (direct softmax, no m/l scratch, no lse write).
+# ---------------------------------------------------------------------------
+
+
+def _simple1_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale):
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * (sm_scale * LOG2E)
+    k = k_ref[0, 0, :, :].astype(jnp.float32)
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp2(s - m[:, None])
+    l = jnp.sum(p, axis=-1)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[0, 0, :, :] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def _simple1_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale):
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * (sm_scale * LOG2E)
+    k = k_ref[0, 0, :, :].astype(jnp.float32)
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp2(s - m[:, None])
+    l = jnp.sum(p, axis=-1)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[0, 0, :, :] = (o / l[:, None]).astype(o_ref.dtype)
+    lse = m * (1.0 / LOG2E) + jnp.log(l)
+    lse_ref[0, 0, :, :] = jax.lax.broadcast_in_dim(
+        lse, lse_ref.shape[2:], (0,))
+
+
+def simple1_lse_attention(q, k, v, sm_scale, block_q=512):
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    grid = (b, h, s // block_q)
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, LANES),
+                            lambda b_, h_, i: (b_, h_, i, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_simple1_lse_kernel, sm_scale=sm_scale),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[q_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * 3),
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return o
+
+
+def simple1_attention(q, k, v, sm_scale, block_q=512):
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    grid = (b, h, s // block_q)
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_simple1_kernel, sm_scale=sm_scale),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * 3),
+        interpret=_use_interpret(),
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
 # slope measurement (protocol of tools/bert_decompose.py)
 # ---------------------------------------------------------------------------
 
@@ -153,7 +232,8 @@ def main():
     ap.add_argument("--shape", default="bert-large", choices=sorted(SHAPES))
     ap.add_argument("--only", required=True,
                     help="flash|flash_grad|xla|xla_grad|stock|stock_grad|"
-                         "pack2|blocks:BQxBK|blocks_grad:BQxBK")
+                         "pack2|simple1|simple1_lse|blocks:BQxBK|"
+                         "blocks_grad:BQxBK")
     cli = ap.parse_args()
     b, h, s, d, causal = SHAPES[cli.shape]
     sm = 1.0 / float(np.sqrt(d))
@@ -187,15 +267,30 @@ def main():
         if name == "pack2":
             assert not causal, "pack2 probe is non-causal (bert shape)"
             return pack2_attention(qc, k0, v0, sm)
+        if name == "simple1":
+            assert not causal, "simple1 probe is non-causal (bert shape)"
+            return simple1_attention(qc, k0, v0, sm)
+        if name == "simple1_lse":
+            assert not causal
+            return simple1_lse_attention(qc, k0, v0, sm)
         raise SystemExit(f"unknown variant {cli.only}")
 
     grad_mode = name.endswith("_grad")
+    # LAYERS amplifies per-iteration work above the tunnel's timing
+    # noise, same as bert_decompose's 24-layer chains; the reported ms
+    # is per single attention call.
+    LAYERS = 12
+
+    def stack(x):
+        for _ in range(LAYERS):
+            x = attn(x)
+        return x
 
     @functools.partial(jax.jit, static_argnames="iters")
     def chain(qc, salt, iters):
         if grad_mode:
             def loss(x):
-                return jnp.mean(attn(x).astype(jnp.float32))
+                return jnp.mean(stack(x).astype(jnp.float32))
 
             def body(x, _):
                 out, g = jax.value_and_grad(loss)(x)
@@ -203,7 +298,7 @@ def main():
                         + jnp.asarray(salt * 1e-12, x.dtype)), out
         else:
             def body(x, _):
-                o = attn(x)
+                o = stack(x)
                 out = jnp.mean(o[:, 0, 0, :].astype(jnp.float32))
                 return x + (1e-6 * out + salt).astype(x.dtype), out
 
@@ -228,6 +323,7 @@ def main():
         slopes.append(((t2 - t1) - (t1 - t0)) / ITERS)
     t = float(np.median(slopes))
 
+    t /= LAYERS  # per single attention call
     flops = attn_flops(b, h, s, d, causal)
     if grad_mode:
         flops *= 3  # bwd recomputes s + 4 dots ~= 2x fwd
