@@ -298,6 +298,20 @@ def _fwd_single_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref,
         compute(block_k)
 
 
+def _single_specs(block_q, block_k, dim, ride):
+    """BlockSpecs for the single-block (b, h, i) grids: ``ride`` names
+    the operand the grid axis walks ("q" or "k"); the opposite side is
+    pinned to block 0 (its whole extent is resident). Returns
+    (q_spec, k_spec, q_row_spec) — the row spec follows the q side
+    (lse/delta are per-q-row, lane-broadcast)."""
+    walk = lambda b, h, i: (b, h, i, 0)
+    pin = lambda b, h, i: (b, h, 0, 0)
+    q_ix, k_ix = (walk, pin) if ride == "q" else (pin, walk)
+    return (pl.BlockSpec((1, 1, block_q, dim), q_ix),
+            pl.BlockSpec((1, 1, block_k, dim), k_ix),
+            pl.BlockSpec((1, 1, block_q, LANES), q_ix))
+
+
 def _make_specs(block_q, block_k, dim):
     """BlockSpecs for a (b, h, q-block, k-block) grid: q-side tiles index by
     the q-block id, k-side tiles by the k-block id — one block of each input
@@ -327,26 +341,15 @@ def _flash_fwd(q, k, v, q_offset, k_offset, *, sm_scale, causal,
     if kv_seq == block_k:
         # whole key sequence in one block: direct softmax, no scratch
         # (see _fwd_single_kernel — measured 2.5x at the bench shapes)
+        sq_spec, sk_spec, srow_spec = _single_specs(
+            block_q, block_k, dim, ride="q")
         o, lse = pl.pallas_call(
             functools.partial(
                 _fwd_single_kernel, sm_scale=sm_scale, causal=causal,
                 block_q=block_q, block_k=block_k),
             grid=grid[:3],
-            in_specs=[
-                _OFF_SPEC, _OFF_SPEC,
-                pl.BlockSpec((1, 1, block_q, dim),
-                             lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_k, dim),
-                             lambda b, h, i: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, block_k, dim),
-                             lambda b, h, i: (b, h, 0, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, 1, block_q, dim),
-                             lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, LANES),
-                             lambda b, h, i: (b, h, i, 0)),
-            ],
+            in_specs=[_OFF_SPEC, _OFF_SPEC, sq_spec, sk_spec, sk_spec],
+            out_specs=[sq_spec, srow_spec],
             out_shape=[
                 jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
                 jax.ShapeDtypeStruct((batch, heads, q_seq, LANES),
@@ -516,6 +519,137 @@ def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
         dv_ref[0, 0, :, :] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
+def _bwd_dq_single_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref,
+                          do_ref, lse_ref, delta_ref, dq_ref,
+                          *, sm_scale, causal, block_q, block_k):
+    """Single-k-block dq: the general kernel's accumulator scratch and
+    per-k-block @pl.when machinery removed (same specialization as
+    _fwd_single_kernel), with the causal wedge — q blocks whose rows
+    never reach the keys' upper half run half-extent dots."""
+    qi = pl.program_id(2)
+    q_start = q_off_ref[0] + qi * block_q
+    k_start = k_off_ref[0]
+    last_q = q_start + block_q - 1
+
+    def compute(bk):
+        bf16 = _mxu_bf16(q_ref, k_ref, v_ref, do_ref)
+        cast = (lambda r, n: r[0, 0, :n, :]) if bf16 else \
+            (lambda r, n: r[0, 0, :n, :].astype(jnp.float32))
+        q = cast(q_ref, block_q)
+        do = cast(do_ref, block_q)
+        k = cast(k_ref, bk)
+        v = cast(v_ref, bk)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse) * LOG2E
+        s = (sm_scale * LOG2E) * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_ids = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp2(s - lse_safe[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_ref[0, 0, :, :] = jax.lax.dot_general(
+            ds.astype(jnp.bfloat16) if bf16 else ds, k,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+
+    if causal:
+        relevant = k_start <= last_q
+        half = block_k // 2
+        if half and block_k % 2 == 0 and half % 128 == 0:
+            needs_hi = last_q >= k_start + half
+
+            @pl.when(needs_hi)
+            def _():
+                compute(block_k)
+
+            @pl.when(jnp.logical_and(relevant,
+                                     jnp.logical_not(needs_hi)))
+            def _():
+                compute(half)
+        else:
+            @pl.when(relevant)
+            def _():
+                compute(block_k)
+
+        @pl.when(jnp.logical_not(relevant))
+        def _():
+            dq_ref[0, 0, :, :] = jnp.zeros_like(dq_ref[0, 0, :, :])
+    else:
+        compute(block_k)
+
+
+def _bwd_dkv_single_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref,
+                           do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                           *, sm_scale, causal, block_q, block_k):
+    """Single-q-block dk/dv: scratch-free like _bwd_dq_single_kernel.
+    (No wedge here — the causal cut for dk/dv runs along k COLUMNS,
+    which does not map to a uniform static extent slice of the q
+    operand.)"""
+    ki = pl.program_id(2)
+    k_start = k_off_ref[0] + ki * block_k
+    q_start = q_off_ref[0]
+    last_q = q_start + block_q - 1
+
+    def compute():
+        bf16 = _mxu_bf16(q_ref, k_ref, v_ref, do_ref)
+        cast = (lambda r: r[0, 0, :, :]) if bf16 else \
+            (lambda r: r[0, 0, :, :].astype(jnp.float32))
+        q = cast(q_ref)
+        k = cast(k_ref)
+        v = cast(v_ref)
+        do = cast(do_ref)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse) * LOG2E
+        s = (sm_scale * LOG2E) * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_ids = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp2(s - lse_safe[:, None])
+        pcast = p.astype(jnp.bfloat16) if bf16 else p
+        dv_ref[0, 0, :, :] = jax.lax.dot_general(
+            pcast, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_ref[0, 0, :, :] = jax.lax.dot_general(
+            ds.astype(jnp.bfloat16) if bf16 else ds, q,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+    if causal:
+        # a kv shard entirely in the future of every q row gets no
+        # gradient (ring contract, mirror of the forward predication)
+        relevant = k_start <= last_q
+
+        @pl.when(relevant)
+        def _():
+            compute()
+
+        @pl.when(jnp.logical_not(relevant))
+        def _():
+            dk_ref[0, 0, :, :] = jnp.zeros_like(dk_ref[0, 0, :, :])
+            dv_ref[0, 0, :, :] = jnp.zeros_like(dv_ref[0, 0, :, :])
+    else:
+        compute()
+
+
 def _bwd_single_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
                        lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
                        *, sm_scale, causal, block_q, block_k):
@@ -585,6 +719,16 @@ def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
     kv_seq = k.shape[2]
     block_q = _pick_block(q_seq, block_q)
     block_k = _pick_block(kv_seq, block_k)
+    if (causal and kv_seq == block_k and block_q == q_seq
+            and q_seq >= 1024 and (q_seq // 2) % 128 == 0
+            and not env_mod._get_bool("FLASH_FUSED_BWD", False)):
+        # single-k-block causal: two q blocks let the dq wedge skip the
+        # first block's upper-half dots (measured r5 at the GPT-2
+        # shape: fwd+bwd 1.697 -> 1.555 ms, incl. the dkv kernel
+        # falling back to the general path). Skipped under the
+        # FLASH_FUSED_BWD A/B so that flag still reaches its fused
+        # kernel at these shapes.
+        block_q = q_seq // 2
 
     if delta is None:
         delta = compute_delta(o, do)
@@ -622,48 +766,95 @@ def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
         )(q_offset, k_offset, q, k, v, do, lse, delta)
         return dq, dk, dv
 
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k),
-        grid=(batch, heads, q_seq // block_q, kv_seq // block_k),
-        in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, k_spec, k_spec, q_spec,
-                  qrow_spec, qrow_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
-        scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
-        compiler_params=_compiler_params(4),
-        interpret=interpret,
-    )(q_offset, k_offset, q, k, v, do, lse, delta)
+    if kv_seq == block_k:
+        # scratch-free single-k-block dq (with causal wedge), any nq
+        sq_spec, sk_spec, srow_spec = _single_specs(
+            block_q, block_k, dim, ride="q")
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_single_kernel, sm_scale=sm_scale, causal=causal,
+                block_q=block_q, block_k=block_k),
+            grid=(batch, heads, q_seq // block_q),
+            in_specs=[_OFF_SPEC, _OFF_SPEC, sq_spec, sk_spec, sk_spec,
+                      sq_spec, srow_spec, srow_spec],
+            out_specs=sq_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",) * 3),
+            interpret=interpret,
+        )(q_offset, k_offset, q, k, v, do, lse, delta)
+    else:
+        dq = None
 
-    # dk/dv: grid over (b, h, k-block, q-block) — q-side tiles stream along
-    # the innermost axis while dk/dv accumulate in scratch.
-    kq_k_spec = pl.BlockSpec((1, 1, block_k, dim),
-                             lambda b, h, i, j: (b, h, i, 0))
-    kq_q_spec = pl.BlockSpec((1, 1, block_q, dim),
-                             lambda b, h, i, j: (b, h, j, 0))
-    kq_qrow_spec = pl.BlockSpec((1, 1, block_q, LANES),
-                                lambda b, h, i, j: (b, h, j, 0))
+    if q_seq == block_q:
+        # scratch-free single-q-block dk/dv, any nk
+        gq_spec, gk_spec, grow_spec = _single_specs(
+            block_q, block_k, dim, ride="k")
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_single_kernel, sm_scale=sm_scale,
+                causal=causal, block_q=block_q, block_k=block_k),
+            grid=(batch, heads, kv_seq // block_k),
+            in_specs=[_OFF_SPEC, _OFF_SPEC, gq_spec, gk_spec, gk_spec,
+                      gq_spec, grow_spec, grow_spec],
+            out_specs=[gk_spec, gk_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct(k.shape, k.dtype, vma=vma),
+                jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",) * 3),
+            interpret=interpret,
+        )(q_offset, k_offset, q, k, v, do, lse, delta)
+    else:
+        dk = dv = None
 
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k),
-        grid=(batch, heads, kv_seq // block_k, q_seq // block_q),
-        in_specs=[_OFF_SPEC, _OFF_SPEC, kq_q_spec, kq_k_spec,
-                  kq_k_spec, kq_q_spec, kq_qrow_spec, kq_qrow_spec],
-        out_specs=[kq_k_spec, kq_k_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype, vma=vma),
-            jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, dim), jnp.float32),
-            pltpu.VMEM((block_k, dim), jnp.float32),
-        ],
-        compiler_params=_compiler_params(4),
-        interpret=interpret,
-    )(q_offset, k_offset, q, k, v, do, lse, delta)
+    if dq is None:
+        # multi-k-block: the general accumulating dq kernel
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                block_q=block_q, block_k=block_k),
+            grid=(batch, heads, q_seq // block_q, kv_seq // block_k),
+            in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, k_spec, k_spec,
+                      q_spec, qrow_spec, qrow_spec],
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+            scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
+            compiler_params=_compiler_params(4),
+            interpret=interpret,
+        )(q_offset, k_offset, q, k, v, do, lse, delta)
+
+    if dk is None:
+        # multi-q-block: general dk/dv — grid over (b, h, k-block,
+        # q-block), q-side tiles streaming along the innermost axis
+        # while dk/dv accumulate in scratch.
+        kq_k_spec = pl.BlockSpec((1, 1, block_k, dim),
+                                 lambda b, h, i, j: (b, h, i, 0))
+        kq_q_spec = pl.BlockSpec((1, 1, block_q, dim),
+                                 lambda b, h, i, j: (b, h, j, 0))
+        kq_qrow_spec = pl.BlockSpec((1, 1, block_q, LANES),
+                                    lambda b, h, i, j: (b, h, j, 0))
+
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                block_q=block_q, block_k=block_k),
+            grid=(batch, heads, kv_seq // block_k, q_seq // block_q),
+            in_specs=[_OFF_SPEC, _OFF_SPEC, kq_q_spec, kq_k_spec,
+                      kq_k_spec, kq_q_spec, kq_qrow_spec, kq_qrow_spec],
+            out_specs=[kq_k_spec, kq_k_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct(k.shape, k.dtype, vma=vma),
+                jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, dim), jnp.float32),
+                pltpu.VMEM((block_k, dim), jnp.float32),
+            ],
+            compiler_params=_compiler_params(4),
+            interpret=interpret,
+        )(q_offset, k_offset, q, k, v, do, lse, delta)
 
     return dq, dk, dv
 
