@@ -27,7 +27,9 @@ salted inputs, true data dependencies):
 
 Shapes: ``--shape bert-large`` (B8 H16 S512 D64, non-causal) and
 ``--shape gpt2`` (B16 H12 S1024 D64, causal) — the bench headline
-attention shapes.
+attention shapes — plus ``--shape longseq16k`` (B1 H8 S16384 D128,
+causal), the docs/benchmarks.md long-context row on the multi-block
+general path (regression guard for the single-block specialization).
 
 Run:  python tools/flash_vpu_probe.py --shape bert-large --only flash
 Each invocation measures ONE variant (a tunnel hiccup loses one row;
@@ -58,6 +60,9 @@ SHAPES = {
     # (batch, heads, seq, head_dim, causal) — the bench headline configs
     "bert-large": (8, 16, 512, 64, False),
     "gpt2": (16, 12, 1024, 64, True),
+    # the docs/benchmarks.md long-context row (r1): multi-k-block
+    # GENERAL path — regression guard for the single-block work
+    "longseq16k": (1, 8, 16384, 128, True),
 }
 ITERS = 8
 ROUNDS = 6
