@@ -235,10 +235,12 @@ def transformer_main(family: str, allow_env: bool = True,
     default_seq = "1024" if causal else "512"
     seq = int(os.environ.get("BENCH_BERT_SEQ", default_seq)
               if allow_env else default_seq)
-    # v5e sweet spots from sweeps: BERT-Base 32 (r2: 16->46.5%,
-    # 32->50.8%, 64->47.7%); BERT-Large 8 (r3: 4->47.4%, 8->56.4%,
-    # 16->53.1%, 24->48.5%, 32->OOM); GPT-2 16
-    default_batch = "8" if large else "16" if causal else "32"
+    # v5e sweet spots, re-swept r5 with the single-block flash kernel
+    # (cheaper attention moved BERT-Base's spot): BERT-Base 48
+    # (r5: 32->182.2k, 48->186.7k, 64->178.6k); BERT-Large 8
+    # (r5: 8x-accum beats 16x4 56.8k; r3: 4->47.4%, 8->56.4%,
+    # 16->53.1%, 32->OOM); GPT-2 16 (r5: 24->122.0k vs 16->130.1k)
+    default_batch = "8" if large else "16" if causal else "48"
     batch = int(os.environ.get("BENCH_BERT_BATCH", default_batch)
                 if allow_env else default_batch)
     vocab = 50257 if causal else 30522
@@ -267,10 +269,12 @@ def transformer_main(family: str, allow_env: bool = True,
     # independent — so keeping the micro-batch at the activation sweet
     # spot and amortizing the update is the large-batch training
     # configuration this chip actually favors. BERT-Large defaults to
-    # the measured winner x8 (r4 sweep: x2 +0%, x4 +7%, x8 +10.8%,
-    # x16 see docs/perf_experiments.md); BERT-Base to x4 (+1.6%); GPT-2
-    # measured a wash (122.1k -> 121.3k at x4) and stays at 1.
-    default_accum = "8" if large else "1" if causal else "4"
+    # x16 (r5 re-sweep with the faster kernel: x8 62.5k, x16 63.6k,
+    # x32 64.1k — x16 is the knee, effective 128 seqs/chip, a standard
+    # large-batch recipe; r4's x8 sweep: x2 +0%, x4 +7%, x8 +10.8%);
+    # BERT-Base to x4 (+1.6%); GPT-2 measured a wash (122.1k -> 121.3k
+    # at x4) and stays at 1.
+    default_accum = "16" if large else "1" if causal else "4"
     if allow_env and os.environ.get("BENCH_FUSED_ADAMW") == "1":
         default_accum = "1"  # the fused-adamw A/B runs un-accumulated
     accum = int(os.environ.get("BENCH_ACCUM", default_accum)
@@ -559,8 +563,8 @@ if __name__ == "__main__":
             # (fn, arg, core?, rough cold-cache cost s, micro-step cap)
             # caps keep rounds in the 10-20 s fidelity band (long enough
             # that the tunnel's ~150 ms dispatch is <2%, short enough to
-            # fit): bert-large 256 -> 32-update ~18 s rounds; bert 128
-            # -> 32-update ~12 s rounds
+            # fit): bert-large 256 at accum 16 -> 16-update ~16 s
+            # rounds; bert 128 at batch 48 -> 32-update ~17 s rounds
             (transformer_main, "bert-large", True, 160, 256),
             (main, "resnet50", True, 45, None),
             (transformer_main, "bert", True, 140, 128),
