@@ -1,6 +1,8 @@
 """Ring attention + Ulysses sequence parallelism vs single-device ground
 truth, on the virtual 8-device CPU mesh (SURVEY.md §4 strategy)."""
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -41,6 +43,62 @@ def test_flash_matches_reference(causal):
     o = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
     ref = attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(o, ref, atol=2e-5)
+
+
+@functools.lru_cache(maxsize=2)
+def _dispatch_ref_grads(causal):
+    """Reference gradients for test_flash_dispatch_matrix — identical
+    across the four block parametrizations, so computed once per
+    causal flag."""
+    q, k, v = _qkv(7)
+
+    def loss(q, k, v):
+        return jnp.mean(attention_reference(
+            q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize(
+    "blocks",
+    [
+        # (block_q, block_k, bwd_block_q, bwd_block_k) spanning the r5
+        # dispatch matrix at S=256:
+        (512, 1024, 1024, 1024),  # single fwd + dq/dkv single (defaults)
+        (128, 1024, 128, 1024),   # single fwd multi-q (wedge), dkv general
+        (512, 1024, 1024, 128),   # dq general, dkv single multi-k
+        (64, 64, 64, 64),         # fully general (online softmax)
+    ],
+    ids=["all-single", "dq-single-wedge", "dkv-single", "all-general"])
+def test_flash_dispatch_matrix(causal, blocks):
+    """The r5 single-block specialization added four dispatch paths
+    (single-k-block direct-softmax fwd with causal wedge; scratch-free
+    dq and dk/dv single kernels composing with the general pair). Every
+    combination must match the reference in both output and gradients
+    — this pins the path selection itself, not just the default."""
+    bq, bk, bbq, bbk = blocks
+    q, k, v = _qkv(7)
+
+    kw = dict(causal=causal, block_q=bq, block_k=bk,
+              bwd_block_q=bbq, bwd_block_k=bbk)
+    o = flash_attention(q, k, v, **kw)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(o, ref, atol=2e-5)
+
+    def loss(fn):
+        # squared output -> the cotangent do = 2*o/n VARIES per row and
+        # block, so a backward BlockSpec indexing the wrong do block
+        # cannot cancel out (a constant cotangent would hide it)
+        return lambda q, k, v: jnp.mean(
+            fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g_ref = _dispatch_ref_grads(causal)
+    g_fl = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, **kw)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5)
 
 
 @pytest.mark.parametrize("causal", [False, True])
