@@ -22,6 +22,14 @@ Canonical usage (mirrors reference: examples/*.py):
 
 from horovod_tpu.version import __version__
 
+# Load the metrics submodule BEFORE binding the hvd.metrics() API below:
+# the first import of a submodule sets it as a package attribute, which
+# would clobber the function whenever internal code lazily imported the
+# module later. Loaded up front, the module sits in sys.modules (where
+# `from horovod_tpu.metrics import ...` resolves it) and the function
+# binding below stays the package attribute.
+import horovod_tpu.metrics  # noqa: F401
+
 from horovod_tpu.core.basics import (
     init,
     shutdown,
@@ -33,6 +41,7 @@ from horovod_tpu.core.basics import (
     cross_rank,
     cross_size,
     mesh,
+    metrics,
     is_homogeneous,
     mpi_built,
     gloo_built,
@@ -107,7 +116,7 @@ __all__ = [
     # lifecycle / topology
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
-    "mesh", "is_homogeneous",
+    "mesh", "metrics", "is_homogeneous",
     "CROSS_AXIS", "LOCAL_AXIS", "GLOBAL_AXES",
     # capability probes
     "mpi_built", "gloo_built", "nccl_built", "ddl_built", "mlsl_built",

@@ -137,6 +137,15 @@ def init(
             st.timeline = Timeline(st.config.timeline_file,
                                    mark_cycles=st.config.timeline_mark_cycles)
 
+        # Prometheus exposition endpoint (HOROVOD_METRICS_PORT): when the
+        # knob is unset, no thread or socket exists — the metrics hot path
+        # stays a plain dict/int update per event.
+        if st.config.metrics_port is not None:
+            from horovod_tpu.metrics import registry as metrics_registry
+
+            port = metrics_registry().serve(st.config.metrics_port)
+            log.debug("metrics endpoint serving on port %d", port)
+
 
 def _jax_dist_initialized() -> bool:
     try:
@@ -163,6 +172,15 @@ def shutdown() -> None:
             st.runtime.stop()
         if st.timeline is not None:
             st.timeline.close()
+        from horovod_tpu.metrics import registry as metrics_registry
+
+        reg = metrics_registry()
+        reg.stop_server()
+        if st.config.metrics_dump:
+            try:
+                reg.dump(st.config.metrics_dump, rank=st.rank)
+            except OSError as exc:
+                log.warning("could not write metrics dump: %s", exc)
         from horovod_tpu.ops import collectives
 
         collectives.clear_compiled_cache()
@@ -203,6 +221,19 @@ def cross_size() -> int:
 def mesh():
     """The global (cross, local) device mesh."""
     return _ensure_init().mesh
+
+
+def metrics() -> dict:
+    """Snapshot of the process-wide runtime metrics registry as a nested
+    JSON-serializable dict: cycle timing, queue depth, cache hit/miss
+    counts, fusion bytes/utilization, per-op collective latency and bytes,
+    stall and timeline health counters (see docs/metrics.md).
+
+    Works before ``init()`` too — the registry is process-global — but
+    counters only move once the runtime is running."""
+    from horovod_tpu.metrics import registry as metrics_registry
+
+    return metrics_registry().snapshot()
 
 
 def is_homogeneous() -> bool:

@@ -138,6 +138,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
               "a common origin (for traces not in the epoch clock "
               "domain).")
 
+    metrics_group = parser.add_argument_group("metrics")
+    _add(metrics_group, "--metrics-summary", dest="metrics_summary",
+         action="store_true",
+         help="Aggregate per-rank metrics dumps (written at shutdown when "
+              "HOROVOD_METRICS_DUMP is set) into a cross-rank min/median/"
+              "max table and exit; dump files follow as positional "
+              "arguments.")
+
     autotune = parser.add_argument_group("autotune")
     _add(autotune, "--autotune", dest="autotune", action="store_true",
          help="Enable Bayesian autotuning of fusion/cycle parameters.")
@@ -300,6 +308,19 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                          align=args.merge_trace_align)
         print(f"merged {n} events from {len(command)} trace(s) into "
               f"{args.merge_trace}")
+        return 0
+    if args.metrics_summary:
+        from horovod_tpu.metrics import format_summary, summarize_dumps
+
+        if not command:
+            sys.stderr.write("tpurun --metrics-summary: no dump files\n")
+            return 2
+        try:
+            rows = summarize_dumps(command)
+        except (OSError, ValueError, KeyError) as exc:
+            sys.stderr.write(f"tpurun --metrics-summary: {exc}\n")
+            return 2
+        print(format_summary(rows, n_ranks=len(command)))
         return 0
     if not command:
         sys.stderr.write("tpurun: no command given\n")

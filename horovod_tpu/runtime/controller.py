@@ -22,6 +22,7 @@ Transport verbs are abstract (reference: controller.h:34-124 ``Bcast``,
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.runtime import fusion
@@ -38,16 +39,28 @@ class MessageTable:
 
     def __init__(self):
         self._table: Dict[str, List[msg.Request]] = {}
+        # name -> monotonic time of the FIRST announcement — the stall
+        # inspector's age baseline (reference: stall_inspector.cc stamps
+        # on IncrementTensorCount, not on its own scan)
+        self._first_request_time: Dict[str, float] = {}
 
     def increment(self, request: msg.Request, world: int) -> bool:
         """Record one worker's announcement; True when all workers have
         announced this tensor."""
         reqs = self._table.setdefault(request.tensor_name, [])
+        if not reqs:
+            self._first_request_time[request.tensor_name] = time.monotonic()
         reqs.append(request)
         return len(reqs) == world
 
     def pop(self, name: str) -> List[msg.Request]:
+        self._first_request_time.pop(name, None)
         return self._table.pop(name, [])
+
+    def first_request_time(self, name: str) -> Optional[float]:
+        """Monotonic timestamp of the first announcement for ``name``, or
+        None if the tensor is not pending."""
+        return self._first_request_time.get(name)
 
     def pending(self) -> Dict[str, List[msg.Request]]:
         return self._table

@@ -19,6 +19,7 @@ staging on TPU.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List
 
 import jax
@@ -27,8 +28,21 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import timeline as timeline_mod
 from horovod_tpu.core import mesh as mesh_mod
+from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.ops import collectives
 from horovod_tpu.runtime import types
+
+_OP_LATENCY = _metrics().histogram(
+    "horovod_executor_op_duration_seconds",
+    "Wall time executing one (possibly fused) response, per op type.",
+    labelnames=("op",))
+_OP_BYTES = _metrics().counter(
+    "horovod_executor_op_bytes_total",
+    "Per-worker payload bytes executed, per op type.", labelnames=("op",))
+_OP_ERRORS = _metrics().counter(
+    "horovod_executor_op_errors_total",
+    "Responses that completed with an error status, per op type.",
+    labelnames=("op",))
 
 
 # reduce_op name -> stacked-axis reducer for the XLA fused programs
@@ -189,11 +203,14 @@ class Executor:
         collective_operations.cc:202-205).
         """
         name0 = entries[0].name if entries else "?"
+        op = response.response_type
+        t0 = time.perf_counter()
         try:
             if timeline is not None:
                 timeline.start(name0, response.response_type)
             if response.response_type == types.ERROR:
                 status = types.Status.PreconditionError(response.error_message)
+                _OP_ERRORS.labels(op=op).inc()
                 for e in entries:
                     e.complete(status, None)
                 return
@@ -252,13 +269,17 @@ class Executor:
                     f"unknown response type {response.response_type}")
 
             ok = types.Status.OK()
+            _OP_BYTES.labels(op=op).inc(
+                sum(types.entry_nbytes(e) for e in entries))
             for e in entries:
                 e.complete(ok, e.output)
         except Exception as exc:  # propagate execution failures as statuses
             status = types.Status.UnknownError(str(exc))
+            _OP_ERRORS.labels(op=op).inc()
             for e in entries:
                 e.complete(status, None)
         finally:
+            _OP_LATENCY.labels(op=op).observe(time.perf_counter() - t0)
             if timeline is not None:
                 timeline.end(name0)
 

@@ -20,8 +20,23 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from horovod_tpu.metrics import RATIO_BUCKETS, registry as _metrics
 from horovod_tpu.runtime import message as msg
 from horovod_tpu.runtime import types
+
+_FUSED_BATCHES = _metrics().counter(
+    "horovod_fusion_batches_total",
+    "Fused allreduce responses carrying more than one tensor.")
+_FUSED_TENSORS = _metrics().counter(
+    "horovod_fusion_tensors_total",
+    "Tensors that left fusion inside a multi-tensor batch.")
+_FUSED_BYTES = _metrics().counter(
+    "horovod_fusion_bytes_total",
+    "Payload bytes across all allreduce responses after bin-packing.")
+_BUFFER_UTILIZATION = _metrics().histogram(
+    "horovod_fusion_buffer_utilization_ratio",
+    "Per-bin fill ratio: fused bytes / HOROVOD_FUSION_THRESHOLD.",
+    buckets=RATIO_BUCKETS)
 
 
 def _dtype_size(dtype: str) -> int:
@@ -137,6 +152,21 @@ def fuse_responses_native(responses: List[msg.Response],
     return fused
 
 
+def _record_fusion_metrics(fused: List[msg.Response],
+                           request_by_name: Dict[str, msg.Request],
+                           threshold_bytes: int) -> None:
+    for resp in fused:
+        if resp.response_type != types.ALLREDUCE:
+            continue
+        nbytes = response_bytes(resp, request_by_name)
+        _FUSED_BYTES.inc(nbytes)
+        if threshold_bytes > 0:
+            _BUFFER_UTILIZATION.observe(nbytes / threshold_bytes)
+        if len(resp.tensor_names) > 1:
+            _FUSED_BATCHES.inc()
+            _FUSED_TENSORS.inc(len(resp.tensor_names))
+
+
 def fuse_responses(responses: List[msg.Response],
                    request_by_name: Dict[str, msg.Request],
                    threshold_bytes: int) -> List[msg.Response]:
@@ -144,9 +174,12 @@ def fuse_responses(responses: List[msg.Response],
     identical — tests/test_native_cycle.py asserts it differentially)."""
     from horovod_tpu.runtime.response_cache import native_cycle_enabled
 
+    fused = None
     if responses and native_cycle_enabled():
         fused = fuse_responses_native(responses, request_by_name,
                                       threshold_bytes)
-        if fused is not None:
-            return fused
-    return fuse_responses_py(responses, request_by_name, threshold_bytes)
+    if fused is None:
+        fused = fuse_responses_py(responses, request_by_name,
+                                  threshold_bytes)
+    _record_fusion_metrics(fused, request_by_name, threshold_bytes)
+    return fused

@@ -22,7 +22,29 @@ import os
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.runtime import message as msg
+
+_CACHE_HITS = _metrics().counter(
+    "horovod_response_cache_hits_total",
+    "Negotiation cache lookups that found a matching cached response.")
+_CACHE_MISSES = _metrics().counter(
+    "horovod_response_cache_misses_total",
+    "Negotiation cache lookups that found no entry for the tensor name.")
+_CACHE_INVALIDATIONS = _metrics().counter(
+    "horovod_response_cache_invalidations_total",
+    "Cached responses dropped (params changed or stale deferred hits).")
+
+
+def _record_lookup(state: "CacheState") -> "CacheState":
+    """Shared hit/miss accounting for the Python and native caches.
+    INVALID lookups count as misses (they re-enter full negotiation);
+    the explicit invalidation is counted separately in invalidate()."""
+    if state == CacheState.HIT:
+        _CACHE_HITS.inc()
+    else:
+        _CACHE_MISSES.inc()
+    return state
 
 
 class CacheState(enum.Enum):
@@ -73,11 +95,11 @@ class ResponseCache:
         well: response_cache.cc cached() is const)."""
         bit = self._name_to_bit.get(request.tensor_name)
         if bit is None or bit not in self._entries:
-            return CacheState.MISS
+            return _record_lookup(CacheState.MISS)
         _, key = self._entries[bit]
         if key == self._params_key(request):
-            return CacheState.HIT
-        return CacheState.INVALID
+            return _record_lookup(CacheState.HIT)
+        return _record_lookup(CacheState.INVALID)
 
     def put(self, response: msg.Response, request: msg.Request) -> int:
         """Insert (or refresh) a single-tensor response; evicts LRU at
@@ -124,6 +146,7 @@ class ResponseCache:
         bit = self._name_to_bit.pop(name, None)
         if bit is not None and self._entries.pop(bit, None) is not None:
             self._release_bit(bit)
+            _CACHE_INVALIDATIONS.inc()
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -162,7 +185,7 @@ class NativeResponseCache:
         key = _pack_params_key(request)
         state = self._lib.hvc_cache_cached(
             self._h, request.tensor_name.encode(), key, len(key))
-        return CacheState(state)
+        return _record_lookup(CacheState(state))
 
     def put(self, response: msg.Response, request: msg.Request) -> int:
         if len(response.tensor_names) != 1:
@@ -190,6 +213,9 @@ class NativeResponseCache:
         return None if bit < 0 else bit
 
     def invalidate(self, name: str) -> None:
+        # count only real drops so the Python/native counters agree
+        if self.bit_for_name(name) is not None:
+            _CACHE_INVALIDATIONS.inc()
         self._lib.hvc_cache_invalidate(self._h, name.encode())
 
     def __len__(self) -> int:

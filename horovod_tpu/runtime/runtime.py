@@ -26,12 +26,25 @@ from typing import Any, Optional
 import jax
 
 from horovod_tpu.core import state as state_mod
+from horovod_tpu.metrics import COUNT_BUCKETS, registry as _metrics
 from horovod_tpu.runtime import message as msg
 from horovod_tpu.runtime import types
 from horovod_tpu.runtime.controller import Controller, LocalController
 from horovod_tpu.runtime.executor import Executor
 from horovod_tpu.runtime.tensor_queue import TensorQueue
 from horovod_tpu.utils import logging as log
+
+_CYCLES = _metrics().counter(
+    "horovod_cycles_total", "Background negotiation+execution cycles run.")
+_CYCLE_DURATION = _metrics().histogram(
+    "horovod_cycle_duration_seconds",
+    "Wall time of one cycle body (negotiation + execution).")
+_CYCLE_TENSORS = _metrics().histogram(
+    "horovod_cycle_tensors",
+    "Tensors agreed for execution per cycle.", buckets=COUNT_BUCKETS)
+_HANDLE_WAIT = _metrics().histogram(
+    "horovod_handle_wait_seconds",
+    "Caller time blocked in RuntimeHandle.wait().")
 
 
 class RuntimeHandle:
@@ -68,12 +81,14 @@ class RuntimeHandle:
         if rt is not None:
             with rt._inflight_lock:
                 rt._waiters += 1
+        t0 = time.monotonic()
         try:
             if not self._event.wait(timeout):
                 raise TimeoutError(
                     f"collective '{self.name}' did not complete within "
                     f"{timeout}s")
         finally:
+            _HANDLE_WAIT.observe(time.monotonic() - t0)
             if rt is not None:
                 with rt._inflight_lock:
                     rt._waiters -= 1
@@ -424,6 +439,9 @@ class Runtime:
         responses, shut_down = self.controller.compute_response_list(
             requests, self._st.config.fusion_threshold_bytes,
             timeline=self.timeline, stall_inspector=self.stall_inspector)
+        _CYCLES.inc()
+        _CYCLE_TENSORS.observe(
+            sum(len(r.tensor_names) for r in responses))
         cycle_bytes = 0
         for response in responses:
             entries = self.queue.get_entries(response.tensor_names)
@@ -450,7 +468,28 @@ class Runtime:
                     raise
         if self._autotune_active:
             self._autotune_sync(cycle_bytes, time.monotonic() - cycle_t0)
+        _CYCLE_DURATION.observe(time.monotonic() - cycle_t0)
+        self._emit_timeline_counters()
         return not shut_down
+
+    def _emit_timeline_counters(self) -> None:
+        """Overlay the quantitative plane on the per-tensor trace: one
+        Chrome ``"C"`` (counter) event per series per cycle, through the
+        same writer and epoch clock domain, so counter curves line up with
+        NEGOTIATE/ALLREDUCE bars in the merged view."""
+        if self.timeline is None:
+            return
+        from horovod_tpu.runtime import fusion as fusion_mod
+        from horovod_tpu.runtime import response_cache as cache_mod
+        from horovod_tpu.runtime import tensor_queue as queue_mod
+
+        self.timeline.counters({
+            "queue_depth": queue_mod._QUEUE_DEPTH.value,
+            "cache_hits": cache_mod._CACHE_HITS.value,
+            "cache_misses": cache_mod._CACHE_MISSES.value,
+            "fusion_bytes": fusion_mod._FUSED_BYTES.value,
+            "cycles": _CYCLES.value,
+        })
 
     def _autotune_sync(self, nbytes: int, seconds: float) -> None:
         """Coordinator scores the cycle and broadcasts current params;

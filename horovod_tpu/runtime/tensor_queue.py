@@ -13,8 +13,16 @@ from __future__ import annotations
 import threading
 from typing import Dict, List
 
+from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.runtime import message as msg
 from horovod_tpu.runtime import types
+
+_QUEUE_DEPTH = _metrics().gauge(
+    "horovod_tensor_queue_depth",
+    "Named tensors enqueued and not yet handed to the executor.")
+_ENQUEUED = _metrics().counter(
+    "horovod_tensor_queue_enqueued_total",
+    "Named tensors accepted into the tensor queue.")
 
 
 class DuplicateNameError(ValueError):
@@ -38,6 +46,8 @@ class TensorQueue:
             self._table[entry.name] = entry
             self._pending.append((-entry.priority, self._seq, request))
             self._seq += 1
+            _ENQUEUED.inc()
+            _QUEUE_DEPTH.set(len(self._table))
 
     def pop_requests(self) -> List[msg.Request]:
         """Drain pending negotiation messages for this cycle, highest
@@ -60,6 +70,7 @@ class TensorQueue:
                 e = self._table.pop(n, None)
                 if e is not None:
                     out.append(e)
+            _QUEUE_DEPTH.set(len(self._table))
             return out
 
     def peek(self, name: str):
@@ -81,5 +92,6 @@ class TensorQueue:
             entries = list(self._table.values())
             self._table.clear()
             self._pending.clear()
+            _QUEUE_DEPTH.set(0)
         for e in entries:
             e.complete(status, None)

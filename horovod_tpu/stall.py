@@ -18,7 +18,17 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.utils import logging as log
+
+_STALL_WARNINGS = _metrics().counter(
+    "horovod_stall_warnings_total",
+    "Tensors reported stalled by the stall inspector (one per tensor per "
+    "warning scan).")
+_STALL_SHUTDOWNS = _metrics().counter(
+    "horovod_stall_shutdowns_total",
+    "Stall scans that exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS and "
+    "triggered a global shutdown.")
 
 
 class StallInspector:
@@ -29,7 +39,11 @@ class StallInspector:
         self.shutdown_time = shutdown_time_seconds
         self.enabled = enabled
         self._last_check = time.monotonic()
-        # tensor name -> first time observed incomplete
+        # tensor name -> first time observed incomplete. Fallback baseline
+        # only: the message table's arrival stamp is preferred (see check),
+        # so age is measured from the actual announcement, not from the
+        # first scan that happened to notice it (which under-ages stalls
+        # by up to one warning interval — ~2x delay before the warning).
         self._first_seen: Dict[str, float] = {}
 
     def check(self, message_table, cache=None, world: Optional[int] = None
@@ -48,9 +62,16 @@ class StallInspector:
         stalled_msgs = []
         shutdown = False
         seen_names = set()
+        arrival_time = getattr(message_table, "first_request_time", None)
         for name, requests in pending.items():
             seen_names.add(name)
-            first = self._first_seen.setdefault(name, now)
+            # age from the request's arrival stamp carried in the message
+            # table (reference: stall_inspector.cc keeps the timestamp with
+            # the table entry); scan-time baseline only for tables that do
+            # not carry one
+            first = arrival_time(name) if arrival_time is not None else None
+            if first is None:
+                first = self._first_seen.setdefault(name, now)
             age = now - first
             if age < self.warning_time:
                 continue
@@ -72,6 +93,7 @@ class StallInspector:
                             if k in seen_names}
 
         if stalled_msgs:
+            _STALL_WARNINGS.inc(len(stalled_msgs))
             log.warning(
                 "One or more tensors were submitted to be reduced, gathered "
                 "or broadcasted by subset of ranks and are waiting for "
@@ -81,7 +103,9 @@ class StallInspector:
                 "submitting tensors. Stalled ops: %s",
                 self.warning_time, "; ".join(stalled_msgs))
         if shutdown:
+            _STALL_SHUTDOWNS.inc()
             log.error(
-                "Stalled tensors exceeded HOROVOD_STALL_SHUTDOWN_TIME_"
-                "SECONDS (%.0fs); shutting down.", self.shutdown_time)
+                "Stalled tensors exceeded "
+                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (%.0fs); "
+                "shutting down.", self.shutdown_time)
         return shutdown

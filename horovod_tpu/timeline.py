@@ -35,6 +35,13 @@ import threading
 import time
 from typing import Optional
 
+from horovod_tpu.metrics import registry as _metrics
+
+_TL_DROPPED = _metrics().counter(
+    "horovod_timeline_events_dropped_total",
+    "Timeline events discarded after the writer became unhealthy or its "
+    "ring overflowed.")
+
 # Activity names (reference: horovod/common/common.h:31-58)
 NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
 NEGOTIATE_ALLGATHER = "NEGOTIATE_ALLGATHER"
@@ -65,12 +72,15 @@ class _NativeWriter:
              name: Optional[str] = None, args: Optional[dict] = None,
              s: Optional[str] = None) -> None:
         if not self._handle:  # closed — drop rather than use-after-free
+            _TL_DROPPED.inc()
             return
-        self._lib.hvd_tl_emit(
-            self._handle, ph.encode(), pid, ts_us,
-            name.encode() if name else None,
-            json.dumps(args).encode() if args else None,
-            s.encode() if s else None)
+        if self._lib.hvd_tl_emit(
+                self._handle, ph.encode(), pid, ts_us,
+                name.encode() if name else None,
+                json.dumps(args).encode() if args else None,
+                s.encode() if s else None):
+            # nonzero return: ring overflow or oversize event (timeline.cc)
+            _TL_DROPPED.inc()
 
     def close(self) -> None:
         if self._handle:
@@ -98,6 +108,7 @@ class _Writer:
              name: Optional[str] = None, args: Optional[dict] = None,
              s: Optional[str] = None) -> None:
         if not self._healthy:
+            _TL_DROPPED.inc()
             return
         event = {"ph": ph, "pid": pid, "ts": ts_us}
         if name:
@@ -115,15 +126,42 @@ class _Writer:
     def _run(self) -> None:
         try:
             while True:
-                item = self._q.get()
+                try:
+                    item = self._q.get(timeout=1.0)
+                except queue.Empty:
+                    # periodic flush: a killed process leaves a readable
+                    # (truncated-array) trace instead of a buffered void —
+                    # merge_traces tolerates the truncation
+                    self._flush()
+                    continue
                 if item is self._CLOSE:
                     break
-                self._file.write(json.dumps(item) + ",\n")
+                if not self._healthy:
+                    _TL_DROPPED.inc()
+                    continue
+                try:
+                    self._file.write(json.dumps(item) + ",\n")
+                    if self._q.empty():
+                        self._flush()
+                except (OSError, ValueError):
+                    self._healthy = False
+                    _TL_DROPPED.inc()
         finally:
             # Chrome tracing tolerates a trailing comma with no closing
             # bracket, but we close the array properly.
-            self._file.write("{}]\n")
-            self._file.close()
+            try:
+                self._file.write("{}]\n")
+                self._file.close()
+            except (OSError, ValueError):
+                pass
+            self._healthy = False
+
+    def _flush(self) -> None:
+        if not self._healthy:
+            return
+        try:
+            self._file.flush()
+        except (OSError, ValueError):
             self._healthy = False
 
 
@@ -196,6 +234,19 @@ class Timeline:
 
     def end(self, tensor_name: str, op_name: Optional[str] = None) -> None:
         self._emit(tensor_name, "E")
+
+    def counters(self, values: dict) -> None:
+        """Chrome ``"C"`` (counter) events — one series per key, all on
+        pid 0 with a shared timestamp, so runtime counters (queue depth,
+        cache hits, fused bytes, ...) graph as stacked curves above the
+        per-tensor lanes in the same clock domain. The runtime calls this
+        once per cycle; merge_traces preserves the events across pid
+        remapping (docs/metrics.md)."""
+        with self._lock:
+            ts = self._ts_us()
+            for name, value in values.items():
+                self._writer.emit("C", 0, ts, name=name,
+                                  args={"value": value})
 
     def mark_cycle_start(self) -> None:
         """Optional per-cycle instant markers (reference: timeline.h:98,

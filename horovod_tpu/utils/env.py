@@ -24,6 +24,8 @@ HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
 HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
 HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
+HOROVOD_METRICS_DUMP = "HOROVOD_METRICS_DUMP"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
@@ -88,6 +90,9 @@ class Config:
     cache_capacity: int = DEFAULT_CACHE_CAPACITY
     timeline_file: str = ""
     timeline_mark_cycles: bool = False
+    # None = endpoint disabled (no thread, no socket); 0 = ephemeral port
+    metrics_port: "int | None" = None
+    metrics_dump: str = ""
     autotune: bool = False
     autotune_probe: bool = False
     autotune_log: str = ""
@@ -111,6 +116,10 @@ class Config:
             cache_capacity=_get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
             timeline_file=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_get_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            metrics_port=(
+                _get_int(HOROVOD_METRICS_PORT, 0)
+                if os.environ.get(HOROVOD_METRICS_PORT, "") != "" else None),
+            metrics_dump=os.environ.get(HOROVOD_METRICS_DUMP, ""),
             autotune=_get_bool(HOROVOD_AUTOTUNE),
             autotune_probe=_get_bool(HOROVOD_AUTOTUNE_PROBE),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG, ""),

@@ -1,0 +1,64 @@
+"""Environment-knob contract: every HOROVOD_* var referenced in code is
+documented, and every documented var still exists (tools/check_env_knobs.py
+keeps the two trees from drifting)."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "tools", "check_env_knobs.py")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location("check_env_knobs", CHECKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_env_knob_contract_holds():
+    """The repo's actual contract: no undocumented and no stale knobs."""
+    mod = _load_checker()
+    undocumented, stale = mod.check()
+    assert not undocumented, (
+        f"HOROVOD_* vars referenced in code but absent from docs/ and "
+        f"README.md: {sorted(undocumented)}")
+    assert not stale, (
+        f"HOROVOD_* vars documented but no longer referenced in code: "
+        f"{sorted(stale)}")
+
+
+def test_checker_cli_exit_codes(tmp_path):
+    assert subprocess.run([sys.executable, CHECKER]).returncode == 0
+    # a tree with drift in both directions exits nonzero and names it
+    (tmp_path / "horovod_tpu").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "horovod_tpu" / "a.py").write_text(
+        'os.environ["HOROVOD_SECRET_KNOB"]\n')
+    (tmp_path / "docs" / "a.md").write_text("`HOROVOD_REMOVED_KNOB`\n")
+    out = subprocess.run([sys.executable, CHECKER, str(tmp_path)],
+                         capture_output=True, text=True)
+    assert out.returncode == 1
+    assert "HOROVOD_SECRET_KNOB" in out.stderr
+    assert "HOROVOD_REMOVED_KNOB" in out.stderr
+
+
+def test_wildcards_and_fragments(tmp_path):
+    mod = _load_checker()
+    (tmp_path / "horovod_tpu").mkdir()
+    (tmp_path / "docs").mkdir()
+    # a wrapped string literal leaves a trailing-underscore fragment that
+    # must not count as its own knob
+    (tmp_path / "horovod_tpu" / "a.py").write_text(
+        '"HOROVOD_LONG_KNOB_"\n"NAME"\n"HOROVOD_LONG_KNOB_NAME"\n'
+        '"HOROVOD_FAMILY_MEMBER_A"\n"HOROVOD_FAMILY_MEMBER_B"\n')
+    # docs cover the knob exactly and the family by wildcard prefix;
+    # prose like HOROVOD_WITH[OUT]_* names a family, not a knob
+    (tmp_path / "docs" / "a.md").write_text(
+        "`HOROVOD_LONG_KNOB_NAME` and the `HOROVOD_FAMILY_*` knobs, "
+        "HOROVOD_WITH[OUT]_* style.\n")
+    undocumented, stale = mod.check(tmp_path)
+    assert undocumented == set()
+    assert stale == set()

@@ -1,0 +1,354 @@
+"""Runtime metrics subsystem tests: registry semantics, Prometheus
+exposition, the HTTP endpoint, runtime instrumentation driven by real
+collectives on the CPU mesh, per-rank dumps and the cross-rank
+``tpurun --metrics-summary`` aggregation."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.metrics import (COUNT_BUCKETS, MetricsRegistry,
+                                 flatten_snapshot, format_summary, registry,
+                                 summarize_dumps)
+
+
+def _scalar(snap, name):
+    """Unlabeled counter/gauge value from a snapshot, 0 if absent."""
+    fam = snap.get(name)
+    if not fam or not fam["values"]:
+        return 0
+    return fam["values"][0]["value"]
+
+
+def _hist(snap, name, label=None):
+    """Histogram child dict {count, sum, buckets}, empty if absent."""
+    fam = snap.get(name)
+    if not fam:
+        return {"count": 0, "sum": 0.0, "buckets": []}
+    for entry in fam["values"]:
+        if label is None or label.items() <= entry["labels"].items():
+            return entry["value"]
+    return {"count": 0, "sum": 0.0, "buckets": []}
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", "a counter")
+        c.inc()
+        c.inc(4)
+        g = reg.gauge("g", "a gauge")
+        g.set(7)
+        g.inc(2)
+        g.dec()
+        h = reg.histogram("h_seconds", "a histogram", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = reg.snapshot()
+        assert _scalar(snap, "c_total") == 5
+        assert _scalar(snap, "g") == 8
+        hist = _hist(snap, "h_seconds")
+        assert hist["count"] == 3 and hist["sum"] == 55.5
+        # cumulative le buckets, +Inf last
+        assert hist["buckets"] == [[1.0, 1], [10.0, 2], ["+Inf", 3]]
+        # snapshot must be JSON-serializable end to end
+        json.dumps(snap)
+
+    def test_creation_is_idempotent_by_name(self):
+        reg = MetricsRegistry()
+        a = reg.counter("same_total")
+        b = reg.counter("same_total")
+        assert a is b
+        a.inc()
+        assert b.value == 1
+
+    def test_labeled_children(self):
+        reg = MetricsRegistry()
+        c = reg.counter("ops_total", "per-op", labelnames=("op",))
+        c.labels(op="ALLREDUCE").inc(3)
+        c.labels(op="BROADCAST").inc()
+        snap = reg.snapshot()
+        vals = {tuple(e["labels"].items()): e["value"]
+                for e in snap["ops_total"]["values"]}
+        assert vals[(("op", "ALLREDUCE"),)] == 3
+        assert vals[(("op", "BROADCAST"),)] == 1
+
+    def test_histogram_le_boundary_is_inclusive(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("b", buckets=COUNT_BUCKETS)
+        h.observe(8.0)  # v == bound -> that bucket, not the next
+        snap = _hist(reg.snapshot(), "b")
+        by_bound = dict((str(b), c) for b, c in snap["buckets"])
+        assert by_bound["8.0"] == 1 and by_bound["4.0"] == 0
+
+    def test_prometheus_text_format(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", 'help with "quotes"').inc(2)
+        reg.histogram("h", "lat", buckets=(0.5,),
+                      labelnames=("op",)).labels(op='a"b').observe(0.1)
+        text = reg.prometheus_text()
+        assert "# TYPE c_total counter" in text
+        assert "c_total 2" in text
+        assert "# TYPE h histogram" in text
+        assert 'h_bucket{op="a\\"b",le="0.5"} 1' in text
+        assert 'h_bucket{op="a\\"b",le="+Inf"} 1' in text
+        assert 'h_count{op="a\\"b"} 1' in text
+        assert text.endswith("\n")
+
+
+class TestHttpEndpoint:
+    def test_serve_and_stop(self):
+        reg = MetricsRegistry()
+        reg.counter("up_total", "liveness").inc()
+        port = reg.serve(0)  # ephemeral
+        try:
+            assert reg.http_port == port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain; version=0.0.4")
+                body = resp.read().decode()
+            assert "up_total 1" in body
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=5)
+        finally:
+            reg.stop_server()
+        assert reg.http_port is None
+
+    def test_no_socket_when_env_unset(self, hvd_flat):
+        # HOROVOD_METRICS_PORT unset -> init() must not create the
+        # endpoint (zero idle cost)
+        assert registry().http_port is None
+
+    def test_init_starts_endpoint_from_env(self, tmp_path, monkeypatch):
+        import horovod_tpu as hvd
+
+        hvd.shutdown()
+        monkeypatch.setenv("HOROVOD_METRICS_PORT", "0")
+        hvd.init(mesh_shape=(1, 8))
+        try:
+            port = registry().http_port
+            assert port is not None and port > 0
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+                assert b"horovod_" in resp.read()
+        finally:
+            hvd.shutdown()
+        assert registry().http_port is None  # shutdown() stops it
+
+
+class TestRuntimeInstrumentation:
+    def test_collectives_move_the_metrics(self, hvd):
+        """Real named collectives through the background cycle must move
+        cycle timing, queue, cache, fusion, executor and handle-wait
+        metrics (the acceptance path for the whole subsystem)."""
+        before = hvd.metrics()
+
+        def round_trip():
+            vals = [np.full((8,), r, "float32") for r in range(hvd.size())]
+            h = hvd.allreduce_async(hvd.stack_per_worker(vals),
+                                    average=False, name="metrics.grad")
+            out = hvd.synchronize(h)
+            np.testing.assert_allclose(
+                np.asarray(out), np.sum(np.stack(vals), 0))
+
+        round_trip()
+        round_trip()  # second negotiation of the same name: cache hit
+        after = hvd.metrics()
+
+        def delta(name):
+            return _scalar(after, name) - _scalar(before, name)
+
+        assert delta("horovod_cycles_total") >= 2
+        assert delta("horovod_tensor_queue_enqueued_total") == 2
+        assert delta("horovod_response_cache_misses_total") >= 1
+        assert delta("horovod_response_cache_hits_total") >= 1
+        # 2 rounds x one (8,) float32 per-worker tensor
+        assert delta("horovod_fusion_bytes_total") == 2 * 8 * 4
+        assert _scalar(after, "horovod_tensor_queue_depth") == 0
+
+        cyc = _hist(after, "horovod_cycle_duration_seconds")
+        assert cyc["count"] >= 2 and cyc["sum"] > 0
+        tens = (_hist(after, "horovod_cycle_tensors")["count"]
+                - _hist(before, "horovod_cycle_tensors")["count"])
+        assert tens >= 2
+        wait = (_hist(after, "horovod_handle_wait_seconds")["count"]
+                - _hist(before, "horovod_handle_wait_seconds")["count"])
+        assert wait == 2
+
+        lat = _hist(after, "horovod_executor_op_duration_seconds",
+                    label={"op": "ALLREDUCE"})
+        assert lat["count"] >= 2
+
+        def op_bytes(snap):
+            fam = snap.get("horovod_executor_op_bytes_total", {})
+            return sum(e["value"] for e in fam.get("values", [])
+                       if e["labels"].get("op") == "ALLREDUCE")
+
+        assert op_bytes(after) - op_bytes(before) >= 2 * 8 * 4
+
+    def test_fusion_batch_metrics(self):
+        """Multi-tensor bins are counted with their utilization at the
+        unit level (the integration path fuses one tensor per cycle)."""
+        from horovod_tpu.runtime import fusion
+        from horovod_tpu.runtime import message as msg
+        from horovod_tpu.runtime import types
+
+        before = registry().snapshot()
+        reqs = {
+            n: msg.Request(0, types.ALLREDUCE, n, "float32", (16,),
+                           reduce_op=types.REDUCE_SUM)
+            for n in ("fa", "fb")
+        }
+        responses = [msg.Response(types.ALLREDUCE, ["fa"]),
+                     msg.Response(types.ALLREDUCE, ["fb"])]
+        fused = fusion.fuse_responses(responses, reqs,
+                                      threshold_bytes=1 << 20)
+        assert len(fused) == 1 and len(fused[0].tensor_names) == 2
+        after = registry().snapshot()
+        assert (_scalar(after, "horovod_fusion_batches_total")
+                - _scalar(before, "horovod_fusion_batches_total")) == 1
+        assert (_scalar(after, "horovod_fusion_tensors_total")
+                - _scalar(before, "horovod_fusion_tensors_total")) == 2
+        util = _hist(after, "horovod_fusion_buffer_utilization_ratio")
+        assert util["count"] >= 1
+
+    def test_timeline_counter_overlay(self, tmp_path, monkeypatch):
+        """With HOROVOD_TIMELINE active the runtime emits Chrome "C"
+        counter events each cycle, in the same trace as the per-tensor
+        bars."""
+        import horovod_tpu as hvd
+
+        hvd.shutdown()
+        path = str(tmp_path / "trace.json")
+        monkeypatch.setenv("HOROVOD_TIMELINE", path)
+        hvd.init(mesh_shape=(1, 8))
+        try:
+            h = hvd.allreduce_async(
+                hvd.stack_per_worker(
+                    [np.ones((4,), "float32")] * hvd.size()),
+                average=False, name="overlay.grad")
+            hvd.synchronize(h)
+        finally:
+            hvd.shutdown()
+        events = json.load(open(path))
+        counters = [e for e in events if e.get("ph") == "C"]
+        names = {e["name"] for e in counters}
+        assert {"queue_depth", "cache_hits", "cache_misses",
+                "fusion_bytes", "cycles"} <= names
+        assert all("value" in e["args"] for e in counters)
+        # same epoch-microsecond clock domain as the per-tensor events
+        b_ts = [e["ts"] for e in events if e.get("ph") == "B"]
+        assert b_ts and counters[0]["ts"] > 0
+
+    def test_stall_metrics_and_arrival_baseline(self):
+        """The stall age baseline is the request's arrival in the message
+        table, so a warning fires on the first scan past warning_time —
+        not one full interval later — and warnings/shutdowns count."""
+        import time as _time
+
+        from horovod_tpu.runtime import message as msg
+        from horovod_tpu.runtime import types
+        from horovod_tpu.runtime.controller import MessageTable
+        from horovod_tpu.stall import StallInspector
+
+        before = registry().snapshot()
+        table = MessageTable()
+        table.increment(
+            msg.Request(0, types.ALLREDUCE, "stalled", "float32", (1,)),
+            world=2)
+        t_arrival = table.first_request_time("stalled")
+        assert t_arrival is not None
+
+        insp = StallInspector(warning_time_seconds=0.05,
+                              shutdown_time_seconds=0.1)
+        _time.sleep(0.12)
+        # single scan, age measured from arrival: already past BOTH
+        # thresholds (the old first-scan baseline would report age 0 here)
+        assert insp.check(table, world=2) is True
+        after = registry().snapshot()
+        assert (_scalar(after, "horovod_stall_warnings_total")
+                - _scalar(before, "horovod_stall_warnings_total")) == 1
+        assert (_scalar(after, "horovod_stall_shutdowns_total")
+                - _scalar(before, "horovod_stall_shutdowns_total")) == 1
+        # pop clears the arrival stamp
+        table.pop("stalled")
+        assert table.first_request_time("stalled") is None
+
+
+class TestDumpAndSummary:
+    def _write_dump(self, path, rank, cycles, wait_sum, wait_count):
+        reg = MetricsRegistry()
+        reg.counter("horovod_cycles_total").inc(cycles)
+        h = reg.histogram("horovod_handle_wait_seconds", buckets=(1.0,))
+        for _ in range(wait_count):
+            h.observe(wait_sum / wait_count)
+        with open(path, "w") as f:
+            json.dump({"rank": rank, "metrics": reg.snapshot()}, f)
+
+    def test_summarize_dumps_min_median_max(self, tmp_path):
+        paths = []
+        for rank, cycles in enumerate((10, 30, 20)):
+            p = str(tmp_path / f"metrics-rank-{rank}.json")
+            self._write_dump(p, rank, cycles, wait_sum=cycles / 10.0,
+                             wait_count=2)
+            paths.append(p)
+        rows = dict((r[0], r[1:]) for r in summarize_dumps(paths))
+        assert rows["horovod_cycles_total"] == (10, 20, 30)
+        lo, mid, hi = rows["horovod_handle_wait_seconds.mean"]
+        assert (lo, mid, hi) == (0.5, 1.0, 1.5)
+        text = format_summary(summarize_dumps(paths), n_ranks=3)
+        assert text.splitlines()[0] == "cross-rank metrics summary (3 ranks)"
+        assert "metric" in text and "median" in text
+
+    def test_tpurun_metrics_summary_cli(self, tmp_path, capsys):
+        from horovod_tpu.run.run import run_commandline
+
+        p0 = str(tmp_path / "m0.json")
+        p1 = str(tmp_path / "m1.json")
+        self._write_dump(p0, 0, cycles=5, wait_sum=1.0, wait_count=1)
+        self._write_dump(p1, 1, cycles=9, wait_sum=3.0, wait_count=1)
+        rc = run_commandline(["--metrics-summary", p0, p1])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "cross-rank metrics summary (2 ranks)" in out
+        assert "horovod_cycles_total" in out
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("horovod_cycles_total")][0]
+        assert line.split()[1:] == ["5", "7", "9"]
+
+    def test_cli_errors(self, tmp_path, capsys):
+        from horovod_tpu.run.run import run_commandline
+
+        assert run_commandline(["--metrics-summary"]) == 2
+        bad = str(tmp_path / "nope.json")
+        assert run_commandline(["--metrics-summary", bad]) == 2
+
+    def test_registry_dump_layouts(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("x_total").inc()
+        # directory layout
+        d = str(tmp_path / "dumps")
+        out = reg.dump(d, rank=3)
+        assert out.endswith("metrics-rank-3.json")
+        # {rank} placeholder
+        out2 = reg.dump(str(tmp_path / "m-{rank}.json"), rank=1)
+        assert out2.endswith("m-1.json")
+        data = json.load(open(out2))
+        assert data["rank"] == 1
+        assert data["metrics"]["x_total"]["values"][0]["value"] == 1
+
+    def test_shutdown_writes_dump(self, tmp_path, monkeypatch):
+        import horovod_tpu as hvd
+
+        hvd.shutdown()
+        d = str(tmp_path / "dumps")
+        monkeypatch.setenv("HOROVOD_METRICS_DUMP", d)
+        hvd.init(mesh_shape=(1, 8))
+        hvd.shutdown()
+        data = json.load(open(f"{d}/metrics-rank-0.json"))
+        assert "metrics" in data and data["rank"] == 0

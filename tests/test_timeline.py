@@ -160,3 +160,88 @@ def test_merge_traces_align_and_truncated(tmp_path):
     merged = json.load(open(out))["traceEvents"]
     by_name = {e.get("name"): e for e in merged if e.get("ph") == "B"}
     assert by_name["x"]["ts"] == 0.0 and by_name["y"]["ts"] == 0.0
+
+
+def test_timeline_counters_emit_c_events(tmp_path):
+    """Timeline.counters writes Chrome "C" counter events on pid 0 with a
+    shared timestamp (the per-cycle metrics overlay)."""
+    path = str(tmp_path / "trace.json")
+    tl = Timeline(path)
+    tl.counters({"queue_depth": 3, "cache_hits": 7})
+    tl.counters({"queue_depth": 0, "cache_hits": 9})
+    tl.close()
+    events = json.load(open(path))
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert len(counters) == 4
+    assert all(e["pid"] == 0 for e in counters)
+    depth = [e["args"]["value"] for e in counters
+             if e["name"] == "queue_depth"]
+    assert depth == [3, 0]
+    # both series in one counters() call share one timestamp
+    first_two = [e["ts"] for e in counters[:2]]
+    assert first_two[0] == first_two[1]
+
+
+def test_merge_traces_preserves_counter_events(tmp_path):
+    """Merged "C" events survive with remapped pids: two ranks' counter
+    overlays land on distinct pids and keep their values."""
+    from horovod_tpu.timeline import merge_traces
+
+    r0, r1 = str(tmp_path / "r0.json"), str(tmp_path / "r1.json")
+    for path, depth in [(r0, 5), (r1, 11)]:
+        tl = Timeline(path)
+        tl.negotiate_start("g", "ALLREDUCE")
+        tl.negotiate_end("g")
+        tl.counters({"queue_depth": depth})
+        tl.close()
+    out = str(tmp_path / "merged.json")
+    merge_traces(out, [r0, r1])
+    merged = json.load(open(out))["traceEvents"]
+    counters = [e for e in merged if e.get("ph") == "C"]
+    assert sorted(e["args"]["value"] for e in counters) == [5, 11]
+    # pid remapping kept the two ranks' counter series distinct
+    assert len({e["pid"] for e in counters}) == 2
+    # and each counter pid carries its source-file label
+    labels = {e["pid"]: e["args"]["labels"] for e in merged
+              if e.get("ph") == "M" and e.get("name") == "process_labels"}
+    srcs = {labels[e["pid"]] for e in counters}
+    assert srcs == {"[r0.json]", "[r1.json]"}
+
+
+def test_python_writer_flushes_without_close(tmp_path):
+    """The pure-Python writer flushes after the queue drains, so a live
+    (never-closed) trace is readable mid-run — and loadable through the
+    truncated-array tolerance."""
+    import time as _time
+
+    from horovod_tpu.timeline import _Writer, _load_trace_events
+
+    path = str(tmp_path / "live.json")
+    w = _Writer(path)
+    w.emit("B", 1, 123.0, name="live_event")
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        if "live_event" in open(path).read():
+            break
+        _time.sleep(0.05)
+    events = _load_trace_events(path)
+    assert any(e.get("name") == "live_event" for e in events)
+    w.close()
+
+
+def test_python_writer_counts_drops_when_unhealthy(tmp_path):
+    """Events emitted after the writer goes unhealthy are counted, and
+    the count shows up in hvd.metrics()."""
+    import horovod_tpu as hvd
+    from horovod_tpu.timeline import _Writer
+
+    path = str(tmp_path / "t.json")
+    w = _Writer(path)
+    w.close()  # writer thread exits; _healthy goes False
+    before = hvd.metrics()["horovod_timeline_events_dropped_total"][
+        "values"][0]["value"]
+    w.emit("B", 1, 1.0, name="late")
+    w.emit("E", 1, 2.0)
+    after = hvd.metrics()["horovod_timeline_events_dropped_total"][
+        "values"][0]["value"]
+    assert after - before == 2

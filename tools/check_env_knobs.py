@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Cross-check the ``HOROVOD_*`` environment-variable contract.
+
+Every ``HOROVOD_*`` knob referenced by the package must be documented in
+the docs tree (``docs/*.md`` + ``README.md``), and every knob the docs
+promise must still exist somewhere in the code — docs and code drift in
+opposite directions and both drifts strand users (an undocumented knob is
+undiscoverable; a documented-but-removed knob silently does nothing).
+
+Run directly (exits nonzero on drift, listing the offenders)::
+
+    python tools/check_env_knobs.py
+
+or via the tier-1 suite (tests/test_env_knobs.py). Docs may document a
+family with a trailing-underscore wildcard (``HOROVOD_STALL_CHECK_*``),
+which covers every code var sharing the prefix.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import Iterable, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+# the (?!\[) rejects prose like "HOROVOD_WITH[OUT]_*" naming a knob family
+TOKEN_RE = re.compile(r"\bHOROVOD_[A-Z0-9_]+\b(?!\[)")
+
+# where knobs are *referenced* (package + build + launcher glue)
+CODE_GLOBS = (
+    ("horovod_tpu", "**/*.py"),
+    ("cpp", "**/*.cc"),
+    ("bin", "**/*"),
+    (".", "setup.py"),
+)
+# where knobs are *documented*
+DOC_GLOBS = (
+    ("docs", "**/*.md"),
+    (".", "README.md"),
+)
+
+
+def _scan(root: Path, globs: Iterable[Tuple[str, str]]) -> Set[str]:
+    tokens: Set[str] = set()
+    for base, pattern in globs:
+        for path in sorted((root / base).glob(pattern)):
+            if not path.is_file():
+                continue
+            try:
+                text = path.read_text(errors="replace")
+            except OSError:
+                continue
+            tokens.update(TOKEN_RE.findall(text))
+    return tokens
+
+
+def _drop_fragments(tokens: Set[str]) -> Set[str]:
+    """Drop wrapped-string-literal fragments: a token ending in ``_`` that
+    is a proper prefix of another collected token is half of a split
+    literal, not a real knob."""
+    return {t for t in tokens
+            if not (t.endswith("_")
+                    and any(o != t and o.startswith(t) for o in tokens))}
+
+
+def collect_code_vars(root: Path = REPO_ROOT) -> Set[str]:
+    return _drop_fragments(_scan(root, CODE_GLOBS))
+
+
+def collect_doc_vars(root: Path = REPO_ROOT) -> Tuple[Set[str], Set[str]]:
+    """Returns (exact names, wildcard prefixes). A docs token ending in
+    ``_`` (e.g. from ``HOROVOD_STALL_CHECK_*``) is a wildcard prefix."""
+    tokens = _scan(root, DOC_GLOBS)
+    prefixes = {t for t in tokens if t.endswith("_")}
+    return tokens - prefixes, prefixes
+
+
+def check(root: Path = REPO_ROOT) -> Tuple[Set[str], Set[str]]:
+    """Returns (undocumented code vars, stale docs vars)."""
+    code = collect_code_vars(root)
+    exact, prefixes = collect_doc_vars(root)
+    undocumented = {
+        v for v in code
+        if v not in exact and not any(v.startswith(p) for p in prefixes)}
+    stale = {
+        v for v in exact
+        if v not in code and not any(c.startswith(v) for c in code)}
+    return undocumented, stale
+
+
+def main(argv: list = ()) -> int:
+    root = Path(argv[0]) if argv else REPO_ROOT
+    undocumented, stale = check(root)
+    for v in sorted(undocumented):
+        print(f"UNDOCUMENTED: {v} is referenced in code but appears "
+              f"nowhere under docs/ or README.md", file=sys.stderr)
+    for v in sorted(stale):
+        print(f"STALE: {v} is documented but no longer referenced "
+              f"anywhere in code", file=sys.stderr)
+    if undocumented or stale:
+        return 1
+    print(f"env knob contract ok "
+          f"({len(collect_code_vars(root))} vars cross-checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
