@@ -110,6 +110,14 @@ from horovod_tpu.parallel.ep import (
 from horovod_tpu.ops.pallas import flash_attention
 from horovod_tpu import checkpoint
 from horovod_tpu import data
+from horovod_tpu import elastic
+from horovod_tpu.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    WorkersDownError,
+    WorkerLostError,
+    WorkerStallError,
+)
 
 __all__ = [
     "__version__",
@@ -146,4 +154,8 @@ __all__ = [
     # checkpoint / resume (rank-0 save + broadcast restore)
     "checkpoint",
     "data",
+    # elastic fault tolerance (reference: horovod.elastic)
+    "elastic",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+    "WorkersDownError", "WorkerLostError", "WorkerStallError",
 ]

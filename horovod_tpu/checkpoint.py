@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import re
 import tempfile
+import time
 from typing import Any, Optional, Tuple
 
 import jax
@@ -33,9 +34,46 @@ from horovod_tpu.parallel import dp
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
 
+# a .tmp this old belongs to a dead writer, not an in-flight save
+_STALE_TMP_SECONDS = 600.0
+
 
 def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(directory, f"ckpt_{step}.msgpack")
+
+
+def _fsync_dir(directory: str) -> None:
+    """Durably record the rename in the directory entry — without this a
+    host crash after ``os.replace`` can resurface the old (or no) file."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _clean_stale_tmps(directory: str) -> None:
+    """Remove orphaned ``*.tmp`` files left by writers that were killed
+    mid-save (the elastic failure mode this module exists for). Only files
+    older than ``_STALE_TMP_SECONDS`` go — a concurrent live save keeps
+    its fresh tmp."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    now = time.time()
+    for name in names:
+        if not name.endswith(".tmp"):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            if now - os.path.getmtime(path) > _STALE_TMP_SECONDS:
+                os.unlink(path)
+        except OSError:
+            pass  # raced with another cleaner, or already gone
 
 
 def save(directory: str, state: Any, step: int = 0,
@@ -49,6 +87,7 @@ def save(directory: str, state: Any, step: int = 0,
     if st.rank != 0:
         return None
     os.makedirs(directory, exist_ok=True)
+    _clean_stale_tmps(directory)
     state = jax.device_get(state)
     data = serialization.to_bytes(state)
     path = _ckpt_path(directory, step)
@@ -56,7 +95,10 @@ def save(directory: str, state: Any, step: int = 0,
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())  # durable before it can be published
         os.replace(tmp, path)  # atomic publish
+        _fsync_dir(directory)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -187,6 +187,23 @@ def shutdown() -> None:
     state_mod.reset()
 
 
+def reinit(
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[tuple[int, int]] = None,
+) -> None:
+    """Tear down and re-initialize against the CURRENT environment.
+
+    The elastic runner calls this after re-forming membership: by then
+    ``HOROVOD_RANK``/``HOROVOD_SIZE``/rendezvous knobs describe the new
+    generation, and ``init()`` rebuilds the mesh, config, and topology from
+    them. A plain ``init()`` call would be a no-op (``st.initialized``
+    short-circuits), hence the explicit shutdown-first entry point.
+    """
+    shutdown()
+    init(devices=devices, mesh_shape=mesh_shape)
+
+
 atexit.register(shutdown)  # reference: horovod/common/basics.py:40
 
 

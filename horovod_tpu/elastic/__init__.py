@@ -1,0 +1,52 @@
+"""Elastic (fault-tolerant) training — TPU-native port of Horovod
+Elastic (reference: horovod/common/elastic.py, horovod/run/elastic/).
+
+Usage::
+
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    hvd.init()
+    state = elastic.ArrayState(params=params, optimizer=opt_state, step=0)
+
+    @elastic.run
+    def train(state):
+        while state.step < total_steps:
+            state.params, state.optimizer = train_step(...)
+            state.step += 1
+            state.commit()
+
+    train(state)
+
+On a worker failure the runtime raises
+:class:`~horovod_tpu.exceptions.WorkersDownError`; the ``@elastic.run``
+wrapper re-forms membership through the rendezvous KV store, rebuilds the
+mesh, rolls back to the last ``commit()`` and calls ``train`` again. See
+docs/elastic.md.
+"""
+
+from horovod_tpu.elastic.fault_inject import FaultSpec, maybe_inject
+from horovod_tpu.elastic.runner import (
+    Backoff,
+    check_host_updates,
+    restarts,
+    run,
+    start_heartbeat,
+)
+from horovod_tpu.elastic.state import ArrayState, ObjectState, State
+from horovod_tpu.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    WorkerLostError,
+    WorkersDownError,
+    WorkerStallError,
+)
+
+__all__ = [
+    "ArrayState", "ObjectState", "State",
+    "run", "restarts", "Backoff",
+    "start_heartbeat", "check_host_updates",
+    "FaultSpec", "maybe_inject",
+    "HorovodInternalError", "WorkersDownError", "WorkerLostError",
+    "WorkerStallError", "HostsUpdatedInterrupt",
+]

@@ -1,0 +1,121 @@
+"""Deterministic failure injection for elastic testing.
+
+``HOROVOD_FAULT_INJECT=<spec>`` arms a one-shot fault on a chosen rank at
+a chosen step, letting tests and ``tpurun --elastic`` smoke runs exercise
+the recovery path without real hardware failures. Spec grammar::
+
+    <action>:rank=<r>:step=<s>[:code=<c>][:seconds=<t>][:gen=<g>]
+
+* ``action`` — ``kill`` (``os._exit``) or ``hang`` (sleep, so the stall
+  inspector / transport timeout must detect it).
+* ``rank`` — the rank to fault, matched against the worker's ORIGINAL
+  launch rank (survivors are renumbered on re-form; the fault must not
+  re-fire on whoever inherited the number).
+* ``step`` — fire when the state's step counter reaches this value.
+* ``code`` — exit code for ``kill`` (default 1).
+* ``seconds`` — hang duration (default 3600).
+* ``gen`` — generation (restart count) in which the fault is armed
+  (default 0: only before the first recovery).
+
+The hook point is :func:`maybe_inject`, called by
+``elastic.State.commit()`` every step and directly by tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from typing import Optional
+
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.utils import logging as log
+
+HOROVOD_FAULT_INJECT = "HOROVOD_FAULT_INJECT"
+
+_FAULTS_INJECTED = _metrics().counter(
+    "horovod_elastic_faults_injected_total",
+    "Deterministic faults fired by the HOROVOD_FAULT_INJECT harness.")
+
+_ACTIONS = ("kill", "hang")
+
+# the worker's launch-time rank: captured before any elastic re-form
+# renumbers HOROVOD_RANK in os.environ
+_initial_rank: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    action: str
+    rank: int
+    step: int
+    code: int = 1
+    seconds: float = 3600.0
+    generation: int = 0
+
+
+def parse_spec(text: str) -> FaultSpec:
+    parts = text.strip().split(":")
+    action = parts[0].strip().lower()
+    if action not in _ACTIONS:
+        raise ValueError(
+            f"{HOROVOD_FAULT_INJECT}: unknown action {action!r} "
+            f"(expected one of {_ACTIONS})")
+    fields = {"rank": None, "step": None, "code": 1,
+              "seconds": 3600.0, "gen": 0}
+    for part in parts[1:]:
+        if "=" not in part:
+            raise ValueError(
+                f"{HOROVOD_FAULT_INJECT}: malformed clause {part!r} "
+                f"(expected key=value)")
+        key, value = part.split("=", 1)
+        key = key.strip().lower()
+        if key not in fields:
+            raise ValueError(
+                f"{HOROVOD_FAULT_INJECT}: unknown key {key!r} "
+                f"(expected one of {sorted(fields)})")
+        fields[key] = float(value) if key == "seconds" else int(value)
+    if fields["rank"] is None or fields["step"] is None:
+        raise ValueError(
+            f"{HOROVOD_FAULT_INJECT}: spec must name rank= and step=")
+    return FaultSpec(action=action, rank=fields["rank"], step=fields["step"],
+                     code=fields["code"], seconds=fields["seconds"],
+                     generation=fields["gen"])
+
+
+def spec_from_env() -> Optional[FaultSpec]:
+    text = os.environ.get(HOROVOD_FAULT_INJECT, "")
+    return parse_spec(text) if text else None
+
+
+def initial_rank() -> int:
+    """The rank this process launched with, frozen on first access —
+    re-forms rewrite ``HOROVOD_RANK`` but must not re-target faults."""
+    global _initial_rank
+    if _initial_rank is None:
+        _initial_rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    return _initial_rank
+
+
+def maybe_inject(step: int, rank: Optional[int] = None,
+                 generation: int = 0) -> None:
+    """Fire the armed fault if (rank, step, generation) all match."""
+    spec = spec_from_env()
+    if spec is None:
+        return
+    if rank is None:
+        rank = initial_rank()
+    if (rank != spec.rank or step != spec.step
+            or generation != spec.generation):
+        return
+    _FAULTS_INJECTED.inc()
+    if spec.action == "kill":
+        log.error("fault injection: killing rank %d at step %d "
+                  "(exit code %d)", rank, step, spec.code)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(spec.code)
+    log.error("fault injection: hanging rank %d at step %d for %.0fs",
+              rank, step, spec.seconds)
+    time.sleep(spec.seconds)

@@ -1,0 +1,357 @@
+"""Elastic runner: catch failures, re-form membership, resume.
+
+TPU-native analogue of the reference's ``@hvd.elastic.run`` (reference:
+horovod/common/elastic.py ``run_fn``): the decorated training function
+takes a :class:`~horovod_tpu.elastic.state.State` first; when the runtime
+raises :class:`~horovod_tpu.exceptions.WorkersDownError` (peer death,
+transport loss, stall eviction) the runner
+
+1. tears the framework down (``hvd.shutdown``),
+2. re-forms membership through the rendezvous HTTP KV store — every
+   survivor registers under a per-generation scope; after the membership
+   quiesces, the LOWEST surviving old rank acts as leader, renumbers the
+   survivors contiguously (itself becoming the new rank 0), binds a fresh
+   coordinator port and publishes the assignment,
+3. rebuilds the mesh (``core.basics.reinit``) from the rewritten env,
+4. rolls state back to the last commit (``state.on_reset``) and
+   re-broadcasts it from the new rank 0 (``state.sync``),
+
+then calls the function again. Membership scans below
+``HOROVOD_ELASTIC_MIN_WORKERS`` retry with bounded exponential backoff
+(:class:`Backoff`). A :class:`~horovod_tpu.exceptions.HostsUpdatedInterrupt`
+(driver host-change notice, checked at each commit) takes the same path
+minus the rollback.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import socket as socket_mod
+import time
+from typing import Iterator, List, Optional
+
+from horovod_tpu import exceptions
+from horovod_tpu.core import basics
+from horovod_tpu.elastic import fault_inject
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import _get_float, _get_int
+
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_ELASTIC_MIN_WORKERS = "HOROVOD_ELASTIC_MIN_WORKERS"
+HOROVOD_ELASTIC_MAX_RETRIES = "HOROVOD_ELASTIC_MAX_RETRIES"
+HOROVOD_ELASTIC_SETTLE_SECONDS = "HOROVOD_ELASTIC_SETTLE_SECONDS"
+HOROVOD_ELASTIC_REJOIN_TIMEOUT_SECONDS = \
+    "HOROVOD_ELASTIC_REJOIN_TIMEOUT_SECONDS"
+HOROVOD_ELASTIC_BACKOFF_BASE_SECONDS = "HOROVOD_ELASTIC_BACKOFF_BASE_SECONDS"
+HOROVOD_ELASTIC_BACKOFF_MAX_SECONDS = "HOROVOD_ELASTIC_BACKOFF_MAX_SECONDS"
+HOROVOD_ELASTIC_HEARTBEAT_SECONDS = "HOROVOD_ELASTIC_HEARTBEAT_SECONDS"
+
+_RESTARTS_TOTAL = _metrics().counter(
+    "horovod_elastic_restarts_total",
+    "Successful elastic re-forms after a failure (per process).")
+_WORKERS_REMOVED = _metrics().counter(
+    "horovod_elastic_workers_removed_total",
+    "Workers lost across elastic re-forms, as seen by this process.")
+_GENERATION_GAUGE = _metrics().gauge(
+    "horovod_elastic_generation",
+    "Current membership generation (0 = original launch).")
+
+_LOCAL_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+# process-local membership generation; bumped by every successful re-form
+_generation = 0
+_heartbeat_thread = None
+_last_notice: Optional[str] = None
+
+
+def restarts() -> int:
+    """How many times this process has re-formed (the generation)."""
+    return _generation
+
+
+class Backoff:
+    """Deterministic bounded exponential backoff schedule.
+
+    ``delays()`` yields exactly ``retries`` sleep durations:
+    ``base, base*factor, ...`` capped at ``max_delay`` — pure arithmetic,
+    unit-testable without sleeping.
+    """
+
+    def __init__(self, base: float = 0.5, factor: float = 2.0,
+                 max_delay: float = 10.0, retries: int = 5):
+        if base <= 0 or factor < 1 or retries < 0:
+            raise ValueError("base > 0, factor >= 1, retries >= 0 required")
+        self.base = base
+        self.factor = factor
+        self.max_delay = max_delay
+        self.retries = retries
+
+    def delays(self) -> Iterator[float]:
+        delay = self.base
+        for _ in range(self.retries):
+            yield min(delay, self.max_delay)
+            delay *= self.factor
+
+    def schedule(self) -> List[float]:
+        return list(self.delays())
+
+    @classmethod
+    def from_env(cls) -> "Backoff":
+        return cls(
+            base=_get_float(HOROVOD_ELASTIC_BACKOFF_BASE_SECONDS, 0.5),
+            max_delay=_get_float(HOROVOD_ELASTIC_BACKOFF_MAX_SECONDS, 10.0),
+            retries=_get_int(HOROVOD_ELASTIC_MAX_RETRIES, 5))
+
+
+def _kv_client(scope: str = "global"):
+    """Worker-side rendezvous KV client, or None when the launcher did not
+    provide the HTTP store (single-process / manual runs)."""
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_HTTP_ADDR")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_HTTP_PORT")
+    if not addr or not port:
+        return None
+    from horovod_tpu.run.rendezvous import KVStoreClient
+
+    timeout = _get_float(HOROVOD_ELASTIC_REJOIN_TIMEOUT_SECONDS, 60.0)
+    return KVStoreClient(addr, int(port), scope=scope, timeout=timeout)
+
+
+def _worker_uid() -> str:
+    return f"{fault_inject.initial_rank()}-{os.getpid()}"
+
+
+def _my_address() -> str:
+    """Address peers can dial this worker's new coordinator on. Loopback
+    jobs stay on loopback; otherwise the host's primary address."""
+    old = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
+    if old in _LOCAL_HOSTS:
+        return old
+    try:
+        return socket_mod.gethostbyname(socket_mod.gethostname())
+    except OSError:
+        return old
+
+
+def _free_port() -> int:
+    with socket_mod.socket() as s:
+        s.bind(("0.0.0.0", 0))
+        return s.getsockname()[1]
+
+
+def start_heartbeat() -> None:
+    """Begin announcing liveness into the rendezvous ``heartbeat`` scope
+    (the elastic driver evicts workers whose beat exceeds the TTL)."""
+    global _heartbeat_thread
+    if _heartbeat_thread is not None and _heartbeat_thread.is_alive():
+        return
+    client = _kv_client(scope="heartbeat")
+    if client is None:
+        return
+    import threading
+
+    interval = _get_float(HOROVOD_ELASTIC_HEARTBEAT_SECONDS, 2.0)
+    uid = _worker_uid()
+
+    def _beat():
+        while True:
+            try:
+                client.set(uid, json.dumps(
+                    {"rank": int(os.environ.get("HOROVOD_RANK", "0")),
+                     "generation": _generation}).encode())
+            except OSError:
+                pass  # launcher going away is the job-level teardown
+            time.sleep(interval)
+
+    _heartbeat_thread = threading.Thread(
+        target=_beat, daemon=True, name="hvd-elastic-heartbeat")
+    _heartbeat_thread.start()
+
+
+def check_host_updates() -> None:
+    """Raise :class:`HostsUpdatedInterrupt` if the driver published a new
+    host-change notice since the last check (called from State.commit —
+    the only boundary where re-forming is safe). The first observation
+    only sets the baseline."""
+    global _last_notice
+    client = _kv_client(scope="elastic.notice")
+    if client is None:
+        return
+    try:
+        notice = client.get("update", wait=False).decode()
+    except (KeyError, OSError):
+        return
+    if _last_notice is None:
+        _last_notice = notice
+        return
+    if notice != _last_notice:
+        _last_notice = notice
+        raise exceptions.HostsUpdatedInterrupt(
+            f"elastic driver notice: {notice}")
+
+
+def _scan_members(client, scope: str, settle: float,
+                  deadline: float) -> List[int]:
+    """Poll the per-generation membership scope until it quiesces: no new
+    registration for ``settle`` seconds (survivors discover the failure at
+    different times — commit boundary vs transport timeout)."""
+    members: List[int] = []
+    last_change = time.monotonic()
+    while True:
+        now = time.monotonic()
+        seen = sorted(int(k.split(".", 1)[1]) for k in client.keys(scope)
+                      if k.startswith("member."))
+        if seen != members:
+            members, last_change = seen, now
+        elif members and now - last_change >= settle:
+            return members
+        if now >= deadline:
+            return members
+        time.sleep(0.1)
+
+
+def _reform(min_workers: int, backoff: Backoff) -> None:
+    """Re-form membership for generation ``_generation + 1`` and
+    re-initialize the framework from the rewritten env."""
+    global _generation
+    client = _kv_client()
+    if client is None:
+        raise exceptions.WorkersDownError(
+            "cannot re-form: no rendezvous KV store "
+            "(HOROVOD_RENDEZVOUS_HTTP_ADDR/PORT unset)")
+
+    gen = _generation + 1
+    scope = f"elastic.g{gen}"
+    old_rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    old_size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    settle = _get_float(HOROVOD_ELASTIC_SETTLE_SECONDS, 1.0)
+    rejoin_timeout = _get_float(HOROVOD_ELASTIC_REJOIN_TIMEOUT_SECONDS, 60.0)
+
+    basics.shutdown()
+    _shutdown_jax_distributed()
+
+    client.set(f"member.{old_rank}", _worker_uid().encode(), scope=scope)
+
+    deadline = time.monotonic() + rejoin_timeout
+    members = _scan_members(client, scope, settle, deadline)
+    # retry the scan with backoff while below quorum (survivors discover
+    # the failure at very different times: commit boundary vs transport
+    # timeout — an early scan can quiesce with just this worker)
+    for delay in backoff.delays():
+        if len(members) >= min_workers:
+            break
+        log.warning(
+            "elastic: %d/%d workers present; retrying in %.1fs",
+            len(members), min_workers, delay)
+        time.sleep(delay)
+        members = _scan_members(
+            client, scope, settle, time.monotonic() + rejoin_timeout)
+    if len(members) < min_workers:
+        raise exceptions.WorkersDownError(
+            f"elastic re-form failed: {len(members)} workers "
+            f"registered, HOROVOD_ELASTIC_MIN_WORKERS={min_workers} "
+            f"(after {backoff.retries} retries)")
+    # leadership decided AFTER the final scan — an early lone scanner
+    # must not keep a stale claim once more survivors register, or two
+    # leaders publish conflicting assignments
+    if min(members) == old_rank:
+        addr = _my_address()
+        assignment = {
+            "generation": gen,
+            "size": len(members),
+            # lowest surviving old rank -> new rank 0: the sync root owns
+            # the authoritative committed state
+            "ranks": {str(r): i for i, r in enumerate(members)},
+            "addr": addr,
+            "port": _free_port(),
+            "coordinator": f"{addr}:{_free_port()}",
+        }
+        client.set("assign", json.dumps(assignment).encode(), scope=scope)
+    try:
+        assignment = json.loads(client.get("assign", scope=scope).decode())
+    except (KeyError, TimeoutError) as exc:
+        raise exceptions.WorkersDownError(
+            f"elastic re-form failed: no assignment for generation {gen} "
+            f"({exc})") from exc
+
+    new_rank = assignment["ranks"].get(str(old_rank))
+    if new_rank is None:
+        raise exceptions.WorkersDownError(
+            f"this worker (old rank {old_rank}) was not included in the "
+            f"generation-{gen} assignment — exiting")
+
+    new_size = int(assignment["size"])
+    os.environ["HOROVOD_RANK"] = str(new_rank)
+    os.environ["HOROVOD_SIZE"] = str(new_size)
+    os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = assignment["addr"]
+    os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(assignment["port"])
+    # derived topology env recomputes from rank/size defaults
+    for stale in ("HOROVOD_LOCAL_RANK", "HOROVOD_LOCAL_SIZE",
+                  "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE"):
+        os.environ.pop(stale, None)
+    if os.environ.get("HOROVOD_COORDINATOR_ADDR"):
+        os.environ["HOROVOD_COORDINATOR_ADDR"] = assignment["coordinator"]
+        os.environ["HOROVOD_NUM_PROCESSES"] = str(new_size)
+        os.environ["HOROVOD_PROCESS_ID"] = str(new_rank)
+
+    _generation = gen
+    _GENERATION_GAUGE.set(gen)
+    if new_size < old_size:
+        _WORKERS_REMOVED.inc(old_size - new_size)
+    log.warning("elastic: re-formed generation %d — old rank %d -> "
+                "new rank %d of %d", gen, old_rank, new_rank, new_size)
+    basics.reinit()
+
+
+def _shutdown_jax_distributed() -> None:
+    """Best-effort jax.distributed teardown before re-forming: the old
+    coordinator may be the dead worker. Failure is survivable — socket
+    mode (the tested elastic path) never initialized it."""
+    try:
+        import jax
+
+        from horovod_tpu.core.basics import _jax_dist_initialized
+
+        if _jax_dist_initialized():
+            jax.distributed.shutdown()
+    except Exception as exc:
+        log.warning("jax.distributed shutdown during re-form failed: %s",
+                    exc)
+
+
+def run(func):
+    """Decorator: elastic-retrying entry point (reference:
+    horovod/common/elastic.py ``run``). The wrapped function's first
+    argument must be a :class:`~horovod_tpu.elastic.state.State`."""
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        fault_inject.initial_rank()  # freeze before any re-form renumbers
+        min_workers = _get_int(HOROVOD_ELASTIC_MIN_WORKERS, 1)
+        start_heartbeat()
+        rollback = False
+        while True:
+            if rollback is not False:
+                backoff = Backoff.from_env()
+                _reform(min_workers, backoff)
+                if rollback:  # failure path: roll back to the last commit
+                    state.on_reset()
+                # either way the new rank 0's copy becomes authoritative
+                state.sync(root_rank=0)
+                if rollback:
+                    _RESTARTS_TOTAL.inc()
+                rollback = False
+            try:
+                return func(state, *args, **kwargs)
+            except exceptions.HostsUpdatedInterrupt as exc:
+                log.warning("elastic: %s — re-forming to fold in the new "
+                            "host set", exc)
+                rollback = None  # re-form without rollback
+            except exceptions.WorkersDownError as exc:
+                log.warning("elastic: workers down (%s) — attempting "
+                            "recovery", exc)
+                rollback = True
+
+    return wrapper

@@ -1,0 +1,263 @@
+"""Elastic training state: commit / restore / sync.
+
+TPU-native port of the reference's elastic state objects (reference:
+horovod/common/elastic.py — ``State.commit/restore/sync``,
+horovod/torch/elastic/state.py ``TorchState``): the training loop keeps
+its recoverable values (model params, optimizer state, step counter) in a
+:class:`State`; ``commit()`` snapshots them in memory every step (and
+optionally spills asynchronously to disk via :mod:`horovod_tpu.checkpoint`);
+after a failure the elastic runner calls ``restore()`` to roll every
+survivor back to its last snapshot and ``sync()`` to re-broadcast the
+authoritative copy from the new rank 0 — which, by the re-form protocol
+(runner.py), is the lowest surviving old rank.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.core import basics
+from horovod_tpu.elastic import fault_inject
+from horovod_tpu.metrics import COMMIT_BUCKETS, registry as _metrics
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import _get_bool
+
+HOROVOD_ELASTIC_SPILL_DIR = "HOROVOD_ELASTIC_SPILL_DIR"
+HOROVOD_ELASTIC_SPILL_SYNC = "HOROVOD_ELASTIC_SPILL_SYNC"
+
+_COMMITS = _metrics().counter(
+    "horovod_elastic_commits_total",
+    "State.commit() snapshots taken (per process).")
+_COMMIT_DURATION = _metrics().histogram(
+    "horovod_elastic_commit_duration_seconds",
+    "Wall time of one State.commit() (snapshot; excludes the async "
+    "spill, which runs off-thread).", buckets=COMMIT_BUCKETS)
+
+
+def _host_copy(tree):
+    """A host-resident deep copy of an array pytree: snapshots must not
+    alias live buffers the training loop keeps mutating."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: np.array(a) if hasattr(a, "shape") else a,
+        jax.device_get(tree))
+
+
+def broadcast_object_wire(obj: Any, root_rank: int = 0) -> Any:
+    """Broadcast a picklable object over the collective wire.
+
+    Unlike :func:`horovod_tpu.parallel.dp.broadcast_object` (identity
+    without ``jax.distributed``), this rides the runtime's named-tensor
+    lane — it works in socket-controller mode, which is exactly where the
+    elastic re-form runs. Two-phase: length first (peers cannot know the
+    root's payload size), then the padded payload. Collective: every rank
+    must call it in the same order.
+    """
+    from horovod_tpu.ops import collectives
+
+    st = basics._ensure_init()
+    if st.size <= 1:
+        return obj
+    payload = pickle.dumps(obj) if st.rank == root_rank else b""
+    n = int(np.asarray(collectives.broadcast(
+        np.array([len(payload)], np.int64), root_rank))[0])
+    buf = np.zeros((n,), np.uint8)
+    if st.rank == root_rank:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    out = np.asarray(collectives.broadcast(buf, root_rank))
+    return pickle.loads(out.tobytes())
+
+
+class State:
+    """Base elastic state (reference: horovod/common/elastic.py State).
+
+    Subclasses implement ``save``/``restore_snapshot``/``sync``;
+    ``commit()`` wraps ``save`` with the fault-injection hook, metrics and
+    the optional async disk spill. ``spill_dir`` (or
+    ``HOROVOD_ELASTIC_SPILL_DIR``) enables the spill; rank 0 writes
+    (checkpoint.py convention). ``HOROVOD_ELASTIC_SPILL_SYNC=1`` makes
+    the spill synchronous (tests / strict durability).
+    """
+
+    def __init__(self, spill_dir: Optional[str] = None):
+        self._spill_dir = spill_dir or os.environ.get(
+            HOROVOD_ELASTIC_SPILL_DIR, "")
+        self._spill_sync = _get_bool(HOROVOD_ELASTIC_SPILL_SYNC)
+        self._spill_lock = threading.Lock()
+        self._spill_next: Optional[tuple] = None
+        self._spill_thread: Optional[threading.Thread] = None
+        self._reset_callbacks: list = []
+
+    # -- subclass surface --------------------------------------------------
+    def save(self) -> None:
+        """Snapshot current values in memory."""
+        raise NotImplementedError
+
+    def restore_snapshot(self) -> None:
+        """Roll values back to the last snapshot (process-local)."""
+        raise NotImplementedError
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Make ``root_rank``'s values authoritative everywhere."""
+        raise NotImplementedError
+
+    def _spill_payload(self):
+        """(pytree, step) to persist on spill, or None to skip."""
+        return None
+
+    # -- public API (reference names: commit / restore / on_reset) --------
+    def commit(self) -> None:
+        step = int(getattr(self, "step", 0))
+        from horovod_tpu.elastic import runner as _runner
+
+        fault_inject.maybe_inject(step, generation=_runner.restarts())
+        t0 = time.monotonic()
+        self.save()
+        _COMMITS.inc()
+        _COMMIT_DURATION.observe(time.monotonic() - t0)
+        if self._spill_dir:
+            payload = self._spill_payload()
+            if payload is not None:
+                self._spill(payload[0], payload[1])
+        # commit is the one boundary where re-forming is safe: surface any
+        # driver host-change notice here (raises HostsUpdatedInterrupt,
+        # caught by @elastic.run AFTER this snapshot completed)
+        _runner.check_host_updates()
+
+    def restore(self) -> None:
+        self.restore_snapshot()
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        """Callables invoked after a re-form (reference:
+        horovod/common/elastic.py register_reset_callbacks) — rebuild
+        anything derived from world size (lr schedules, data shards)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.restore()
+        for cb in self._reset_callbacks:
+            cb()
+
+    # -- async spill -------------------------------------------------------
+    def _spill(self, tree, step: int) -> None:
+        from horovod_tpu import checkpoint
+
+        if self._spill_sync:
+            checkpoint.save(self._spill_dir, tree, step=step)
+            return
+        with self._spill_lock:
+            # latest-wins: a slow disk must not queue unbounded snapshots
+            self._spill_next = (tree, step)
+            if self._spill_thread is None or not self._spill_thread.is_alive():
+                self._spill_thread = threading.Thread(
+                    target=self._spill_loop, daemon=True,
+                    name="hvd-elastic-spill")
+                self._spill_thread.start()
+
+    def _spill_loop(self) -> None:
+        from horovod_tpu import checkpoint
+
+        while True:
+            with self._spill_lock:
+                item, self._spill_next = self._spill_next, None
+                if item is None:
+                    return
+            try:
+                checkpoint.save(self._spill_dir, item[0], step=item[1])
+            except Exception as exc:
+                log.warning("elastic spill to %s failed: %s",
+                            self._spill_dir, exc)
+
+
+class ObjectState(State):
+    """Picklable-attribute state (reference: horovod/common/elastic.py
+    ObjectState): every keyword becomes an attribute; commit snapshots
+    them by value; sync ships rank 0's copies over the wire."""
+
+    _INTERNAL = ("_spill_dir", "_spill_sync", "_spill_lock", "_spill_next",
+                 "_spill_thread", "_reset_callbacks", "_saved")
+
+    def __init__(self, spill_dir: Optional[str] = None, **kwargs):
+        super().__init__(spill_dir=spill_dir)
+        self._saved: Dict[str, bytes] = {}
+        for key, value in kwargs.items():
+            setattr(self, key, value)
+        self.save()
+
+    def _public_attrs(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()
+                if k not in self._INTERNAL}
+
+    def save(self) -> None:
+        # pickle round-trip = by-value snapshot of arbitrary objects
+        self._saved = {k: pickle.dumps(v)
+                       for k, v in self._public_attrs().items()}
+
+    def restore_snapshot(self) -> None:
+        for key, blob in self._saved.items():
+            setattr(self, key, pickle.loads(blob))
+
+    def sync(self, root_rank: int = 0) -> None:
+        synced = broadcast_object_wire(self._public_attrs(), root_rank)
+        for key, value in synced.items():
+            setattr(self, key, value)
+        self.save()
+
+
+class ArrayState(State):
+    """Array-pytree state for JAX training loops (the analogue of the
+    reference's framework-specific ``TorchState``): holds ``params``,
+    ``optimizer`` (opt_state) and the ``step`` counter, plus any extra
+    array pytrees passed as keywords. The initial values are snapshot at
+    construction, so a failure before the first ``commit()`` restores the
+    starting point."""
+
+    def __init__(self, params=None, optimizer=None, step: int = 0,
+                 spill_dir: Optional[str] = None, **trees):
+        super().__init__(spill_dir=spill_dir)
+        self.params = params
+        self.optimizer = optimizer
+        self.step = int(step)
+        self._tree_names = ["params", "optimizer"] + sorted(trees)
+        for name, tree in trees.items():
+            setattr(self, name, tree)
+        self._saved: Dict[str, Any] = {}
+        self.save()
+
+    def save(self) -> None:
+        self._saved = {name: _host_copy(getattr(self, name))
+                       for name in self._tree_names}
+        self._saved["step"] = int(self.step)
+
+    def restore_snapshot(self) -> None:
+        for name in self._tree_names:
+            setattr(self, name, _host_copy(self._saved[name]))
+        self.step = int(self._saved["step"])
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Re-broadcast from ``root_rank`` (after a re-form: the lowest
+        surviving rank, renumbered 0 — see runner._reform)."""
+        from horovod_tpu.ops import collectives
+        from horovod_tpu.parallel import dp
+
+        st = basics._ensure_init()
+        for name in self._tree_names:
+            tree = getattr(self, name)
+            if tree is not None:
+                setattr(self, name,
+                        dp.broadcast_parameters(tree, root_rank=root_rank))
+        if st.size > 1:
+            self.step = int(np.asarray(collectives.broadcast(
+                np.array([self.step], np.int64), root_rank))[0])
+        self.save()
+
+    def _spill_payload(self):
+        return ({name: self._saved[name] for name in self._tree_names},
+                int(self._saved.get("step", 0)))

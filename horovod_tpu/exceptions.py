@@ -1,0 +1,55 @@
+"""Typed failure hierarchy for the elastic subsystem.
+
+The reference's elastic mode recovers by catching a dedicated exception
+family raised out of the collective layer (reference:
+horovod/common/exceptions.py — ``HorovodInternalError`` /
+``HostsUpdatedInterrupt``) instead of letting a peer death abort the
+process. Everything here subclasses :class:`RuntimeError` so existing
+callers that catch ``RuntimeError`` around ``hvd.synchronize`` keep
+working; elastic-aware callers (``@hvd.elastic.run``) catch the narrower
+:class:`WorkersDownError` and re-form the job.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal framework failure surfaced to a caller thread (reference:
+    horovod/common/exceptions.py HorovodInternalError)."""
+
+
+class WorkersDownError(HorovodInternalError):
+    """One or more workers left the job (died, hung past the stall
+    shutdown threshold, or closed their transport). Recoverable under
+    ``@hvd.elastic.run``: survivors re-form membership and resume from the
+    last committed state."""
+
+    def __init__(self, message: str,
+                 ranks: Optional[Sequence[int]] = None) -> None:
+        super().__init__(message)
+        #: ranks believed down, when the failure path could tell; else ()
+        self.ranks = tuple(ranks or ())
+
+
+class WorkerLostError(WorkersDownError):
+    """A peer's transport died mid-collective (connection reset, short
+    read, coordinator unreachable)."""
+
+
+class WorkerStallError(WorkersDownError):
+    """The stall inspector crossed HOROVOD_STALL_SHUTDOWN_TIME_SECONDS:
+    some ranks stopped submitting tensors — treated as down so the
+    elastic layer can evict them and continue."""
+
+
+class HostsUpdatedInterrupt(Exception):
+    """The elastic driver announced a host-set change (reference:
+    horovod/common/exceptions.py HostsUpdatedInterrupt). Not an error:
+    deliberately OUTSIDE the RuntimeError family so generic error
+    handlers never swallow it; the elastic runner catches it at the next
+    commit boundary and re-forms membership to fold new hosts in."""
+
+    def __init__(self, message: str = "host set updated") -> None:
+        super().__init__(message)

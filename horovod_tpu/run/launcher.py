@@ -94,12 +94,24 @@ def launch_job(command: str, slots: List[SlotInfo],
                use_jax_distributed: bool = True,
                prefix_output: bool = True,
                start_timeout: float = 300.0,
-               backend=None) -> int:
+               backend=None,
+               elastic: bool = False,
+               min_workers: int = 1,
+               max_workers: Optional[int] = None,
+               discovery_script: Optional[str] = None) -> int:
     """Run ``command`` on every slot; returns the job exit code (first
     non-zero worker code, else 0). Starts the rendezvous KV server for the
     job's lifetime. ``backend`` is a :class:`run.backends.LaunchBackend`
     (default: ssh/local — the seam the reference's gloo-vs-mpirun choice
-    occupies, run/run.py:715-732)."""
+    occupies, run/run.py:715-732).
+
+    ``elastic`` flips the failure policy (reference: elastic gloo_run vs
+    plain gloo_run): a non-zero worker exit no longer tears the job down;
+    survivors re-form on their own and the job fails only when fewer than
+    ``min_workers`` workers remain. With a ``discovery_script`` an
+    :class:`~horovod_tpu.elastic.driver.ElasticDriver` polls it and
+    publishes host-change notices + heartbeat evictions through the
+    rendezvous store."""
     from horovod_tpu.run.backends import make_backend
 
     base_env = dict(os.environ if env is None else env)
@@ -142,6 +154,16 @@ def launch_job(command: str, slots: List[SlotInfo],
     socket_port = _free_port()
     coordinator_port = _free_port()
 
+    elastic_driver = None
+    if elastic and discovery_script:
+        from horovod_tpu.elastic.driver import (ElasticDriver,
+                                                HostDiscoveryScript)
+
+        elastic_driver = ElasticDriver(
+            rendezvous, HostDiscoveryScript(discovery_script),
+            min_workers=min_workers, max_workers=max_workers)
+        elastic_driver.start()
+
     exit_codes: List[Optional[int]] = [None] * len(slots)
     failure = threading.Event()
     first_failure: List[Optional[int]] = [None]
@@ -153,6 +175,9 @@ def launch_job(command: str, slots: List[SlotInfo],
             coordinator_port,
             num_processes=len(slots),
             use_jax_distributed=use_jax_distributed)
+        if elastic:
+            worker_env["HOROVOD_ELASTIC"] = "1"
+            worker_env["HOROVOD_ELASTIC_MIN_WORKERS"] = str(min_workers)
         cmd = backend.command_for_slot(slot, command, worker_env)
 
         stdout = stderr = None
@@ -171,12 +196,23 @@ def launch_job(command: str, slots: List[SlotInfo],
                 prefix_output=prefix_output)
             exit_codes[i] = code
             if code not in (0, None):
-                # report the code of the worker that failed first, not of
-                # workers we subsequently tore down (gloo_run.py:256-262)
                 with failure_lock:
-                    if not failure.is_set():
-                        first_failure[0] = code
-                    failure.set()
+                    if elastic:
+                        # survivors re-form on their own; only kill the
+                        # job once fewer than min_workers could remain
+                        failed = sum(1 for c in exit_codes
+                                     if c not in (0, None))
+                        if len(slots) - failed < min_workers:
+                            if not failure.is_set():
+                                first_failure[0] = code
+                            failure.set()
+                    else:
+                        # report the code of the worker that failed first,
+                        # not of workers we subsequently tore down
+                        # (gloo_run.py:256-262)
+                        if not failure.is_set():
+                            first_failure[0] = code
+                        failure.set()
         finally:
             for f in files:
                 f.close()
@@ -203,8 +239,22 @@ def launch_job(command: str, slots: List[SlotInfo],
     finally:
         for sig, handler in prev_handlers.items():
             signal.signal(sig, handler)
+        if elastic_driver is not None:
+            elastic_driver.stop()
         rendezvous.stop()
 
+    if elastic:
+        # success = enough workers finished cleanly; lost ranks (non-zero
+        # exits) were absorbed by the survivors' re-form
+        clean = sum(1 for c in exit_codes if c == 0)
+        if clean >= min_workers:
+            return 0
+        if first_failure[0] is not None:
+            return first_failure[0]
+        for code in exit_codes:
+            if code not in (0, None):
+                return code
+        return 1
     if first_failure[0] is not None:
         return first_failure[0]
     for code in exit_codes:

@@ -5,13 +5,22 @@ horovod/run/rendezvous/http_server.py:140-204): a threaded HTTP server
 holding scoped KV maps — ``global``, ``local_<cross_rank>``,
 ``cross_<local_rank>`` — that worker processes use to find each other
 before any collective channel exists. PUT stores a value, GET returns 404
-until the key appears (clients long-poll), DELETE marks a rank finished so
-the launcher can reap the scope.
+until the key appears, DELETE marks a rank finished so the launcher can
+reap the scope.
 
-The socket data plane only needs the coordinator address (rank 0), which
-the launcher passes directly in env; this store exists for everything else
-— worker liveness, result collection, object exchange before init, and the
-driver/task services (service.py).
+GET supports server-side long-polling (``?wait=<seconds>``): the handler
+parks on a condition variable until the key is published or the wait
+expires, replacing the client's fixed-sleep 404 spin (one request per
+``HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS`` instead of twenty per second).
+
+Two scopes get special treatment for the elastic subsystem:
+
+* ``heartbeat`` — every PUT is timestamped; keys older than the server's
+  TTL (``HOROVOD_RENDEZVOUS_HEARTBEAT_TTL``) vanish from GET and listing,
+  so the elastic driver reads current liveness with no bookkeeping.
+* ``/_keys/<scope>`` — lists a scope's keys (newline-joined), which the
+  elastic re-form protocol uses to discover who registered for the next
+  generation.
 """
 
 from __future__ import annotations
@@ -19,9 +28,20 @@ from __future__ import annotations
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 from urllib.error import HTTPError
+from urllib.parse import parse_qs, urlsplit
 from urllib.request import Request, urlopen
+
+from horovod_tpu.utils.env import _get_float
+
+HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS = "HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS"
+HOROVOD_RENDEZVOUS_HEARTBEAT_TTL = "HOROVOD_RENDEZVOUS_HEARTBEAT_TTL"
+
+# cap on the server-side park, so a lost client cannot pin a handler
+# thread forever
+_MAX_WAIT_SECONDS = 60.0
+HEARTBEAT_SCOPE = "heartbeat"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -31,11 +51,15 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _split(self):
-        parts = self.path.strip("/").split("/", 1)
+        parts = urlsplit(self.path).path.strip("/").split("/", 1)
         if len(parts) != 2 or not parts[0] or not parts[1]:
             self.send_error(400, "path must be /scope/key")
             return None
         return parts[0], parts[1]
+
+    def _query(self, name: str) -> Optional[str]:
+        values = parse_qs(urlsplit(self.path).query).get(name)
+        return values[0] if values else None
 
     def do_PUT(self):
         sk = self._split()
@@ -46,17 +70,44 @@ class _Handler(BaseHTTPRequestHandler):
         value = self.rfile.read(length)
         with self.server.lock:
             self.server.store.setdefault(scope, {})[key] = value
+            self.server.put_times.setdefault(scope, {})[key] = \
+                time.monotonic()
+            self.server.cond.notify_all()  # wake long-polling GETs
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def _lookup(self, scope: str, key: str) -> Optional[bytes]:
+        """Caller holds the lock. Heartbeat keys past the TTL read as
+        absent — expiry IS the liveness signal."""
+        value = self.server.store.get(scope, {}).get(key)
+        if value is not None and scope == HEARTBEAT_SCOPE:
+            put = self.server.put_times.get(scope, {}).get(key, 0.0)
+            if time.monotonic() - put > self.server.heartbeat_ttl:
+                return None
+        return value
+
     def do_GET(self):
+        path = urlsplit(self.path).path
+        if path.startswith("/_keys/"):
+            return self._do_keys(path[len("/_keys/"):].strip("/"))
         sk = self._split()
         if sk is None:
             return
         scope, key = sk
+        try:
+            wait = min(float(self._query("wait") or 0.0), _MAX_WAIT_SECONDS)
+        except ValueError:
+            wait = 0.0
+        deadline = time.monotonic() + wait
         with self.server.lock:
-            value = self.server.store.get(scope, {}).get(key)
+            value = self._lookup(scope, key)
+            while value is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self.server.cond.wait(remaining)
+                value = self._lookup(scope, key)
         if value is None:
             self.send_response(404)
             self.send_header("Content-Length", "0")
@@ -67,6 +118,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(value)
 
+    def _do_keys(self, scope: str) -> None:
+        """GET /_keys/<scope>[?ttl=<s>] — list the scope's (live) keys."""
+        ttl = None
+        try:
+            if self._query("ttl") is not None:
+                ttl = float(self._query("ttl"))
+        except ValueError:
+            ttl = None
+        with self.server.lock:
+            keys = _live_keys_locked(self.server, scope, ttl)
+        body = "\n".join(sorted(keys)).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_DELETE(self):
         # a rank declaring itself finished with the scope
         # (reference: http_server.py scope_size bookkeeping)
@@ -76,17 +143,32 @@ class _Handler(BaseHTTPRequestHandler):
         scope, key = sk
         with self.server.lock:
             self.server.store.get(scope, {}).pop(key, None)
+            self.server.put_times.get(scope, {}).pop(key, None)
             self.server.finished.setdefault(scope, set()).add(key)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
 
 
+def _live_keys_locked(httpd, scope: str, ttl: Optional[float]) -> List[str]:
+    """Keys of ``scope``; with a TTL (explicit, or the server default for
+    the heartbeat scope) only keys PUT within the last ``ttl`` seconds."""
+    if ttl is None and scope == HEARTBEAT_SCOPE:
+        ttl = httpd.heartbeat_ttl
+    keys = list(httpd.store.get(scope, {}))
+    if ttl is None:
+        return keys
+    now = time.monotonic()
+    times = httpd.put_times.get(scope, {})
+    return [k for k in keys if now - times.get(k, 0.0) <= ttl]
+
+
 class RendezvousServer:
     """Launcher-side store. ``start()`` returns the bound port."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 bind_retries: int = 5):
+                 bind_retries: int = 5,
+                 heartbeat_ttl: Optional[float] = None):
         # An explicitly-requested port can collide with a dying server
         # from a previous launch (or a race between launchers); retry with
         # backoff before giving up. Only EADDRINUSE is plausibly transient
@@ -107,7 +189,13 @@ class RendezvousServer:
                 time.sleep(0.2 * attempt)
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.finished = {}  # type: ignore[attr-defined]
+        self._httpd.put_times = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.cond = threading.Condition(  # type: ignore[attr-defined]
+            self._httpd.lock)
+        self._httpd.heartbeat_ttl = (  # type: ignore[attr-defined]
+            heartbeat_ttl if heartbeat_ttl is not None
+            else _get_float(HOROVOD_RENDEZVOUS_HEARTBEAT_TTL, 30.0))
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -122,6 +210,9 @@ class RendezvousServer:
 
     def stop(self) -> None:
         self._httpd.shutdown()
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            # release parked long-polls so their handler threads exit
+            self._httpd.cond.notify_all()  # type: ignore[attr-defined]
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
@@ -135,16 +226,38 @@ class RendezvousServer:
         with self._httpd.lock:  # type: ignore[attr-defined]
             return self._httpd.store.get(scope, {}).get(key)  # type: ignore
 
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        """In-process PUT (the elastic driver lives in the launcher)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store.setdefault(scope, {})[key] = value
+            self._httpd.put_times.setdefault(  # type: ignore[attr-defined]
+                scope, {})[key] = time.monotonic()
+            self._httpd.cond.notify_all()  # type: ignore[attr-defined]
+
+    def live_keys(self, scope: str, ttl: Optional[float] = None) -> List[str]:
+        """Scope keys PUT within ``ttl`` seconds (default: the server's
+        heartbeat TTL for the heartbeat scope, else no expiry)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return _live_keys_locked(self._httpd, scope, ttl)
+
 
 class KVStoreClient:
     """Worker-side client (reference: the gloo HTTPStore,
-    common/gloo/http_store.cc — set/get/wait against the launcher server)."""
+    common/gloo/http_store.cc — set/get/wait against the launcher server).
+
+    ``get(wait=True)`` long-polls: each request asks the server to park up
+    to ``long_poll`` seconds (``HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS``)
+    before 404ing, and the short client-side sleep only paces retries
+    against pre-long-poll servers."""
 
     def __init__(self, addr: str, port: int, scope: str = "global",
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, long_poll: Optional[float] = None):
         self._base = f"http://{addr}:{port}"
         self._scope = scope
         self._timeout = timeout
+        self._long_poll = (long_poll if long_poll is not None
+                           else _get_float(
+                               HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS, 5.0))
 
     def _url(self, key: str, scope: Optional[str] = None) -> str:
         return f"{self._base}/{scope or self._scope}/{key}"
@@ -157,8 +270,15 @@ class KVStoreClient:
             wait: bool = True) -> bytes:
         deadline = time.monotonic() + self._timeout
         while True:
+            url = self._url(key, scope)
+            poll = 0.0
+            if wait:
+                poll = max(0.0, min(self._long_poll,
+                                    deadline - time.monotonic()))
+                if poll > 0:
+                    url += f"?wait={poll:g}"
             try:
-                return urlopen(self._url(key, scope), timeout=10).read()
+                return urlopen(url, timeout=poll + 10).read()
             except HTTPError as e:
                 if e.code != 404 or not wait:
                     raise KeyError(key) from e
@@ -167,6 +287,15 @@ class KVStoreClient:
                     f"rendezvous key {key!r} not published within "
                     f"{self._timeout}s")
             time.sleep(0.05)
+
+    def keys(self, scope: Optional[str] = None,
+             ttl: Optional[float] = None) -> List[str]:
+        """List a scope's keys (live ones only, when ``ttl`` given)."""
+        url = f"{self._base}/_keys/{scope or self._scope}"
+        if ttl is not None:
+            url += f"?ttl={ttl:g}"
+        body = urlopen(url, timeout=10).read().decode()
+        return [k for k in body.split("\n") if k]
 
     def finish(self, key: str, scope: Optional[str] = None) -> None:
         req = Request(self._url(key, scope), method="DELETE")

@@ -163,6 +163,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
          dest="autotune_gaussian_process_noise", type=float,
          help="GP noise regularization in [0, 1].")
 
+    elastic_group = parser.add_argument_group("elastic (fault-tolerant)")
+    _add(elastic_group, "--elastic", dest="elastic", action="store_true",
+         help="Elastic mode: worker failures no longer kill the job; "
+              "survivors re-form membership and resume from the last "
+              "committed state (requires the training script to use "
+              "hvd.elastic). Sets HOROVOD_ELASTIC=1 for workers.")
+    _add(elastic_group, "--min-workers", dest="min_workers", type=int,
+         help="Minimum workers an elastic job may shrink to (default 1); "
+              "below this the job fails. Sets HOROVOD_ELASTIC_MIN_WORKERS.")
+    _add(elastic_group, "--max-workers", dest="max_workers", type=int,
+         help="Maximum workers an elastic job may grow to (discovered "
+              "hosts beyond this are held in reserve).")
+    _add(elastic_group, "--host-discovery-script",
+         dest="host_discovery_script",
+         help="Executable printing the current 'hostname[:slots]' set, one "
+              "per line; polled by the elastic driver to add/remove "
+              "hosts at runtime.")
+
     stall = parser.add_argument_group("stall check")
     _add(stall, "--no-stall-check", dest="no_stall_check",
          action="store_true", help="Disable the stall inspector.")
@@ -364,12 +382,22 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
     import shlex as _shlex
 
+    elastic = bool(args.elastic)
+    min_workers = args.min_workers or 1
+    if elastic and args.min_workers and args.min_workers > np:
+        sys.stderr.write(f"tpurun: --min-workers {args.min_workers} "
+                         f"exceeds the launch size {np}\n")
+        return 2
+
     command_str = " ".join(_shlex.quote(c) for c in command)
     return launcher.launch_job(
         command_str, slots, env=env, ssh_port=args.ssh_port,
         output_dir=args.output_dir,
         use_jax_distributed=not args.no_jax_distributed,
-        start_timeout=args.start_timeout, backend=backend)
+        start_timeout=args.start_timeout, backend=backend,
+        elastic=elastic, min_workers=min_workers,
+        max_workers=args.max_workers,
+        discovery_script=args.host_discovery_script)
 
 
 def main() -> None:

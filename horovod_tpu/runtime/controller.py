@@ -188,6 +188,11 @@ class Controller:
         self.cache_enabled = cache_capacity > 0
         self.message_table = MessageTable()  # coordinator only
         self._should_shut_down = False
+        # typed verdict when the shutdown was provoked by a stall eviction
+        # (WorkerStallError from the inspector) — the runtime lifts this
+        # so elastic callers get a catchable WorkersDownError while the
+        # shutdown bit still propagates to every peer
+        self.failure: Optional[Exception] = None
         # name -> Request for every announcement not yet resolved on this
         # worker (needed for fusion byte accounting + cache puts when the
         # agreement arrives in a LATER cycle than the announcement)
@@ -288,7 +293,19 @@ class Controller:
         # check is part of every ComputeResponseList, controller.cc:98-107).
         if self.is_coordinator and stall_inspector is not None \
                 and len(self.message_table):
-            if stall_inspector.check(self.message_table, world=self.world):
+            try:
+                if stall_inspector.check(self.message_table,
+                                         world=self.world):
+                    self.request_shutdown()
+            except Exception as stall_exc:
+                from horovod_tpu.exceptions import WorkerStallError
+
+                if not isinstance(stall_exc, WorkerStallError):
+                    raise
+                # keep the typed reason AND still propagate the shutdown
+                # bit next cycle so peers exit their loops in lockstep
+                if self.failure is None:
+                    self.failure = stall_exc
                 self.request_shutdown()
 
         common_bits = sorted(CacheCoordinator.common_hits(anded))

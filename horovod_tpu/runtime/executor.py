@@ -104,6 +104,9 @@ class Executor:
         self.net = net
         self._programs: Dict[tuple, Any] = {}
         self._lock = threading.Lock()
+        # typed workers-down verdict from a data-plane failure (see
+        # execute's except clause); lifted by the runtime's cycle body
+        self.failure = None
         # Multi-process with a global mesh (jax.distributed): the hot op
         # (allreduce) must ride XLA collectives over ICI/DCN, not the host
         # TCP ring — the ring stays as control plane + fallback. Requires
@@ -276,6 +279,14 @@ class Executor:
         except Exception as exc:  # propagate execution failures as statuses
             status = types.Status.UnknownError(str(exc))
             _OP_ERRORS.labels(op=op).inc()
+            from horovod_tpu import exceptions
+
+            if (isinstance(exc, exceptions.WorkersDownError)
+                    and self.failure is None):
+                # a data-plane transport loss is a workers-down event even
+                # though this cycle completes "normally" (entries failed by
+                # status): record it so the runtime raises typed errors
+                self.failure = exc
             for e in entries:
                 e.complete(status, None)
         finally:

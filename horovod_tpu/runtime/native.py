@@ -18,6 +18,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from horovod_tpu.exceptions import WorkerLostError
+
 _CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "cpp")
 _LIB_PATH = os.path.join(_CPP_DIR, "libhvdtpu_net.so")
@@ -254,7 +256,9 @@ class NetComm:
     def barrier(self) -> None:
         with self._lock:
             if self._lib.hvdnet_barrier(self._h) != 0:
-                raise RuntimeError("barrier failed")
+                raise WorkerLostError(
+                    "barrier failed (peer closed or "
+                    "transport lost)")
 
     def bit_and_or(self, bits: int) -> Tuple[int, int]:
         """Cross-worker bitwise AND/OR of the coordination bitvector
@@ -278,7 +282,9 @@ class NetComm:
                 out_and.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
                 out_or.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
         if rc != 0:
-            raise RuntimeError("bit_and_or failed")
+            raise WorkerLostError(
+                "bit_and_or failed (peer closed or "
+                "transport lost)")
         return (int.from_bytes(out_and.tobytes(), "little"),
                 int.from_bytes(out_or.tobytes(), "little"))
 
@@ -290,7 +296,9 @@ class NetComm:
                 self._h, blob, len(blob), out,
                 cap if self.rank == 0 else 0, lens)
         if total < 0:
-            raise RuntimeError("gatherv failed")
+            raise WorkerLostError(
+                "gatherv failed (peer closed or "
+                "transport lost)")
         if self.rank != 0:
             return None
         blobs, off = [], 0
@@ -319,13 +327,17 @@ class NetComm:
             with self._lock:
                 rc = self._lib.hvdnet_bcast(self._h, buf, len(blob))
             if rc < 0:
-                raise RuntimeError("bcast failed")
+                raise WorkerLostError(
+                    "bcast failed (peer closed or "
+                    "transport lost)")
             return blob
         buf = ctypes.create_string_buffer(max(cap, 1))
         with self._lock:
             n = self._lib.hvdnet_bcast(self._h, buf, cap)
         if n < 0:
-            raise RuntimeError("bcast failed")
+            raise WorkerLostError(
+                "bcast failed (peer closed or "
+                "transport lost)")
         return buf.raw[:n]
 
     def bcast(self, blob: Optional[bytes]) -> bytes:
@@ -364,7 +376,9 @@ class NetComm:
             rc = fn(self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.size,
                     _RING_OPS[op])
         if rc != 0:
-            raise RuntimeError("ring allreduce failed")
+            raise WorkerLostError(
+                "ring allreduce failed (peer closed or "
+                "transport lost)")
         return arr
 
     def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
@@ -414,7 +428,9 @@ class NetComm:
             rc = fn(self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.size,
                     _RING_OPS[op], out.ctypes.data_as(ctypes.c_void_p))
         if rc != 0:
-            raise RuntimeError("reducescatter failed")
+            raise WorkerLostError(
+                "reducescatter failed (peer closed or "
+                "transport lost)")
         return out
 
     def alltoall(self, arr: np.ndarray) -> np.ndarray:
@@ -435,7 +451,9 @@ class NetComm:
                 self._h, arr.ctypes.data_as(ctypes.c_void_p),
                 out.ctypes.data_as(ctypes.c_void_p), chunk_bytes)
         if rc != 0:
-            raise RuntimeError("alltoall failed")
+            raise WorkerLostError(
+                "alltoall failed (peer closed or "
+                "transport lost)")
         return out
 
     def _allgatherv_raw(self, blob: bytes, cap: int) -> List[bytes]:
@@ -445,7 +463,9 @@ class NetComm:
             total = self._lib.hvdnet_allgatherv(
                 self._h, blob, len(blob), out, cap, lens)
         if total < 0:
-            raise RuntimeError("allgatherv failed")
+            raise WorkerLostError(
+                "allgatherv failed (peer closed or "
+                "transport lost)")
         blobs, off = [], 0
         raw = out.raw
         for r in range(self.world):

@@ -93,6 +93,24 @@ class RuntimeHandle:
                 with rt._inflight_lock:
                     rt._waiters -= 1
         if not self._status.ok():
+            # typed propagation for the elastic layer: when the runtime
+            # recorded a workers-down failure, surface it as the same
+            # exception type (WorkersDownError subclasses RuntimeError, so
+            # non-elastic callers are unaffected)
+            failure = getattr(rt, "failure", None) if rt is not None else None
+            if failure is None and rt is not None:
+                # the executor records data-plane losses on itself before
+                # completing entries; the runtime lifts it only at cycle
+                # end — after this waiter already woke
+                failure = getattr(rt.executor, "failure", None)
+            if failure is not None:
+                from horovod_tpu import exceptions
+
+                if isinstance(failure, exceptions.WorkersDownError):
+                    raise type(failure)(
+                        f"collective '{self.name}' failed: "
+                        f"{self._status.reason}",
+                        ranks=failure.ranks) from failure
             raise RuntimeError(
                 f"collective '{self.name}' failed: {self._status.reason}")
         return self._output
@@ -122,7 +140,12 @@ class Runtime:
         self.stall_inspector = StallInspector(
             warning_time_seconds=st.config.stall_check_time_seconds,
             shutdown_time_seconds=st.config.stall_shutdown_time_seconds,
-            enabled=not st.config.stall_check_disable)
+            enabled=not st.config.stall_check_disable,
+            elastic=st.config.elastic)
+        # the typed reason this runtime went down (WorkersDownError
+        # subclass), when an involuntary failure path could tell; a
+        # deliberate stop() leaves it None
+        self.failure: Optional[Exception] = None
         # stale deferred hits renegotiate on the same clock as stall warnings
         self.controller.STALE_HIT_SECONDS = st.config.stall_check_time_seconds
         self._cycle_time_s = st.config.cycle_time_ms / 1000.0
@@ -220,6 +243,7 @@ class Runtime:
         self._waiters = 0  # callers parked in RuntimeHandle.wait()
         self._last_poll_time = 0.0  # callers spinning on RuntimeHandle.poll()
         self._stop = threading.Event()
+        self._deliberate_stop = False  # set by stop(): not a failure
         self._woken = threading.Event()
         self._thread = threading.Thread(
             target=self._run_loop, daemon=True, name="hvd-background-loop")
@@ -250,6 +274,12 @@ class Runtime:
                  reduce_op: str = types.REDUCE_AVERAGE,
                  priority: int = 0) -> RuntimeHandle:
         if self._stop.is_set():
+            from horovod_tpu import exceptions
+
+            if isinstance(self.failure, exceptions.WorkersDownError):
+                raise type(self.failure)(
+                    f"{types.SHUT_DOWN_ERROR} (cause: {self.failure})",
+                    ranks=self.failure.ranks) from self.failure
             raise RuntimeError(types.SHUT_DOWN_ERROR)
         handle = RuntimeHandle(name, runtime=self)
 
@@ -393,12 +423,13 @@ class Runtime:
             self._check_lane_hazard()
             try:
                 keep_going = self.run_cycle()
-            except Exception:
+            except Exception as exc:
                 log.get_logger().exception("background cycle failed")
                 # In multi-process mode a transport failure means a peer
                 # died or shut down — treat as global shutdown (reference:
                 # any rank failure aborts the job, gloo_run.py:256-262).
                 keep_going = getattr(self.controller, "net", None) is None
+                self._record_failure(exc)  # no-op if run_cycle already did
             if not keep_going:
                 break
         # Every exit path (peer shutdown bit, transport failure, stop())
@@ -424,7 +455,13 @@ class Runtime:
             return True
         try:
             return self._run_cycle_body(requests, cycle_t0=time.monotonic())
-        except Exception:
+        except Exception as exc:
+            # Record the typed failure BEFORE completing any entry: the
+            # waiter wakes on complete() and immediately reads
+            # self.failure — recording later (in _run_loop) loses the
+            # race and callers see a generic abort instead of
+            # WorkersDownError.
+            self._record_failure(exc)
             # The popped requests' entries would otherwise be stranded in
             # the table with their handles never completing (and the names
             # permanently poisoned for re-enqueue) — fail them loudly.
@@ -435,10 +472,40 @@ class Runtime:
                 e.complete(status, None)
             raise
 
+    def _record_failure(self, exc: Exception) -> None:
+        """Store the typed reason this runtime is going down (first failure
+        wins). Single-process cycles (no net) survive cycle errors, so
+        nothing is recorded for them."""
+        if getattr(self.controller, "net", None) is None \
+                or self.failure is not None:
+            return
+        from horovod_tpu import exceptions
+
+        self.failure = (
+            exc if isinstance(exc, exceptions.WorkersDownError)
+            else exceptions.WorkerLostError(f"control-plane failure: {exc}"))
+
     def _run_cycle_body(self, requests, cycle_t0: float) -> bool:
         responses, shut_down = self.controller.compute_response_list(
             requests, self._st.config.fusion_threshold_bytes,
             timeline=self.timeline, stall_inspector=self.stall_inspector)
+        # the coordinator's stall scan records its typed verdict on the
+        # controller (controller.py) while the shutdown bit propagates —
+        # lift it so handles raise WorkerStallError, not a generic abort
+        ctrl_failure = getattr(self.controller, "failure", None)
+        if ctrl_failure is not None and self.failure is None:
+            self.failure = ctrl_failure
+        if shut_down and self.failure is None and not self._deliberate_stop \
+                and getattr(self.controller, "net", None) is not None \
+                and self._st.config.elastic:
+            # elastic: a REMOTE-initiated shutdown bit with no local cause
+            # means a peer evicted someone (stall) or is tearing down —
+            # survivors must re-form rather than die on a generic abort
+            from horovod_tpu import exceptions
+
+            self.failure = exceptions.WorkersDownError(
+                "peer requested shutdown (remote stall eviction or "
+                "failure)")
         _CYCLES.inc()
         _CYCLE_TENSORS.observe(
             sum(len(r.tensor_names) for r in responses))
@@ -466,6 +533,8 @@ class Runtime:
                     # covers everything around it)
                     _fail_incomplete_entries(entries)
                     raise
+        if self.executor.failure is not None and self.failure is None:
+            self.failure = self.executor.failure
         if self._autotune_active:
             self._autotune_sync(cycle_bytes, time.monotonic() - cycle_t0)
         _CYCLE_DURATION.observe(time.monotonic() - cycle_t0)
@@ -526,6 +595,7 @@ class Runtime:
         mode, shutdown is announced through the SHOULD_SHUT_DOWN status bit
         so every worker exits its cycle loop together (reference:
         response_cache.h:128-132 + controller shutdown propagation)."""
+        self._deliberate_stop = True
         if getattr(self.controller, "net", None) is not None \
                 and self._thread.is_alive():
             self.controller.request_shutdown()

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Tuple
 
+from horovod_tpu.exceptions import WorkerLostError
 from horovod_tpu.runtime import message as msg
 from horovod_tpu.runtime.controller import Controller
 from horovod_tpu.runtime.native import NetComm
@@ -42,24 +43,42 @@ class SocketController(Controller):
         return cls(rank, world, host, port, cache_capacity,
                    timeout_ms=int(timeout_s * 1000))
 
+    def _lost(self, phase: str, exc: Exception) -> WorkerLostError:
+        """Annotate a transport loss with the negotiation phase and this
+        rank — the context the elastic re-form logs need to explain WHY a
+        generation ended (the raw verb error only names the syscall)."""
+        return WorkerLostError(
+            f"rank {self.rank}/{self.world}: {phase} failed — a peer "
+            f"died or closed its transport ({exc})", ranks=exc.ranks
+            if isinstance(exc, WorkerLostError) else ())
+
     # -- verbs -------------------------------------------------------------
     def sync_bitvectors(self, bits: int) -> Tuple[int, int]:
-        return self.net.bit_and_or(bits)
+        try:
+            return self.net.bit_and_or(bits)
+        except WorkerLostError as exc:
+            raise self._lost("bitvector sync", exc) from exc
 
     def send_ready_tensors(self, requests: List[msg.Request]
                            ) -> Optional[List[List[msg.Request]]]:
-        blobs = self.net.gatherv(msg.pack_request_list(requests))
+        try:
+            blobs = self.net.gatherv(msg.pack_request_list(requests))
+        except WorkerLostError as exc:
+            raise self._lost("ready-tensor gather", exc) from exc
         if blobs is None:
             return None
         return [msg.unpack_request_list(b) for b in blobs]
 
     def bcast_responses(self, responses: Optional[List[msg.Response]]
                         ) -> List[msg.Response]:
-        if self.rank == 0:
-            assert responses is not None
-            blob = self.net.bcast(msg.pack_response_list(responses))
-        else:
-            blob = self.net.bcast(None)
+        try:
+            if self.rank == 0:
+                assert responses is not None
+                blob = self.net.bcast(msg.pack_response_list(responses))
+            else:
+                blob = self.net.bcast(None)
+        except WorkerLostError as exc:
+            raise self._lost("response broadcast", exc) from exc
         return msg.unpack_response_list(blob)
 
     def bcast_blob(self, blob: Optional[bytes]) -> bytes:

@@ -34,10 +34,15 @@ _STALL_SHUTDOWNS = _metrics().counter(
 class StallInspector:
     def __init__(self, warning_time_seconds: float = 60.0,
                  shutdown_time_seconds: float = 0.0,
-                 enabled: bool = True):
+                 enabled: bool = True, elastic: bool = False):
         self.warning_time = warning_time_seconds
         self.shutdown_time = shutdown_time_seconds
         self.enabled = enabled
+        # elastic mode: a shutdown-threshold stall raises a catchable
+        # WorkerStallError (naming the missing ranks) instead of only
+        # returning True — the elastic runner evicts the stalled workers
+        # and re-forms rather than failing the whole job
+        self.elastic = elastic
         self._last_check = time.monotonic()
         # tensor name -> first time observed incomplete. Fallback baseline
         # only: the message table's arrival stamp is preferred (see check),
@@ -61,6 +66,7 @@ class StallInspector:
         pending = message_table.pending()
         stalled_msgs = []
         shutdown = False
+        missing_ranks: set = set()
         seen_names = set()
         arrival_time = getattr(message_table, "first_request_time", None)
         for name, requests in pending.items():
@@ -87,6 +93,7 @@ class StallInspector:
             # directly would desynchronize cache bits across workers.
             if self.shutdown_time > 0 and age > self.shutdown_time:
                 shutdown = True
+                missing_ranks.update(missing)
 
         # forget tensors that completed since last scan
         self._first_seen = {k: v for k, v in self._first_seen.items()
@@ -108,4 +115,12 @@ class StallInspector:
                 "Stalled tensors exceeded "
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (%.0fs); "
                 "shutting down.", self.shutdown_time)
+            if self.elastic:
+                from horovod_tpu.exceptions import WorkerStallError
+
+                raise WorkerStallError(
+                    f"stalled ranks exceeded the shutdown threshold "
+                    f"({self.shutdown_time:.0f}s): "
+                    f"{'; '.join(stalled_msgs)}",
+                    ranks=sorted(missing_ranks))
         return shutdown

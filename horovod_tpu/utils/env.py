@@ -44,6 +44,7 @@ HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
 HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
 HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference: operations.cc:386
@@ -105,6 +106,9 @@ class Config:
     stall_shutdown_time_seconds: float = 0.0
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # elastic mode: stall shutdown and peer loss raise catchable
+    # WorkersDownError instead of tearing the process down
+    elastic: bool = False
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -138,6 +142,7 @@ class Config:
             ),
             hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_get_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
+            elastic=_get_bool(HOROVOD_ELASTIC),
         )
 
 

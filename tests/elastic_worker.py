@@ -1,0 +1,60 @@
+"""Worker script for the elastic fault-injection acceptance test.
+
+Launched by tests/test_elastic_multiprocess.py with world=3, socket
+controller, the pytest process hosting the rendezvous HTTP store, and
+``HOROVOD_FAULT_INJECT=kill:rank=1:step=3``: rank 1 dies inside its
+step-3 commit; the survivors' next collective fails with
+WorkersDownError, ``@elastic.run`` re-forms them into a 2-worker
+generation, rolls back to the last commit (step 3) and finishes all
+TOTAL_STEPS steps.
+
+Invariant printed at the end: one Average-allreduce of ones adds exactly
+1.0 per step regardless of world size, so ``w == step`` at every commit
+— surviving a membership change with w intact proves the rollback+sync
+path, not just the re-form.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "8"))
+
+
+@elastic.run
+def train(state):
+    while state.step < TOTAL_STEPS:
+        grad = hvd.allreduce(np.ones(4, np.float32), average=True,
+                             name="elastic_grad")
+        state.params["w"] = state.params["w"] + np.asarray(grad)
+        state.step += 1
+        state.commit()
+    return state
+
+
+def main() -> int:
+    hvd.init()
+    state = elastic.ArrayState(
+        params={"w": np.zeros(4, np.float32)}, optimizer=None, step=0)
+    train(state)
+
+    w = float(state.params["w"][0])
+    restarts = elastic.restarts()
+    from horovod_tpu.elastic.runner import _RESTARTS_TOTAL
+
+    print(f"DONE rank={hvd.rank()} size={hvd.size()} step={state.step} "
+          f"w={w:g} generation={restarts} "
+          f"elastic_restarts_total={_RESTARTS_TOTAL.value:g}",
+          flush=True)
+    if state.step != TOTAL_STEPS or abs(w - TOTAL_STEPS) > 1e-5:
+        return 3
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

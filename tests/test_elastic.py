@@ -1,0 +1,414 @@
+"""Unit tests for the elastic subsystem (PR 2 satellites).
+
+Process-local pieces: backoff arithmetic, fault-spec parsing, the
+exception hierarchy, state commit/restore round-trips and spill,
+rendezvous long-poll / TTL / key listing, checkpoint write hardening,
+driver membership math, and the stall inspector's elastic raise. The
+end-to-end kill/re-form path lives in test_elastic_multiprocess.py.
+"""
+
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint, exceptions
+from horovod_tpu.elastic import (ArrayState, Backoff, FaultSpec, ObjectState,
+                                 fault_inject)
+from horovod_tpu.elastic.driver import ElasticDriver, HostDiscoveryScript
+from horovod_tpu.run.rendezvous import KVStoreClient, RendezvousServer
+from horovod_tpu.stall import StallInspector
+
+
+# ---------------------------------------------------------------------------
+# exceptions
+# ---------------------------------------------------------------------------
+
+class TestExceptionHierarchy:
+    def test_workers_down_is_runtime_error(self):
+        # back-compat: pre-elastic callers catch RuntimeError
+        assert issubclass(exceptions.WorkersDownError, RuntimeError)
+        assert issubclass(exceptions.WorkerLostError,
+                          exceptions.WorkersDownError)
+        assert issubclass(exceptions.WorkerStallError,
+                          exceptions.WorkersDownError)
+
+    def test_hosts_updated_is_not_a_failure(self):
+        # the interrupt must NOT be caught by `except RuntimeError`
+        assert not issubclass(exceptions.HostsUpdatedInterrupt, RuntimeError)
+
+    def test_ranks_carried(self):
+        e = exceptions.WorkerLostError("gone", ranks=[2, 1])
+        assert e.ranks == (2, 1)
+        assert exceptions.WorkersDownError("x").ranks == ()
+
+    def test_exported_at_package_root(self):
+        assert hvd.WorkersDownError is exceptions.WorkersDownError
+        assert hvd.HostsUpdatedInterrupt is exceptions.HostsUpdatedInterrupt
+
+
+# ---------------------------------------------------------------------------
+# backoff
+# ---------------------------------------------------------------------------
+
+class TestBackoff:
+    def test_schedule_doubles_and_caps(self):
+        b = Backoff(base=0.5, factor=2.0, max_delay=3.0, retries=5)
+        assert b.schedule() == [0.5, 1.0, 2.0, 3.0, 3.0]
+
+    def test_zero_retries_empty(self):
+        assert Backoff(retries=0).schedule() == []
+
+    def test_invalid_params_raise(self):
+        with pytest.raises(ValueError):
+            Backoff(base=0)
+        with pytest.raises(ValueError):
+            Backoff(factor=0.5)
+        with pytest.raises(ValueError):
+            Backoff(retries=-1)
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_ELASTIC_BACKOFF_BASE_SECONDS", "1.0")
+        monkeypatch.setenv("HOROVOD_ELASTIC_BACKOFF_MAX_SECONDS", "4.0")
+        monkeypatch.setenv("HOROVOD_ELASTIC_MAX_RETRIES", "3")
+        assert Backoff.from_env().schedule() == [1.0, 2.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+class TestFaultInject:
+    def test_parse_kill(self):
+        spec = fault_inject.parse_spec("kill:rank=1:step=3:code=17")
+        assert spec == FaultSpec(action="kill", rank=1, step=3, code=17,
+                                 seconds=spec.seconds, generation=0)
+
+    def test_parse_hang_with_gen(self):
+        spec = fault_inject.parse_spec("hang:rank=0:step=2:seconds=5:gen=1")
+        assert (spec.action, spec.seconds, spec.generation) == ("hang", 5.0, 1)
+
+    @pytest.mark.parametrize("bad", [
+        "explode:rank=0:step=1",   # unknown action
+        "kill:rank=0",             # missing step
+        "kill:step=1",             # missing rank
+        "kill:rank=x:step=1",      # non-integer
+        "",
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            fault_inject.parse_spec(bad)
+
+    def test_maybe_inject_ignores_other_rank_and_step(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FAULT_INJECT", "kill:rank=7:step=3")
+        # wrong rank: nothing happens (we are obviously still alive after)
+        fault_inject.maybe_inject(step=3, rank=0)
+        # right rank, wrong step
+        fault_inject.maybe_inject(step=2, rank=7)
+        # right rank+step, wrong generation
+        monkeypatch.setenv("HOROVOD_FAULT_INJECT", "kill:rank=0:step=3:gen=2")
+        fault_inject.maybe_inject(step=3, rank=0, generation=0)
+
+
+# ---------------------------------------------------------------------------
+# state commit / restore
+# ---------------------------------------------------------------------------
+
+class TestObjectState:
+    def test_commit_restore_round_trip(self):
+        state = ObjectState(batch=0, epoch=0, table={"a": 1})
+        state.batch = 5
+        state.table["a"] = 2
+        state.commit()
+        state.batch = 9
+        state.table["a"] = 99
+        state.restore()
+        assert state.batch == 5
+        assert state.table == {"a": 2}
+
+    def test_snapshot_is_by_value(self):
+        # mutating a live attr must not leak into the committed snapshot
+        state = ObjectState(history=[1, 2])
+        state.commit()
+        state.history.append(3)
+        state.restore()
+        assert state.history == [1, 2]
+
+    def test_reset_callbacks_fire_on_reset(self):
+        calls = []
+        state = ObjectState(x=1)
+        state.register_reset_callbacks([lambda: calls.append("a"),
+                                        lambda: calls.append("b")])
+        state.on_reset()
+        assert calls == ["a", "b"]
+
+
+class TestArrayState:
+    def test_commit_restore_round_trip(self):
+        state = ArrayState(params={"w": np.zeros(3, np.float32)},
+                           optimizer={"m": np.ones(3, np.float32)}, step=0)
+        state.params["w"] = state.params["w"] + 2
+        state.step = 4
+        state.commit()
+        state.params["w"] = state.params["w"] * 50
+        state.optimizer["m"] = state.optimizer["m"] * 50
+        state.step = 7
+        state.restore()
+        assert state.step == 4
+        np.testing.assert_array_equal(state.params["w"], [2, 2, 2])
+        np.testing.assert_array_equal(state.optimizer["m"], [1, 1, 1])
+
+    def test_initial_values_snapshot_at_construction(self):
+        state = ArrayState(params={"w": np.arange(3)}, optimizer=None)
+        state.params["w"] = np.full(3, -1)
+        state.restore()  # failure before the first commit -> starting point
+        np.testing.assert_array_equal(state.params["w"], [0, 1, 2])
+
+    def test_extra_trees(self):
+        state = ArrayState(params=None, optimizer=None,
+                           ema={"w": np.ones(2)})
+        state.ema["w"] = state.ema["w"] * 3
+        state.commit()
+        state.ema["w"] = state.ema["w"] * 100
+        state.restore()
+        np.testing.assert_array_equal(state.ema["w"], [3, 3])
+
+    def test_sync_single_process_no_op(self):
+        hvd.init()
+        try:
+            state = ArrayState(params={"w": np.ones(2)}, optimizer=None,
+                               step=3)
+            state.sync(root_rank=0)
+            assert state.step == 3
+        finally:
+            hvd.shutdown()
+
+    def test_sync_spill_writes_checkpoint(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_ELASTIC_SPILL_SYNC", "1")
+        hvd.init()
+        try:
+            state = ArrayState(params={"w": np.ones(2, np.float32)},
+                               optimizer=None, step=0,
+                               spill_dir=str(tmp_path))
+            state.step = 2
+            state.commit()
+            assert checkpoint.latest_step(str(tmp_path)) == 2
+        finally:
+            hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# rendezvous: long-poll, TTL, key listing
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def rendezvous():
+    server = RendezvousServer(host="127.0.0.1", heartbeat_ttl=0.3)
+    port = server.start()
+    yield server, port
+    server.stop()
+
+
+class TestRendezvous:
+    def test_long_poll_wakes_on_put(self, rendezvous):
+        server, port = rendezvous
+        client = KVStoreClient("127.0.0.1", port, scope="s", timeout=10,
+                               long_poll=5.0)
+        threading.Timer(0.3, client.set, args=("k", b"v")).start()
+        t0 = time.monotonic()
+        assert client.get("k") == b"v"
+        # woken by the PUT's notify, far before the 5s poll window closes
+        assert time.monotonic() - t0 < 3.0
+
+    def test_get_nowait_raises_keyerror(self, rendezvous):
+        _, port = rendezvous
+        client = KVStoreClient("127.0.0.1", port, scope="s", timeout=1)
+        with pytest.raises(KeyError):
+            client.get("missing", wait=False)
+
+    def test_keys_listing(self, rendezvous):
+        _, port = rendezvous
+        client = KVStoreClient("127.0.0.1", port, scope="m", timeout=1)
+        client.set("member.0", b"a")
+        client.set("member.2", b"b")
+        assert client.keys("m") == ["member.0", "member.2"]
+        assert client.keys("empty-scope") == []
+
+    def test_heartbeat_ttl_expires(self, rendezvous):
+        server, port = rendezvous
+        client = KVStoreClient("127.0.0.1", port, scope="heartbeat",
+                               timeout=1)
+        client.set("w0", b"beat")
+        assert server.live_keys("heartbeat") == ["w0"]
+        time.sleep(0.4)  # past the 0.3s TTL
+        assert server.live_keys("heartbeat") == []
+        # an expired heartbeat also reads as absent
+        with pytest.raises(KeyError):
+            client.get("w0", wait=False)
+
+    def test_ttl_param_filters_listing(self, rendezvous):
+        _, port = rendezvous
+        client = KVStoreClient("127.0.0.1", port, scope="g", timeout=1)
+        client.set("old", b"x")
+        time.sleep(0.2)
+        client.set("new", b"y")
+        assert client.keys("g", ttl=0.1) == ["new"]
+        assert client.keys("g") == ["new", "old"]
+
+    def test_server_side_put(self, rendezvous):
+        server, port = rendezvous
+        server.put("elastic.notice", "update", b"notice-1")
+        client = KVStoreClient("127.0.0.1", port, scope="elastic.notice",
+                               timeout=1)
+        assert client.get("update", wait=False) == b"notice-1"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening
+# ---------------------------------------------------------------------------
+
+class TestCheckpointHardening:
+    def test_stale_tmp_cleaned_fresh_kept(self, tmp_path):
+        hvd.init()
+        try:
+            stale = tmp_path / "dead-writer.tmp"
+            stale.write_bytes(b"torn")
+            old = time.time() - 3600
+            os.utime(stale, (old, old))
+            fresh = tmp_path / "live-writer.tmp"
+            fresh.write_bytes(b"in-flight")
+
+            checkpoint.save(str(tmp_path), {"w": np.ones(2)}, step=1)
+
+            assert not stale.exists()
+            assert fresh.exists()
+            assert checkpoint.latest_step(str(tmp_path)) == 1
+        finally:
+            hvd.shutdown()
+
+    def test_save_remains_atomic(self, tmp_path):
+        hvd.init()
+        try:
+            path = checkpoint.save(str(tmp_path), {"w": np.arange(4)}, step=7)
+            assert os.path.basename(path) == "ckpt_7.msgpack"
+            # no droppings
+            assert [n for n in os.listdir(tmp_path)
+                    if n.endswith(".tmp")] == []
+        finally:
+            hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# driver membership math + notices
+# ---------------------------------------------------------------------------
+
+class TestElasticDriver:
+    def test_diff_hosts(self):
+        added, removed = ElasticDriver.diff_hosts(
+            {"a": 2, "b": 2}, {"a": 2, "c": 4})
+        assert added == ["c"]
+        assert removed == ["b"]
+
+    def test_diff_hosts_slot_change_is_both(self):
+        added, removed = ElasticDriver.diff_hosts({"a": 2}, {"a": 4})
+        assert added == ["a"]
+        assert removed == ["a"]
+
+    def test_discovery_script_parsing(self, tmp_path):
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\n"
+                          "echo host1:4\n"
+                          "echo '# comment'\n"
+                          "echo host2\n")
+        script.chmod(0o755)
+        hosts = HostDiscoveryScript(str(script)).find_available_hosts()
+        assert hosts == {"host1": 4, "host2": 1}
+
+    def test_host_change_publishes_notice(self, rendezvous):
+        server, _ = rendezvous
+        snapshots = iter([{"a": 1, "b": 1}, {"a": 1}])
+        discovery = SimpleNamespace(
+            find_available_hosts=lambda: next(snapshots))
+        driver = ElasticDriver(server, discovery, heartbeat_ttl=60)
+        driver._hosts = discovery.find_available_hosts()  # baseline
+        driver._poll_once()  # sees host b removed
+        notice = json.loads(server.get("elastic.notice", "update").decode())
+        assert "b" in notice["notice"]
+        assert notice["seq"] == 1
+
+    def test_heartbeat_loss_detected(self, rendezvous):
+        server, port = rendezvous
+        client = KVStoreClient("127.0.0.1", port, scope="heartbeat",
+                               timeout=1)
+        driver = ElasticDriver(server, discovery=None, heartbeat_ttl=0.2)
+        client.set("w0", b"beat")
+        assert driver._check_heartbeats() == set()      # first seen: live
+        time.sleep(0.3)                                 # beat goes stale
+        assert driver._check_heartbeats() == {"w0"}
+
+
+# ---------------------------------------------------------------------------
+# stall inspector: elastic raise
+# ---------------------------------------------------------------------------
+
+def _stalled_table(age: float, world: int = 2):
+    now = time.monotonic()
+    return SimpleNamespace(
+        pending=lambda: {"t": [SimpleNamespace(rank=0)]},
+        first_request_time=lambda name: now - age)
+
+
+class TestStallElastic:
+    def test_elastic_stall_raises_typed(self):
+        inspector = StallInspector(warning_time_seconds=0.0,
+                                   shutdown_time_seconds=1.0, elastic=True)
+        inspector._last_check = time.monotonic() - 1
+        with pytest.raises(exceptions.WorkerStallError) as exc_info:
+            inspector.check(_stalled_table(age=10.0), world=2)
+        assert exc_info.value.ranks == (1,)
+
+    def test_non_elastic_stall_returns_true(self):
+        inspector = StallInspector(warning_time_seconds=0.0,
+                                   shutdown_time_seconds=1.0, elastic=False)
+        inspector._last_check = time.monotonic() - 1
+        assert inspector.check(_stalled_table(age=10.0), world=2) is True
+
+
+# ---------------------------------------------------------------------------
+# metrics + config knobs
+# ---------------------------------------------------------------------------
+
+class TestElasticMetrics:
+    def test_elastic_families_registered(self):
+        names = {f["name"] if isinstance(f, dict) else f
+                 for f in hvd.metrics()}
+        for metric in ("horovod_elastic_commits_total",
+                       "horovod_elastic_commit_duration_seconds",
+                       "horovod_elastic_restarts_total",
+                       "horovod_elastic_workers_removed_total",
+                       "horovod_elastic_generation",
+                       "horovod_elastic_faults_injected_total"):
+            assert metric in names, (metric, sorted(names))
+
+    def test_commit_moves_counters(self):
+        def commits():
+            values = hvd.metrics()["horovod_elastic_commits_total"]["values"]
+            return values[0]["value"] if values else 0
+
+        before = commits()
+        ObjectState(x=1).commit()
+        assert commits() == before + 1
+
+
+class TestConfigKnob:
+    def test_elastic_config_parsing(self, monkeypatch):
+        from horovod_tpu.utils.env import Config
+
+        assert Config.from_env().elastic is False
+        monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+        assert Config.from_env().elastic is True

@@ -1,0 +1,105 @@
+"""Elastic fault-injection acceptance test (ISSUE.md PR 2).
+
+World=3 over the real socket/native transport; the pytest process hosts
+the rendezvous HTTP KV store (standing in for the tpurun launcher).
+``HOROVOD_FAULT_INJECT=kill:rank=1:step=3`` hard-kills rank 1 inside its
+step-3 commit; ranks 0 and 2 must catch WorkersDownError, re-form into a
+2-worker generation through the store, roll back to the last commit and
+finish all 8 steps with the training invariant (w == step) intact.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.run.rendezvous import RendezvousServer
+from horovod_tpu.runtime.native import native_built
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "elastic_worker.py")
+
+pytestmark = pytest.mark.skipif(
+    not native_built(), reason="native transport not built")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_elastic(world: int, extra_env=None, timeout=240):
+    rendezvous = RendezvousServer(host="127.0.0.1")
+    http_port = rendezvous.start()
+    socket_port = _free_port()
+    procs = []
+    try:
+        for rank in range(world):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.update({
+                "HOROVOD_RANK": str(rank),
+                "HOROVOD_SIZE": str(world),
+                "HOROVOD_CONTROLLER": "socket",
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(socket_port),
+                "HOROVOD_RENDEZVOUS_HTTP_ADDR": "127.0.0.1",
+                "HOROVOD_RENDEZVOUS_HTTP_PORT": str(http_port),
+                "HOROVOD_ELASTIC": "1",
+                # survivors must notice the dead peer quickly, not after
+                # the default 30s verb timeout
+                "HOROVOD_GLOO_TIMEOUT_SECONDS": "5",
+                "JAX_PLATFORMS": "cpu",
+            })
+            env.update(extra_env or {})
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        rendezvous.stop()
+    return procs, outs
+
+
+def test_kill_rank1_at_step3_survivors_finish():
+    """The ISSUE.md acceptance scenario: rank 1 killed at step 3 of an
+    8-step run; ranks 0 and 2 restore from the last commit and complete
+    all 8 steps in a 2-worker generation."""
+    procs, outs = _launch_elastic(
+        3, extra_env={
+            "HOROVOD_FAULT_INJECT": "kill:rank=1:step=3:code=17",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        })
+    # the planted death exits with the injected code
+    assert procs[1].returncode == 17, outs[1]
+    for i in (0, 2):
+        assert procs[i].returncode == 0, (i, outs[i])
+        assert "DONE" in outs[i], (i, outs[i])
+        assert "step=8" in outs[i], (i, outs[i])
+        assert "w=8" in outs[i], (i, outs[i])
+        assert "size=2" in outs[i], (i, outs[i])
+        # metrics satellite: the restart was counted
+        restarts = float(outs[i].split(
+            "elastic_restarts_total=")[1].split()[0])
+        assert restarts >= 1, (i, outs[i])
+
+
+def test_no_fault_runs_clean():
+    """Same harness without injection: the elastic wrapper must be
+    transparent when nothing fails (no spurious re-forms, generation 0)."""
+    procs, outs = _launch_elastic(2, timeout=180)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "step=8" in out, out
+        assert "generation=0" in out, out
+        assert "elastic_restarts_total=0" in out, out
