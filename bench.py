@@ -498,6 +498,100 @@ def control_plane_main(fast: bool = False):
     return results
 
 
+def collectives_main():
+    """Data-plane microbench: steady-state fused allreduce through the
+    background runtime — pipelined dispatch, size-bucketed program cache
+    and persistent fusion buffer all on the hot path. Emits ONE JSON line
+    (the driver records the last parsed line): per-size p50 latency +
+    effective per-worker payload bandwidth, plus the XLA compile count
+    during the timed (post-warmup) phase. The compile count is the
+    regression canary — steady state over fixed named tensors must stay
+    at zero new compiles (tests/test_data_plane.py enforces the same
+    invariant at tier 1)."""
+    hvd.init()
+    from horovod_tpu.runtime import executor as executor_mod
+    from horovod_tpu.runtime.fusion_buffer import bucket_elems
+    from horovod_tpu.runtime.runtime import get_runtime
+
+    ex = get_runtime().executor
+    world = hvd.size()
+    tensors_per_step = 4
+    # Bin groupings are timing-dependent (the background cycle may catch
+    # 1..tensors_per_step of the enqueued tensors per bin) but handles are
+    # synchronized before the next step, so bins never span steps and the
+    # possible fused totals are exactly k*elems for k in 1..tensors_per_step.
+    # Warm up until the program cache covers every such bucket AND a full
+    # step adds zero compiles, so the timed phase can't hit a first-ever
+    # grouping; the early warmup steps enqueue 1, 2, ... tensors to give
+    # each total a deliberate chance to compile.
+    max_warmup_steps, timed_steps = 24, 7
+    rng = np.random.RandomState(0)
+    rows = []
+    steady_compiles = 0
+    for elems in (4096, 65536, 1 << 20):  # 16 KiB .. 4 MiB per tensor
+        payload = rng.randn(world, elems).astype(np.float32)
+
+        def one_step(step, count=tensors_per_step):
+            hs = [hvd.allreduce_async(
+                hvd.stack_per_worker(list(payload + np.float32(step))),
+                name=f"bench/ar{elems}/t{j}")
+                for j in range(count)]
+            for h in hs:
+                hvd.synchronize(h)
+
+        expected = {bucket_elems(k * elems, 4, ex.fusion_buffers.quantum_bytes)
+                    for k in range(1, tensors_per_step + 1)}
+
+        def buckets_warmed():
+            # host-ring-only mode compiles nothing; don't wait on it
+            if not ex._programs:
+                return True
+            keys = list(ex._programs)
+            return all(any(b in k for k in keys) for b in expected)
+
+        quiet = 0
+        for s in range(max_warmup_steps):
+            before = executor_mod._PROGRAM_COMPILES.value
+            one_step(s, count=min(s + 1, tensors_per_step))
+            quiet = quiet + 1 \
+                if executor_mod._PROGRAM_COMPILES.value == before else 0
+            if quiet >= 2 and buckets_warmed():
+                break
+        compiles0 = executor_mod._PROGRAM_COMPILES.value
+        lat = []
+        for s in range(timed_steps):
+            t0 = time.perf_counter()
+            one_step(max_warmup_steps + s)
+            lat.append(time.perf_counter() - t0)
+        new_compiles = executor_mod._PROGRAM_COMPILES.value - compiles0
+        steady_compiles += new_compiles
+        p50 = float(np.median(lat))
+        step_bytes = tensors_per_step * elems * 4  # per-worker payload
+        rows.append({
+            "tensor_bytes": elems * 4,
+            "p50_ms": round(p50 * 1e3, 3),
+            "payload_gb_s": round(step_bytes / p50 / 1e9, 3),
+            "timed_phase_compiles": new_compiles,
+        })
+        log(f"collectives {elems * 4}B/tensor: p50 {rows[-1]['p50_ms']} ms"
+            f"  {rows[-1]['payload_gb_s']} GB/s"
+            f"  compiles(timed)={new_compiles}")
+    result = {
+        "metric": f"fused allreduce p50 latency, {tensors_per_step}-tensor "
+                  f"cycle at {rows[-1]['tensor_bytes']}B/tensor "
+                  f"(np={world}, pipelined data plane)",
+        "value": rows[-1]["p50_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "sizes": rows,
+        "steady_state_compiles": steady_compiles,
+        "program_compiles_total": executor_mod._PROGRAM_COMPILES.value,
+        "program_cache_hits_total": executor_mod._PROGRAM_CACHE_HITS.value,
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -515,8 +609,14 @@ if __name__ == "__main__":
     parser.add_argument("--control-plane", action="store_true",
                         help="benchmark the control plane (negotiation/"
                              "cache/fusion/autotune) at np=4 on host")
+    parser.add_argument("--collectives", action="store_true",
+                        help="microbench the data plane: steady-state "
+                             "fused allreduce latency vs payload size + "
+                             "XLA compile count (one JSON line)")
     cli = parser.parse_args()
-    if cli.control_plane:
+    if cli.collectives:
+        collectives_main()
+    elif cli.control_plane:
         control_plane_main()
     elif cli.model is not None and not cli.all:
         if cli.model in ("bert", "bert-large", "gpt2"):

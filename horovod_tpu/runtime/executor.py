@@ -3,24 +3,32 @@
 TPU-native analogue of the reference's op chain + ``PerformOperation``
 (reference: horovod/common/operations.cc:211-279, ops/operation_manager.cc,
 ops/collective_operations.cc fused memcpy helpers): a fused ALLREDUCE
-response becomes ONE compiled XLA program — flatten each entry, concatenate
-into the fusion buffer, reduce across workers, split back — so XLA emits a
-single large all-reduce over ICI instead of many small ones. Programs are
-cached by (shapes, dtype, op) exactly as the reference reuses its fusion
-buffer; in steady state each cycle re-dispatches a cached executable.
+response becomes ONE compiled XLA reduction over a fused buffer, so XLA
+emits a single large all-reduce over ICI instead of many small ones.
 
-Where the reference memcpys into a persistent 64 MB buffer
-(MemcpyInFusionBuffer, collective_operations.cc:37-81), here the pack and
-unpack are part of the compiled program: XLA fuses them with the collective
-and manages the HBM, which is both faster and simpler than hand-managed
-staging on TPU.
+The data plane is **pipelined** (the reference overlaps collective launch
+with the next fusion-buffer memcpy the same way): ``dispatch`` runs the
+host-side pack — entry slices ``np.copyto``'d into a persistent fusion
+buffer (fusion_buffer.py, the reference's MemcpyInFusionBuffer,
+collective_operations.cc:37-81) — pushes it to device and *launches* the
+jitted reduction, returning a pending token; ``_PendingOp.complete`` later
+blocks on the device result (D2H) and unpacks entry outputs. The cycle
+body dispatches several responses before draining, so packing bin k+1
+overlaps the device reduction and transfer of bin k.
+
+Compiled programs are cached by **size bucket** rather than exact shape:
+the fused flat payload is padded with the reduction's identity up to a
+bucket boundary (power-of-two above ``HOROVOD_FUSION_BUCKET_QUANTUM``),
+so steady-state training compiles O(#buckets) programs total even as
+bin-packing regroups the same tensors differently every cycle. The pad is
+sliced off before unpack; integer sums stay exact (zero padding).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +39,8 @@ from horovod_tpu.core import mesh as mesh_mod
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.ops import collectives
 from horovod_tpu.runtime import types
+from horovod_tpu.runtime.fusion_buffer import (FusionBufferManager,
+                                               reduce_identity)
 
 _OP_LATENCY = _metrics().histogram(
     "horovod_executor_op_duration_seconds",
@@ -43,6 +53,17 @@ _OP_ERRORS = _metrics().counter(
     "horovod_executor_op_errors_total",
     "Responses that completed with an error status, per op type.",
     labelnames=("op",))
+_PROGRAM_COMPILES = _metrics().counter(
+    "horovod_executor_program_compiles_total",
+    "Fused-collective program cache misses (new XLA compiles). Stops "
+    "growing once steady-state traffic maps onto existing size buckets.")
+_PROGRAM_CACHE_HITS = _metrics().counter(
+    "horovod_executor_program_cache_hits_total",
+    "Fused-collective dispatches served by an already-compiled program.")
+_PAD_BYTES = _metrics().counter(
+    "horovod_executor_pad_bytes_total",
+    "Identity-padding bytes appended to fused payloads for size-bucketed "
+    "program reuse.")
 
 
 # reduce_op name -> stacked-axis reducer for the XLA fused programs
@@ -87,6 +108,70 @@ def _widen_for_ring(a, copy: bool = False):
                     "(uint64 cannot be widened losslessly)")
 
 
+class _PendingOp:
+    """Completion token for one dispatched response.
+
+    ``dispatch`` fills ``finish`` with the blocking tail (D2H fetch +
+    unpack) for async paths, or leaves it None when the work completed
+    inline (host ring, eager ops, errors). ``complete`` runs the tail,
+    fires entry callbacks exactly once, and closes the metrics/timeline
+    span opened at dispatch. Responses must be completed in dispatch
+    order (the cycle body's drain preserves it)."""
+
+    __slots__ = ("executor", "op", "entries", "timeline", "name0", "t0",
+                 "finish", "done")
+
+    def __init__(self, executor: "Executor", op: str, entries, timeline):
+        self.executor = executor
+        self.op = op
+        self.entries = entries
+        self.timeline = timeline
+        self.name0 = entries[0].name if entries else "?"
+        self.t0 = time.perf_counter()
+        self.finish: Optional[Callable[[], None]] = None
+        self.done = False
+
+    def _close(self) -> None:
+        self.done = True
+        _OP_LATENCY.labels(op=self.op).observe(time.perf_counter() - self.t0)
+        if self.timeline is not None:
+            self.timeline.end(self.name0)
+
+    def fail(self, status: types.Status) -> None:
+        """Complete every entry with an error status and close the span
+        (reference: ErrorOp, collective_operations.cc:202-205)."""
+        _OP_ERRORS.labels(op=self.op).inc()
+        for e in self.entries:
+            e.complete(status, None)
+        self._close()
+
+    def fail_exc(self, exc: Exception) -> None:
+        from horovod_tpu import exceptions
+
+        if (isinstance(exc, exceptions.WorkersDownError)
+                and self.executor.failure is None):
+            # a data-plane transport loss is a workers-down event even
+            # though this cycle completes "normally" (entries failed by
+            # status): record it so the runtime raises typed errors
+            self.executor.failure = exc
+        self.fail(types.Status.UnknownError(str(exc)))
+
+    def complete(self) -> None:
+        if self.done:
+            return
+        try:
+            if self.finish is not None:
+                self.finish()
+            ok = types.Status.OK()
+            _OP_BYTES.labels(op=self.op).inc(
+                sum(types.entry_nbytes(e) for e in self.entries))
+            for e in self.entries:
+                e.complete(ok, e.output)
+            self._close()
+        except Exception as exc:  # propagate execution failures as statuses
+            self.fail_exc(exc)
+
+
 class Executor:
     """First-match dispatch per response type (reference:
     operation_manager.cc:32-80). Two data planes:
@@ -105,8 +190,21 @@ class Executor:
         self._programs: Dict[tuple, Any] = {}
         self._lock = threading.Lock()
         # typed workers-down verdict from a data-plane failure (see
-        # execute's except clause); lifted by the runtime's cycle body
+        # _PendingOp.fail_exc); lifted by the runtime's cycle body
         self.failure = None
+        # persistent host staging (reference: FusionBufferManager) + the
+        # size-bucket policy keying the program caches
+        quantum = None
+        try:
+            from horovod_tpu.core import state as state_mod
+
+            quantum = state_mod.global_state().config.fusion_bucket_quantum
+        except Exception:
+            pass  # direct construction in tests / tools: use the default
+        self.fusion_buffers = (FusionBufferManager(quantum)
+                               if quantum is not None
+                               else FusionBufferManager())
+        self._ag_staging = bytearray()  # allgather wire staging (reused)
         # Multi-process with a global mesh (jax.distributed): the hot op
         # (allreduce) must ride XLA collectives over ICI/DCN, not the host
         # TCP ring — the ring stays as control plane + fallback. Requires
@@ -135,21 +233,21 @@ class Executor:
 
         return mesh_mod.replicated_sharding(self.mesh)
 
-    def _fused_allreduce_program(self, shapes, dtype, reduce_op: str,
+    def _fused_allreduce_program(self, rows: int, n: int, dtype,
+                                 reduce_op: str,
                                  hierarchical: bool = False):
-        key = ("fused_allreduce", shapes, str(dtype), reduce_op,
+        """One compiled reduction per (rows, bucket, dtype, op[, hier]):
+        input is the packed fusion buffer (rows, n) — one row per worker —
+        reduced over the worker axis, output replicated. Keyed by the
+        size bucket, not the member shapes, so regrouped bins reuse it."""
+        key = ("fused_allreduce", rows, n, str(dtype), reduce_op,
                hierarchical)
         with self._lock:
             fn = self._programs.get(key)
             if fn is not None:
+                _PROGRAM_CACHE_HITS.inc()
                 return fn
-
-        sizes = []
-        for s in shapes:
-            n = 1
-            for d in s[1:]:
-                n *= int(d)
-            sizes.append(n)
+        _PROGRAM_COMPILES.inc()
 
         if hierarchical:
             # two-level reduction over the fused buffer (shared body with
@@ -174,18 +272,7 @@ class Executor:
             def reduce_buf(buf):
                 return reducer(buf, axis=0)
 
-        def f(*tensors):
-            flat = [t.reshape(t.shape[0], -1) for t in tensors]
-            buf = jnp.concatenate(flat, axis=1) if len(flat) > 1 else flat[0]
-            red = reduce_buf(buf)
-            outs = []
-            off = 0
-            for shape, n in zip(shapes, sizes):
-                outs.append(red[off:off + n].reshape(shape[1:]))
-                off += n
-            return tuple(outs)
-
-        fn = jax.jit(f, out_shardings=self._replicated())
+        fn = jax.jit(reduce_buf, out_shardings=self._replicated())
         with self._lock:
             self._programs[key] = fn
         return fn
@@ -198,25 +285,32 @@ class Executor:
 
     def execute(self, response, entries: List[types.TensorTableEntry],
                 timeline=None) -> None:
-        """Run one (fused) response and fire entry callbacks.
+        """Run one (fused) response synchronously: dispatch + complete.
+        Kept for callers that don't pipeline (and as the un-overlapped
+        baseline — semantics identical to dispatch().complete())."""
+        self.dispatch(response, entries, timeline=timeline).complete()
 
-        reference: PerformOperation (operations.cc:211-279) — statuses are
-        delivered through per-entry callbacks; an ERROR response maps to an
-        error status on every entry (ErrorOp,
-        collective_operations.cc:202-205).
+    def dispatch(self, response, entries: List[types.TensorTableEntry],
+                 timeline=None) -> _PendingOp:
+        """Stage one (fused) response onto the data plane and return a
+        pending token; ``token.complete()`` blocks on the result and fires
+        entry callbacks (reference: PerformOperation, operations.cc:211-279
+        — statuses are delivered through per-entry callbacks; an ERROR
+        response maps to an error status on every entry).
+
+        Asynchronous paths (the XLA fused allreduces) launch here and
+        fetch in complete(); host-ring and eager paths run to completion
+        here and complete() only fires callbacks — the drain order is the
+        same either way.
         """
-        name0 = entries[0].name if entries else "?"
-        op = response.response_type
-        t0 = time.perf_counter()
+        pend = _PendingOp(self, response.response_type, entries, timeline)
         try:
             if timeline is not None:
-                timeline.start(name0, response.response_type)
+                timeline.start(pend.name0, response.response_type)
             if response.response_type == types.ERROR:
-                status = types.Status.PreconditionError(response.error_message)
-                _OP_ERRORS.labels(op=op).inc()
-                for e in entries:
-                    e.complete(status, None)
-                return
+                pend.fail(
+                    types.Status.PreconditionError(response.error_message))
+                return pend
 
             if response.response_type == types.ALLREDUCE:
                 if (self.net is not None and self._spmd_world
@@ -234,14 +328,16 @@ class Executor:
                         dt = e.tensor.dtype  # np.dtype for numpy AND jax
                         (wide if dt.itemsize == 8 and dt.kind in "iuf"
                          else rest).append(e)
-                    if rest:
-                        self._execute_allreduce_spmd(rest, timeline)
                     if wide:
                         self._execute_allreduce_host(wide, timeline)
+                    if rest:
+                        pend.finish = self._dispatch_allreduce_spmd(
+                            rest, timeline)
                 elif self.net is not None:
                     self._execute_allreduce_host(entries, timeline)
                 else:
-                    self._execute_allreduce(response, entries, timeline)
+                    pend.finish = self._dispatch_allreduce(
+                        response, entries, timeline)
             elif response.response_type == types.ALLGATHER:
                 if self.net is not None:
                     self._execute_allgather_host(response, entries)
@@ -270,35 +366,106 @@ class Executor:
             else:
                 raise ValueError(
                     f"unknown response type {response.response_type}")
+        except Exception as exc:
+            pend.fail_exc(exc)
+        return pend
 
-            ok = types.Status.OK()
-            _OP_BYTES.labels(op=op).inc(
-                sum(types.entry_nbytes(e) for e in entries))
-            for e in entries:
-                e.complete(ok, e.output)
-        except Exception as exc:  # propagate execution failures as statuses
-            status = types.Status.UnknownError(str(exc))
-            _OP_ERRORS.labels(op=op).inc()
-            from horovod_tpu import exceptions
+    # -- fused pack/pad helpers --------------------------------------------
+    def _pack_fused(self, arrays, rows: int, dtype, reduce_op: str):
+        """Copy flattened entry payloads into a leased persistent fusion
+        buffer of shape (rows, bucket) and pad the tail columns with the
+        reduction identity. Returns (lease, total_elems_per_row)."""
+        import numpy as np
 
-            if (isinstance(exc, exceptions.WorkersDownError)
-                    and self.failure is None):
-                # a data-plane transport loss is a workers-down event even
-                # though this cycle completes "normally" (entries failed by
-                # status): record it so the runtime raises typed errors
-                self.failure = exc
-            for e in entries:
-                e.complete(status, None)
-        finally:
-            _OP_LATENCY.labels(op=op).observe(time.perf_counter() - t0)
+        sizes = [a.size // rows for a in arrays]
+        total = sum(sizes)
+        lease = self.fusion_buffers.acquire(rows, total, dtype)
+        buf = lease.array
+        off = 0
+        for a, n in zip(arrays, sizes):
+            np.copyto(buf[:, off:off + n], a.reshape(rows, n))
+            off += n
+        if lease.capacity > total:
+            buf[:, total:] = reduce_identity(dtype, reduce_op)
+            _PAD_BYTES.inc(
+                (lease.capacity - total) * rows * buf.dtype.itemsize)
+        return lease, total
+
+    # -- single-controller XLA data plane ----------------------------------
+    def _dispatch_allreduce(self, response, entries, timeline=None):
+        """Fused allreduce over the global mesh: pack worker-stacked
+        entries into the (world, bucket) fusion buffer, launch the
+        bucket-keyed compiled reduction, and return the completion tail
+        (D2H fetch + unpack). Replicated inputs need no collective and
+        complete inline."""
+        import numpy as np
+
+        stacked, replicated = [], []
+        for e in entries:
+            (stacked if collectives._is_worker_stacked(e.tensor)
+             else replicated).append(e)
+
+        # Replicated inputs need no collective: every worker already holds
+        # the same value (single-controller invariant). average/min/max of
+        # identical copies is the identity; sum/product scale by world.
+        size = collectives.state_mod.global_state().size
+        for e in replicated:
+            if e.reduce_op == types.REDUCE_SUM:
+                e.output = e.tensor * size
+            elif e.reduce_op == types.REDUCE_PRODUCT:
+                e.output = e.tensor ** size
+            else:
+                e.output = e.tensor
+
+        if not stacked:
+            return None
+        reduce_op = stacked[0].reduce_op
+        name0 = stacked[0].name
+        if timeline is not None:
+            timeline.activity_start(name0,
+                                    timeline_mod.MEMCPY_IN_FUSION_BUFFER)
+        arrays = [np.asarray(e.tensor) for e in stacked]
+        rows = arrays[0].shape[0]  # worker-stacked: leading dim == world
+        dtype = arrays[0].dtype
+        lease, total = self._pack_fused(arrays, rows, dtype, reduce_op)
+        if timeline is not None:
+            timeline.activity_end(name0)
+            timeline.activity_start(name0, timeline_mod.XLA_COLLECTIVE)
+        hier = (collectives.state_mod.global_state()
+                .config.hierarchical_allreduce
+                and self.hierarchical_available()
+                and reduce_op in (types.REDUCE_SUM, types.REDUCE_AVERAGE))
+        fn = self._fused_allreduce_program(rows, lease.capacity, dtype,
+                                           reduce_op, hier)
+        out_dev = fn(lease.array)  # async launch; fetch in finish()
+
+        shapes = [np.asarray(a.shape[1:]) for a in arrays]
+        sizes = [a.size // rows for a in arrays]
+
+        def finish():
+            red = np.asarray(out_dev)  # D2H, blocks on the reduction
+            self.fusion_buffers.release(lease)
             if timeline is not None:
-                timeline.end(name0)
+                timeline.activity_end(name0)
+                timeline.activity_start(
+                    name0, timeline_mod.MEMCPY_OUT_FUSION_BUFFER)
+            off = 0
+            for e, shape, n in zip(stacked, shapes, sizes):
+                e.output = red[off:off + n].reshape(tuple(shape))
+                off += n
+            if timeline is not None:
+                timeline.activity_end(name0)
+
+        return finish
 
     # -- host (multi-process) data plane -----------------------------------
     def _execute_allreduce_host(self, entries, timeline=None) -> None:
-        """Fused host ring allreduce: pack all entries into one flat buffer
-        (the literal fusion-buffer memcpy of the reference,
-        collective_operations.cc:37-81), one ring pass, unpack."""
+        """Fused host ring allreduce: pack all entries into one flat
+        persistent buffer (the literal fusion-buffer memcpy of the
+        reference, collective_operations.cc:37-81), one ring pass, unpack.
+        No bucket padding on the wire — the ring isn't compiled, so extra
+        bytes would cost bandwidth for nothing; the persistent slab is
+        bucket-sized and sliced to the exact payload."""
         import numpy as np
 
         world = self.net.world
@@ -308,7 +475,13 @@ class Executor:
         if timeline is not None:
             timeline.activity_start(entries[0].name,
                                     timeline_mod.MEMCPY_IN_FUSION_BUFFER)
-        buf = np.concatenate([a.ravel() for a in wire])
+        total = sum(w.size for w in wire)
+        lease = self.fusion_buffers.acquire(1, total, wire[0].dtype)
+        buf = lease.array.ravel()[:total]
+        off = 0
+        for w in wire:
+            np.copyto(buf[off:off + w.size], w.ravel())
+            off += w.size
         if timeline is not None:
             timeline.activity_end(entries[0].name)
             timeline.activity_start(entries[0].name, "NET_RING_ALLREDUCE")
@@ -317,24 +490,30 @@ class Executor:
         if timeline is not None:
             timeline.activity_end(entries[0].name)
         if reduce_op == types.REDUCE_AVERAGE:
-            buf = buf / world
+            buf = buf / world  # new array; the slab is released unscaled
         off = 0
         for e, orig, w in zip(entries, arrays, wire):
             n = w.size
+            # astype(copy=True is the default) detaches the output from
+            # the reusable slab even when dtypes already match
             out = buf[off:off + n].reshape(orig.shape).astype(orig.dtype)
             e.output = out
             off += n
+        self.fusion_buffers.release(lease)
 
     def _fused_spmd_allreduce_program(self, n: int, dtype, reduce_op: str):
-        """One compiled XLA program per (flat size, dtype, op): the global
-        stacked fusion buffer (P, n) — one row per process, sharded over the
-        per-process sub-mesh — is reduced over the process axis, output
-        replicated. Integer sums are exact (no duplication)."""
+        """One compiled XLA program per (size bucket, dtype, op): the
+        global stacked fusion buffer (P, n) — one row per process, sharded
+        over the per-process sub-mesh — is reduced over the process axis,
+        output replicated. Integer sums are exact (no duplication, and
+        bucket padding is zeros for sum/average)."""
         key = ("spmd_allreduce", n, str(dtype), reduce_op)
         with self._lock:
             fn = self._programs.get(key)
             if fn is not None:
+                _PROGRAM_CACHE_HITS.inc()
                 return fn
+        _PROGRAM_COMPILES.inc()
 
         replicated = NamedSharding(self._proc_mesh, P())
         reducer = _REDUCERS[reduce_op]
@@ -347,54 +526,89 @@ class Executor:
             self._programs[key] = fn
         return fn
 
-    def _execute_allreduce_spmd(self, entries, timeline=None) -> None:
+    def _dispatch_allreduce_spmd(self, entries, timeline=None):
         """Fused allreduce over a one-device-per-process sub-mesh in
-        multi-process mode: pack entries into one flat host buffer, place it
-        on this process's row of a (P, n) global array (single host→device
-        transfer), reduce with a compiled XLA collective (rides ICI/DCN),
-        unpack the replicated result. The analogue of NCCLAllreduce on the
-        reference's GPU path (nccl_operations.cc:55-105) with XLA in place
-        of NCCL."""
+        multi-process mode: pack entries into the flat persistent fusion
+        buffer (padded to its size bucket — deterministic across ranks,
+        the sizes are negotiated), place it on this process's row of a
+        (P, bucket) global array (single host→device transfer), launch
+        the compiled XLA collective (rides ICI/DCN), and return the
+        completion tail that fetches + unpacks the replicated result. The
+        analogue of NCCLAllreduce on the reference's GPU path
+        (nccl_operations.cc:55-105) with XLA in place of NCCL."""
         import numpy as np
 
+        reduce_op = entries[0].reduce_op
+        name0 = entries[0].name
         arrays = [np.asarray(e.tensor) for e in entries]
         if timeline is not None:
-            timeline.activity_start(entries[0].name,
+            timeline.activity_start(name0,
                                     timeline_mod.MEMCPY_IN_FUSION_BUFFER)
-        flat = np.concatenate([a.ravel() for a in arrays])
+        lease, total = self._pack_fused(arrays, 1, arrays[0].dtype,
+                                        reduce_op)
+        flat = lease.array  # (1, bucket) — already the row layout
         mesh = self._proc_mesh
         n_proc = mesh.devices.size
         row_sharding = NamedSharding(mesh, P("proc"))
         local_dev = [d for d in mesh.devices.flatten()
                      if d.process_index == jax.process_index()][0]
-        local_row = jax.device_put(flat[None], local_dev)
+        local_row = jax.device_put(flat, local_dev)
         global_stack = jax.make_array_from_single_device_arrays(
-            (n_proc,) + flat.shape, row_sharding, [local_row])
+            (n_proc, lease.capacity), row_sharding, [local_row])
         if timeline is not None:
-            timeline.activity_end(entries[0].name)
-            timeline.activity_start(entries[0].name,
-                                    timeline_mod.XLA_COLLECTIVE)
+            timeline.activity_end(name0)
+            timeline.activity_start(name0, timeline_mod.XLA_COLLECTIVE)
         fn = self._fused_spmd_allreduce_program(
-            int(flat.size), flat.dtype, entries[0].reduce_op)
-        out = np.asarray(fn(global_stack))
-        if timeline is not None:
-            timeline.activity_end(entries[0].name)
-        off = 0
-        for e, a in zip(entries, arrays):
-            e.output = out[off:off + a.size].reshape(a.shape).astype(
-                a.dtype, copy=False)
-            off += a.size
+            lease.capacity, flat.dtype, reduce_op)
+        out_dev = fn(global_stack)  # async launch; fetch in finish()
+
+        def finish():
+            out = np.asarray(out_dev)  # D2H, blocks on the collective
+            self.fusion_buffers.release(lease)
+            if timeline is not None:
+                timeline.activity_end(name0)
+                timeline.activity_start(
+                    name0, timeline_mod.MEMCPY_OUT_FUSION_BUFFER)
+            off = 0
+            for e, a in zip(entries, arrays):
+                e.output = out[off:off + a.size].reshape(a.shape).astype(
+                    a.dtype, copy=False)
+                off += a.size
+            if timeline is not None:
+                timeline.activity_end(name0)
+
+        return finish
 
     def _execute_allgather_host(self, response, entries) -> None:
+        """Per-entry variable-size gather on the host wire. The wire wants
+        one contiguous byte blob per entry; instead of a fresh
+        ``tobytes()`` copy each time, contiguous arrays go out zero-copy
+        (a ctypes view of their memory) and non-contiguous ones stage
+        through one persistent bytearray reused across entries/cycles."""
+        import ctypes
+
         import numpy as np
 
         for e in entries:
-            local = np.ascontiguousarray(np.asarray(e.tensor))
-            blobs = self.net.allgatherv(local.tobytes())
+            local = np.asarray(e.tensor)
+            nb = local.nbytes
+            if local.flags.c_contiguous and nb:
+                blob = (ctypes.c_char * nb).from_address(
+                    local.ctypes.data) if local.flags.writeable else \
+                    ctypes.cast(local.ctypes.data,
+                                ctypes.POINTER(ctypes.c_char * nb)).contents
+            else:
+                if len(self._ag_staging) < nb:
+                    self._ag_staging = bytearray(nb)
+                view = np.frombuffer(self._ag_staging, dtype=local.dtype,
+                                     count=local.size)
+                np.copyto(view.reshape(local.shape), local)
+                blob = (ctypes.c_char * nb).from_buffer(self._ag_staging)
+            blobs = self.net.allgatherv(blob)
             parts = []
             trailing = local.shape[1:]
-            for r, blob in enumerate(blobs):
-                a = np.frombuffer(blob, dtype=local.dtype)
+            for r, blob_r in enumerate(blobs):
+                a = np.frombuffer(blob_r, dtype=local.dtype)
                 first = (response.tensor_sizes[r] if response.tensor_sizes
                          else a.size // max(int(np.prod(trailing)) or 1, 1))
                 parts.append(a.reshape((first,) + trailing))
@@ -442,43 +656,3 @@ class Executor:
                 e.root_rank)
             e.output = np.frombuffer(
                 blob, dtype=local.dtype).reshape(local.shape)
-
-    def _execute_allreduce(self, response, entries, timeline=None) -> None:
-        stacked, replicated = [], []
-        for e in entries:
-            (stacked if collectives._is_worker_stacked(e.tensor)
-             else replicated).append(e)
-
-        # Replicated inputs need no collective: every worker already holds
-        # the same value (single-controller invariant). average/min/max of
-        # identical copies is the identity; sum/product scale by world.
-        size = collectives.state_mod.global_state().size
-        for e in replicated:
-            if e.reduce_op == types.REDUCE_SUM:
-                e.output = e.tensor * size
-            elif e.reduce_op == types.REDUCE_PRODUCT:
-                e.output = e.tensor ** size
-            else:
-                e.output = e.tensor
-
-        if not stacked:
-            return
-        reduce_op = stacked[0].reduce_op
-        shapes = tuple(tuple(e.tensor.shape) for e in stacked)
-        dtype = stacked[0].tensor.dtype
-        if timeline is not None:
-            timeline.activity_start(stacked[0].name,
-                                    timeline_mod.MEMCPY_IN_FUSION_BUFFER)
-            timeline.activity_end(stacked[0].name)
-            timeline.activity_start(stacked[0].name,
-                                    timeline_mod.XLA_COLLECTIVE)
-        hier = (collectives.state_mod.global_state()
-                .config.hierarchical_allreduce
-                and self.hierarchical_available()
-                and reduce_op in (types.REDUCE_SUM, types.REDUCE_AVERAGE))
-        fn = self._fused_allreduce_program(shapes, dtype, reduce_op, hier)
-        outs = fn(*[e.tensor for e in stacked])
-        for e, out in zip(stacked, outs):
-            e.output = out
-        if timeline is not None:
-            timeline.activity_end(stacked[0].name)

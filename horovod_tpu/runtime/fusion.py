@@ -6,16 +6,17 @@ horovod/common/controller.cc:551-672) and the fusion-buffer design
 small tensors become one collective over a single fused buffer, trading a
 little packing work for far fewer collective launches.
 
-On TPU the "buffer" is not a persistent allocation we memcpy into — the
-fused pack/reduce/unpack is one XLA program (concat → psum → split) that
-XLA lays out in HBM itself; what survives from the reference is the
-*batching decision*: which responses fuse, bounded by
-``HOROVOD_FUSION_THRESHOLD`` bytes, with look-ahead past dtype mismatches
-(reference: controller.cc:595-650).
+This module owns the *batching decision*: which responses fuse, bounded
+by ``HOROVOD_FUSION_THRESHOLD`` bytes, with look-ahead past dtype
+mismatches (reference: controller.cc:595-650). The buffer itself lives in
+``fusion_buffer.py`` — a persistent host staging slab the executor packs
+with ``np.copyto`` (the reference's FusionBufferManager) before launching
+one bucket-keyed XLA reduction over it.
 """
 
 from __future__ import annotations
 
+import collections
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -79,17 +80,21 @@ def fuse_responses_py(responses: List[msg.Response],
     flushing the bin, so a stray fp32 tensor between bf16 gradients does
     not break the bf16 bin — then form later bins from the skipped ones.
     """
-    remaining = list(responses)
+    # deque walk: popleft is O(1), each response is examined once per bin
+    # it fails to join (O(n·bins) total) — a list with pop(0) re-shifts
+    # the whole tail for every bin head, going O(n²) on large backlogs
+    remaining = collections.deque(responses)
     fused: List[msg.Response] = []
     while remaining:
-        head = remaining.pop(0)
+        head = remaining.popleft()
         if head.response_type != types.ALLREDUCE:
             fused.append(head)
             continue
         acc_names = list(head.tensor_names)
         acc_bytes = response_bytes(head, request_by_name)
-        skipped: List[msg.Response] = []
-        for cand in remaining:
+        skipped: "collections.deque" = collections.deque()
+        while remaining:
+            cand = remaining.popleft()
             if _fusable(head, cand, request_by_name):
                 nbytes = response_bytes(cand, request_by_name)
                 if acc_bytes + nbytes <= threshold_bytes:

@@ -1,0 +1,144 @@
+"""Persistent host fusion buffers + size-bucket policy for the data plane.
+
+TPU-native analogue of the reference's ``FusionBufferManager``
+(reference: horovod/common/fusion_buffer_manager.cc): instead of
+``np.concatenate`` allocating a fresh host staging array every cycle, the
+executor packs entry slices into a reusable per-(rows, bucket, dtype)
+buffer with ``np.copyto``. Buffers are leased for the lifetime of one
+dispatched response — the pipelined cycle body can have several responses
+in flight, so a key may hold up to ``HOROVOD_CYCLE_PIPELINE_DEPTH``
+buffers (each is reused as soon as its response completes).
+
+The same module owns the **size-bucket policy** that keys the executor's
+compiled-program caches: a fused flat payload is padded up to the next
+power-of-two boundary above ``HOROVOD_FUSION_BUCKET_QUANTUM`` bytes
+(identity below it), so steady-state training compiles O(#buckets) XLA
+programs no matter how bin-packing regroups the same tensors from cycle
+to cycle. Padding must not perturb the reduction — ``reduce_identity``
+supplies the dtype-appropriate neutral element per reduce op (zeros for
+sum/avg keep integer sums exact; ±inf / integer extremes for min/max;
+ones for product) and the executor slices the pad off before unpacking.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.runtime import types
+from horovod_tpu.utils import env as env_mod
+
+_BUF_ALLOCS = _metrics().counter(
+    "horovod_fusion_buffer_allocs_total",
+    "Persistent fusion-buffer allocations (new (rows, bucket, dtype) "
+    "slabs; steady state stops growing).")
+_BUF_REUSES = _metrics().counter(
+    "horovod_fusion_buffer_reuses_total",
+    "Fusion-buffer leases served from an existing slab (no allocation).")
+_BUF_BYTES = _metrics().gauge(
+    "horovod_fusion_buffer_bytes",
+    "Total bytes held in persistent fusion buffers.")
+
+DEFAULT_BUCKET_QUANTUM_BYTES = env_mod.DEFAULT_FUSION_BUCKET_QUANTUM_BYTES
+
+
+def bucket_elems(nelems: int, itemsize: int, quantum_bytes: int) -> int:
+    """Element count of the size bucket holding ``nelems`` items.
+
+    Payloads at or under the quantum keep their exact size (identity —
+    tiny tensors don't pay padding for cache keys they'd rarely share);
+    larger payloads round up to the next power-of-two multiple of the
+    quantum, so arbitrary bin-packing totals collapse onto O(log) keys.
+    """
+    nbytes = nelems * itemsize
+    if quantum_bytes <= 0 or nbytes <= quantum_bytes:
+        return nelems
+    bucket = quantum_bytes
+    while bucket < nbytes:
+        bucket <<= 1
+    return -(-bucket // itemsize)  # ceil: quantum need not divide itemsize
+
+
+def reduce_identity(dtype, reduce_op: str):
+    """Neutral element of ``reduce_op`` for ``dtype`` — the only value the
+    pad region may hold (reduced pad columns are sliced off before unpack,
+    but the reduction itself must not overflow or NaN on them)."""
+    dt = np.dtype(dtype)
+    if reduce_op in (types.REDUCE_SUM, types.REDUCE_AVERAGE):
+        return np.zeros((), dt)[()]
+    if reduce_op == types.REDUCE_PRODUCT:
+        return np.ones((), dt)[()]
+    if reduce_op in (types.REDUCE_MIN, types.REDUCE_MAX):
+        want_max = reduce_op == types.REDUCE_MIN
+        if dt.kind in ("i", "u"):
+            info = np.iinfo(dt)
+            return dt.type(info.max if want_max else info.min)
+        if dt.kind == "b":
+            return dt.type(want_max)
+        # floats incl. float16/bfloat16 (ml_dtypes registers kind "V"
+        # types that still carry infinities)
+        return dt.type(np.inf if want_max else -np.inf)
+    raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+
+class BufferLease:
+    """One checked-out fusion buffer: ``array`` is (rows, capacity) in the
+    requested dtype; ``capacity`` is the bucket element count per row."""
+
+    __slots__ = ("array", "capacity", "_key")
+
+    def __init__(self, array: np.ndarray, capacity: int, key: tuple):
+        self.array = array
+        self.capacity = capacity
+        self._key = key
+
+
+class FusionBufferManager:
+    """Reusable host staging arrays keyed by (rows, bucket, dtype).
+
+    ``acquire`` hands out a free slab (allocating only on first sight of a
+    key, or while more leases are outstanding than slabs exist — bounded
+    by the cycle pipeline depth); ``release`` returns it for the next
+    cycle. Only the background cycle thread packs, so contention is nil;
+    the lock merely keeps the free lists consistent if an elastic restart
+    tears the runtime down mid-flight.
+    """
+
+    def __init__(self,
+                 quantum_bytes: int = DEFAULT_BUCKET_QUANTUM_BYTES) -> None:
+        self.quantum_bytes = int(quantum_bytes)
+        self._free: Dict[Tuple[int, int, str], List[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self._total_bytes = 0
+
+    def bucket_elems(self, nelems: int, itemsize: int) -> int:
+        return bucket_elems(nelems, itemsize, self.quantum_bytes)
+
+    def acquire(self, rows: int, nelems: int, dtype) -> BufferLease:
+        """Lease a (rows, bucket(nelems)) staging array. The caller packs
+        real payload into ``array[:, :nelems]`` and pads the rest."""
+        dt = np.dtype(dtype)
+        capacity = self.bucket_elems(int(nelems), dt.itemsize)
+        key = (int(rows), capacity, dt.str)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                _BUF_REUSES.inc()
+                return BufferLease(free.pop(), capacity, key)
+        _BUF_ALLOCS.inc()
+        array = np.empty((int(rows), capacity), dt)
+        with self._lock:
+            self._total_bytes += array.nbytes
+            _BUF_BYTES.set(self._total_bytes)
+        return BufferLease(array, capacity, key)
+
+    def release(self, lease: BufferLease) -> None:
+        with self._lock:
+            self._free.setdefault(lease._key, []).append(lease.array)
+
+    def allocated_bytes(self) -> int:
+        with self._lock:
+            return self._total_bytes

@@ -19,6 +19,7 @@ tracing.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Optional
@@ -45,6 +46,10 @@ _CYCLE_TENSORS = _metrics().histogram(
 _HANDLE_WAIT = _metrics().histogram(
     "horovod_handle_wait_seconds",
     "Caller time blocked in RuntimeHandle.wait().")
+_PIPELINE_DEPTH = _metrics().gauge(
+    "horovod_cycle_pipeline_depth",
+    "Responses currently in flight on the pipelined data plane (bounded "
+    "by HOROVOD_CYCLE_PIPELINE_DEPTH).")
 
 
 class RuntimeHandle:
@@ -510,29 +515,54 @@ class Runtime:
         _CYCLE_TENSORS.observe(
             sum(len(r.tensor_names) for r in responses))
         cycle_bytes = 0
-        for response in responses:
-            entries = self.queue.get_entries(response.tensor_names)
-            if entries:
-                try:
-                    self.executor.execute(response, entries,
-                                          timeline=self.timeline)
-                    if self._autotune_active:
-                        # JAX dispatch is async: block so the score
-                        # measures the collective itself, not host
-                        # dispatch latency (the reference scores
-                        # completed-op wall time)
-                        jax.block_until_ready(
-                            [e.output for e in entries
-                             if e.output is not None])
-                        for e in entries:
-                            cycle_bytes += types.entry_nbytes(e)
-                except Exception:
-                    # these entries left the table already — complete any
-                    # whose handle hasn't fired so callers error instead
-                    # of hanging (execute() handles its own failures; this
-                    # covers everything around it)
-                    _fail_incomplete_entries(entries)
-                    raise
+        # Pipelined execution: dispatch up to ``depth`` responses before
+        # draining the oldest completion, so host packing of bin k+1
+        # overlaps the device reduction and transfer of bin k (the
+        # reference likewise overlaps the fusion-buffer memcpy with the
+        # in-flight collective). Completions drain in dispatch order.
+        depth = max(1, self._st.config.cycle_pipeline_depth)
+        pending: "collections.deque" = collections.deque()
+
+        def drain_one() -> None:
+            nonlocal cycle_bytes
+            tok, tok_entries = pending.popleft()
+            _PIPELINE_DEPTH.set(len(pending))
+            tok.complete()  # never raises: failures become entry statuses
+            if self._autotune_active:
+                # JAX dispatch is async: block so the score measures the
+                # collective itself, not host dispatch latency (the
+                # reference scores completed-op wall time)
+                jax.block_until_ready(
+                    [e.output for e in tok_entries
+                     if e.output is not None])
+                for e in tok_entries:
+                    cycle_bytes += types.entry_nbytes(e)
+
+        cycle_entries = []  # every entry list touched this cycle
+        try:
+            for response in responses:
+                entries = self.queue.get_entries(response.tensor_names)
+                if not entries:
+                    continue
+                cycle_entries.append(entries)
+                tok = self.executor.dispatch(response, entries,
+                                             timeline=self.timeline)
+                pending.append((tok, entries))
+                _PIPELINE_DEPTH.set(len(pending))
+                while len(pending) >= depth:
+                    drain_one()
+            while pending:
+                drain_one()
+        except Exception:
+            # these entries left the table already — complete any whose
+            # handle hasn't fired so callers error instead of hanging
+            # (dispatch/complete handle their own failures; this covers
+            # everything around them, for every response in flight)
+            for entries in cycle_entries:
+                _fail_incomplete_entries(entries)
+            raise
+        finally:
+            _PIPELINE_DEPTH.set(0)
         if self.executor.failure is not None and self.failure is None:
             self.failure = self.executor.failure
         if self._autotune_active:

@@ -45,10 +45,14 @@ HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
 HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_CYCLE_PIPELINE_DEPTH = "HOROVOD_CYCLE_PIPELINE_DEPTH"
+HOROVOD_FUSION_BUCKET_QUANTUM = "HOROVOD_FUSION_BUCKET_QUANTUM"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference: operations.cc:386
 DEFAULT_CACHE_CAPACITY = 1024  # reference: global_state.h:88
+DEFAULT_CYCLE_PIPELINE_DEPTH = 2
+DEFAULT_FUSION_BUCKET_QUANTUM_BYTES = 64 * 1024
 
 
 def _get_int(name: str, default: int) -> int:
@@ -109,6 +113,11 @@ class Config:
     # elastic mode: stall shutdown and peer loss raise catchable
     # WorkersDownError instead of tearing the process down
     elastic: bool = False
+    # data-plane pipelining: responses in flight per cycle (1 = serial)
+    cycle_pipeline_depth: int = DEFAULT_CYCLE_PIPELINE_DEPTH
+    # size-bucket quantum for the fused program cache; payloads at or
+    # under it keep exact sizes, larger ones pad to a power of two
+    fusion_bucket_quantum: int = DEFAULT_FUSION_BUCKET_QUANTUM_BYTES
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -143,6 +152,13 @@ class Config:
             hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_get_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             elastic=_get_bool(HOROVOD_ELASTIC),
+            cycle_pipeline_depth=_get_int(
+                HOROVOD_CYCLE_PIPELINE_DEPTH, DEFAULT_CYCLE_PIPELINE_DEPTH
+            ),
+            fusion_bucket_quantum=_get_int(
+                HOROVOD_FUSION_BUCKET_QUANTUM,
+                DEFAULT_FUSION_BUCKET_QUANTUM_BYTES,
+            ),
         )
 
 
