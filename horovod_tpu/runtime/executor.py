@@ -7,14 +7,19 @@ response becomes ONE compiled XLA reduction over a fused buffer, so XLA
 emits a single large all-reduce over ICI instead of many small ones.
 
 The data plane is **pipelined** (the reference overlaps collective launch
-with the next fusion-buffer memcpy the same way): ``dispatch`` runs the
-host-side pack — entry slices ``np.copyto``'d into a persistent fusion
-buffer (fusion_buffer.py, the reference's MemcpyInFusionBuffer,
-collective_operations.cc:37-81) — pushes it to device and *launches* the
-jitted reduction, returning a pending token; ``_PendingOp.complete`` later
-blocks on the device result (D2H) and unpacks entry outputs. The cycle
-body dispatches several responses before draining, so packing bin k+1
-overlaps the device reduction and transfer of bin k.
+with the next fusion-buffer memcpy the same way): ``dispatch`` packs the
+fused payload and *launches* the jitted reduction, returning a pending
+token; ``_PendingOp.complete`` later blocks on the device result and
+unpacks entry outputs. The cycle body dispatches several responses before
+draining, so packing bin k+1 overlaps the device reduction of bin k.
+Where the pack happens depends on where the payload lives: the
+single-controller path packs **on device** (eager flatten/concatenate/pad
+— sharded gradients never visit the host, and outputs stay replicated
+``jax.Array`` values), while the SPMD device_put and host-ring paths stage
+through a persistent host fusion buffer (fusion_buffer.py, the
+reference's MemcpyInFusionBuffer, collective_operations.cc:37-81).
+Leases on those host slabs ride on the pending token and are released on
+every exit path — success, error status, or cycle abort.
 
 Compiled programs are cached by **size bucket** rather than exact shape:
 the fused flat payload is padded with the reduction's identity up to a
@@ -111,15 +116,18 @@ def _widen_for_ring(a, copy: bool = False):
 class _PendingOp:
     """Completion token for one dispatched response.
 
-    ``dispatch`` fills ``finish`` with the blocking tail (D2H fetch +
+    ``dispatch`` fills ``finish`` with the blocking tail (device sync +
     unpack) for async paths, or leaves it None when the work completed
     inline (host ring, eager ops, errors). ``complete`` runs the tail,
     fires entry callbacks exactly once, and closes the metrics/timeline
-    span opened at dispatch. Responses must be completed in dispatch
-    order (the cycle body's drain preserves it)."""
+    span opened at dispatch. A host fusion-buffer lease backing the
+    in-flight payload is attached as ``lease`` and released when the span
+    closes — success OR failure — so transient faults (WorkersDownError
+    mid-ring, an aborted cycle) never strand slabs. Responses must be
+    completed in dispatch order (the cycle body's drain preserves it)."""
 
     __slots__ = ("executor", "op", "entries", "timeline", "name0", "t0",
-                 "finish", "done")
+                 "finish", "done", "lease")
 
     def __init__(self, executor: "Executor", op: str, entries, timeline):
         self.executor = executor
@@ -130,16 +138,24 @@ class _PendingOp:
         self.t0 = time.perf_counter()
         self.finish: Optional[Callable[[], None]] = None
         self.done = False
+        self.lease = None
 
     def _close(self) -> None:
         self.done = True
+        if self.lease is not None:
+            self.executor.fusion_buffers.release(self.lease)
+            self.lease = None
         _OP_LATENCY.labels(op=self.op).observe(time.perf_counter() - self.t0)
         if self.timeline is not None:
             self.timeline.end(self.name0)
 
     def fail(self, status: types.Status) -> None:
         """Complete every entry with an error status and close the span
-        (reference: ErrorOp, collective_operations.cc:202-205)."""
+        (reference: ErrorOp, collective_operations.cc:202-205). Idempotent:
+        a token already drained (or failed at dispatch) is left alone, so
+        the cycle body's abort sweep can fail the whole pending deque."""
+        if self.done:
+            return
         _OP_ERRORS.labels(op=self.op).inc()
         for e in self.entries:
             e.complete(status, None)
@@ -329,10 +345,20 @@ class Executor:
                         (wide if dt.itemsize == 8 and dt.kind in "iuf"
                          else rest).append(e)
                     if wide:
+                        # the ring ran to completion right here — fire
+                        # these callbacks now rather than when the token
+                        # drains (under pipeline depth N the drain waits
+                        # behind up to N-1 later device collectives)
                         self._execute_allreduce_host(wide, timeline)
+                        ok = types.Status.OK()
+                        _OP_BYTES.labels(op=pend.op).inc(
+                            sum(types.entry_nbytes(e) for e in wide))
+                        for e in wide:
+                            e.complete(ok, e.output)
+                        pend.entries = rest
                     if rest:
                         pend.finish = self._dispatch_allreduce_spmd(
-                            rest, timeline)
+                            rest, timeline, pend)
                 elif self.net is not None:
                     self._execute_allreduce_host(entries, timeline)
                 else:
@@ -380,24 +406,33 @@ class Executor:
         sizes = [a.size // rows for a in arrays]
         total = sum(sizes)
         lease = self.fusion_buffers.acquire(rows, total, dtype)
-        buf = lease.array
-        off = 0
-        for a, n in zip(arrays, sizes):
-            np.copyto(buf[:, off:off + n], a.reshape(rows, n))
-            off += n
-        if lease.capacity > total:
-            buf[:, total:] = reduce_identity(dtype, reduce_op)
-            _PAD_BYTES.inc(
-                (lease.capacity - total) * rows * buf.dtype.itemsize)
+        try:
+            buf = lease.array
+            off = 0
+            for a, n in zip(arrays, sizes):
+                np.copyto(buf[:, off:off + n], a.reshape(rows, n))
+                off += n
+            if lease.capacity > total:
+                buf[:, total:] = reduce_identity(dtype, reduce_op)
+                _PAD_BYTES.inc(
+                    (lease.capacity - total) * rows * buf.dtype.itemsize)
+        except Exception:
+            self.fusion_buffers.release(lease)
+            raise
         return lease, total
 
     # -- single-controller XLA data plane ----------------------------------
     def _dispatch_allreduce(self, response, entries, timeline=None):
-        """Fused allreduce over the global mesh: pack worker-stacked
-        entries into the (world, bucket) fusion buffer, launch the
-        bucket-keyed compiled reduction, and return the completion tail
-        (D2H fetch + unpack). Replicated inputs need no collective and
-        complete inline."""
+        """Fused allreduce over the global mesh, entirely on device: the
+        worker-stacked entries are flattened, concatenated and
+        identity-padded to the size bucket with eager XLA ops (the
+        device-side MemcpyInFusionBuffer — sharded gradients never visit
+        the host), the bucket-keyed compiled reduction is launched, and
+        the returned completion tail blocks on the device result and
+        unpacks replicated ``jax.Array`` slices. The host
+        FusionBufferManager still owns the bucket policy but stages
+        nothing here — it serves the host-ring and SPMD device_put paths.
+        Replicated inputs need no collective and complete inline."""
         import numpy as np
 
         stacked, replicated = [], []
@@ -421,13 +456,27 @@ class Executor:
             return None
         reduce_op = stacked[0].reduce_op
         name0 = stacked[0].name
+        rows = int(stacked[0].tensor.shape[0])  # worker-stacked == world
+        dtype = np.dtype(stacked[0].tensor.dtype)
+        sizes = [int(e.tensor.size) // rows for e in stacked]
+        shapes = [tuple(e.tensor.shape[1:]) for e in stacked]
+        total = sum(sizes)
+        capacity = self.fusion_buffers.bucket_elems(total, dtype.itemsize)
         if timeline is not None:
             timeline.activity_start(name0,
                                     timeline_mod.MEMCPY_IN_FUSION_BUFFER)
-        arrays = [np.asarray(e.tensor) for e in stacked]
-        rows = arrays[0].shape[0]  # worker-stacked: leading dim == world
-        dtype = arrays[0].dtype
-        lease, total = self._pack_fused(arrays, rows, dtype, reduce_op)
+        # Device-side pack: eager reshape/concat/pad are tiny XLA ops
+        # cached by shape in jax's own executable cache, and in steady
+        # state the bounded set of bin groupings is fully warm. The
+        # expensive program (the one holding the collective) stays keyed
+        # by the size bucket below.
+        parts = [jnp.reshape(e.tensor, (rows, n))
+                 for e, n in zip(stacked, sizes)]
+        if capacity > total:
+            parts.append(jnp.full((rows, capacity - total),
+                                  reduce_identity(dtype, reduce_op), dtype))
+            _PAD_BYTES.inc((capacity - total) * rows * dtype.itemsize)
+        buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         if timeline is not None:
             timeline.activity_end(name0)
             timeline.activity_start(name0, timeline_mod.XLA_COLLECTIVE)
@@ -435,23 +484,22 @@ class Executor:
                 .config.hierarchical_allreduce
                 and self.hierarchical_available()
                 and reduce_op in (types.REDUCE_SUM, types.REDUCE_AVERAGE))
-        fn = self._fused_allreduce_program(rows, lease.capacity, dtype,
+        fn = self._fused_allreduce_program(rows, capacity, dtype,
                                            reduce_op, hier)
-        out_dev = fn(lease.array)  # async launch; fetch in finish()
-
-        shapes = [np.asarray(a.shape[1:]) for a in arrays]
-        sizes = [a.size // rows for a in arrays]
+        out_dev = fn(buf)  # async launch; completion syncs in finish()
 
         def finish():
-            red = np.asarray(out_dev)  # D2H, blocks on the reduction
-            self.fusion_buffers.release(lease)
+            # pipeline barrier without D2H: bound in-flight device work
+            # at the drain, but keep results resident as replicated
+            # jax.Arrays (callers rely on device residency/sharding)
+            jax.block_until_ready(out_dev)
             if timeline is not None:
                 timeline.activity_end(name0)
                 timeline.activity_start(
                     name0, timeline_mod.MEMCPY_OUT_FUSION_BUFFER)
             off = 0
             for e, shape, n in zip(stacked, shapes, sizes):
-                e.output = red[off:off + n].reshape(tuple(shape))
+                e.output = out_dev[off:off + n].reshape(shape)
                 off += n
             if timeline is not None:
                 timeline.activity_end(name0)
@@ -477,29 +525,34 @@ class Executor:
                                     timeline_mod.MEMCPY_IN_FUSION_BUFFER)
         total = sum(w.size for w in wire)
         lease = self.fusion_buffers.acquire(1, total, wire[0].dtype)
-        buf = lease.array.ravel()[:total]
-        off = 0
-        for w in wire:
-            np.copyto(buf[off:off + w.size], w.ravel())
-            off += w.size
-        if timeline is not None:
-            timeline.activity_end(entries[0].name)
-            timeline.activity_start(entries[0].name, "NET_RING_ALLREDUCE")
-        reduce_op = entries[0].reduce_op
-        self.net.allreduce(buf, _RING_OP[reduce_op])
-        if timeline is not None:
-            timeline.activity_end(entries[0].name)
-        if reduce_op == types.REDUCE_AVERAGE:
-            buf = buf / world  # new array; the slab is released unscaled
-        off = 0
-        for e, orig, w in zip(entries, arrays, wire):
-            n = w.size
-            # astype(copy=True is the default) detaches the output from
-            # the reusable slab even when dtypes already match
-            out = buf[off:off + n].reshape(orig.shape).astype(orig.dtype)
-            e.output = out
-            off += n
-        self.fusion_buffers.release(lease)
+        try:  # the ring raising (WorkersDownError is routine in elastic
+            # mode) must not strand the slab — release on every path
+            buf = lease.array.ravel()[:total]
+            off = 0
+            for w in wire:
+                np.copyto(buf[off:off + w.size], w.ravel())
+                off += w.size
+            if timeline is not None:
+                timeline.activity_end(entries[0].name)
+                timeline.activity_start(entries[0].name,
+                                        "NET_RING_ALLREDUCE")
+            reduce_op = entries[0].reduce_op
+            self.net.allreduce(buf, _RING_OP[reduce_op])
+            if timeline is not None:
+                timeline.activity_end(entries[0].name)
+            if reduce_op == types.REDUCE_AVERAGE:
+                buf = buf / world  # new array; slab is released unscaled
+            off = 0
+            for e, orig, w in zip(entries, arrays, wire):
+                n = w.size
+                # astype(copy=True is the default) detaches the output
+                # from the reusable slab even when dtypes already match
+                out = buf[off:off + n].reshape(orig.shape).astype(
+                    orig.dtype)
+                e.output = out
+                off += n
+        finally:
+            self.fusion_buffers.release(lease)
 
     def _fused_spmd_allreduce_program(self, n: int, dtype, reduce_op: str):
         """One compiled XLA program per (size bucket, dtype, op): the
@@ -526,7 +579,7 @@ class Executor:
             self._programs[key] = fn
         return fn
 
-    def _dispatch_allreduce_spmd(self, entries, timeline=None):
+    def _dispatch_allreduce_spmd(self, entries, timeline=None, pend=None):
         """Fused allreduce over a one-device-per-process sub-mesh in
         multi-process mode: pack entries into the flat persistent fusion
         buffer (padded to its size bucket — deterministic across ranks,
@@ -534,7 +587,9 @@ class Executor:
         (P, bucket) global array (single host→device transfer), launch
         the compiled XLA collective (rides ICI/DCN), and return the
         completion tail that fetches + unpacks the replicated result. The
-        analogue of NCCLAllreduce on the reference's GPU path
+        slab lease rides on ``pend`` so the token releases it whether the
+        response completes, fails, or the cycle aborts. The analogue of
+        NCCLAllreduce on the reference's GPU path
         (nccl_operations.cc:55-105) with XLA in place of NCCL."""
         import numpy as np
 
@@ -546,6 +601,8 @@ class Executor:
                                     timeline_mod.MEMCPY_IN_FUSION_BUFFER)
         lease, total = self._pack_fused(arrays, 1, arrays[0].dtype,
                                         reduce_op)
+        if pend is not None:
+            pend.lease = lease
         flat = lease.array  # (1, bucket) — already the row layout
         mesh = self._proc_mesh
         n_proc = mesh.devices.size
@@ -564,7 +621,6 @@ class Executor:
 
         def finish():
             out = np.asarray(out_dev)  # D2H, blocks on the collective
-            self.fusion_buffers.release(lease)
             if timeline is not None:
                 timeline.activity_end(name0)
                 timeline.activity_start(

@@ -554,6 +554,17 @@ class Runtime:
             while pending:
                 drain_one()
         except Exception:
+            # In-flight tokens first: tok.fail() closes the timeline span
+            # opened at dispatch, observes op latency, and releases any
+            # fusion-buffer lease riding on the token — abandoning them
+            # would leave perpetually-open timeline ops and stranded
+            # slabs after an elastic restart. fail() is idempotent, so
+            # tokens that already completed or failed are left alone.
+            status = types.Status.UnknownError(
+                "background cycle failed; see runtime log")
+            while pending:
+                tok, _ = pending.popleft()
+                tok.fail(status)
             # these entries left the table already — complete any whose
             # handle hasn't fired so callers error instead of hanging
             # (dispatch/complete handle their own failures; this covers

@@ -196,7 +196,7 @@ class TestSteadyStateProgramCache:
         self._one_cycle(hvd, rt, threshold_bytes=30000, step=0)
         compiles_after_warmup = ex_mod._PROGRAM_COMPILES.value
         hits0 = ex_mod._PROGRAM_CACHE_HITS.value
-        reuses0 = fb._BUF_REUSES.value
+        allocs0 = fb._BUF_ALLOCS.value
         # steady state: regrouped bins {t0,t1},{t2,t3} (never seen before)
         # plus the warmup grouping again — all hit the warmed buckets
         for step in range(1, 4):
@@ -205,8 +205,10 @@ class TestSteadyStateProgramCache:
         assert ex_mod._PROGRAM_COMPILES.value == compiles_after_warmup, \
             "steady-state cycles must not trigger new XLA compiles"
         assert ex_mod._PROGRAM_CACHE_HITS.value > hits0
-        assert fb._BUF_REUSES.value > reuses0, \
-            "persistent fusion buffers must be reused across cycles"
+        # the single-controller path packs on device: sharded gradients
+        # never stage through (or allocate) host fusion-buffer slabs
+        assert fb._BUF_ALLOCS.value == allocs0, \
+            "device-path cycles must not allocate host staging slabs"
 
     @pytest.mark.parametrize("depth", [1, 3])
     def test_pipeline_depth_preserves_results(self, hvd, monkeypatch, depth):
@@ -220,6 +222,93 @@ class TestSteadyStateProgramCache:
         # multi-bin cycle (threshold fits 2 of the 9600B requests)
         self._one_cycle(hvd, rt, threshold_bytes=20000, step=10 + depth)
         assert rt_mod._PIPELINE_DEPTH.value == 0  # drained
+
+
+class TestDeviceResidency:
+    """The single-controller fused allreduce must stay on device end to
+    end: inputs are sharded jax.Arrays and outputs come back as replicated
+    jax.Arrays — never host numpy round-trips on the hot path."""
+
+    def test_outputs_are_replicated_jax_arrays(self, hvd):
+        import jax
+
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        ex = get_runtime().executor
+        entries = [types.TensorTableEntry(
+            name=f"resid/t{j}",
+            tensor=hvd.stack_per_worker(
+                [np.full((7,), float(i + j), "float32")
+                 for i in range(hvd.size())]),
+            reduce_op=types.REDUCE_SUM) for j in range(3)]
+        saved = ex.fusion_buffers
+        ex.fusion_buffers = FusionBufferManager(16)  # force real padding
+        try:
+            allocs0 = fb._BUF_ALLOCS.value
+            ex.execute(msg.Response(types.ALLREDUCE,
+                                    [e.name for e in entries]), entries)
+        finally:
+            ex.fusion_buffers = saved
+        assert fb._BUF_ALLOCS.value == allocs0  # no host staging slabs
+        for j, e in enumerate(entries):
+            assert isinstance(e.output, jax.Array), \
+                "single-controller allreduce must return device arrays"
+            assert e.output.sharding.is_fully_replicated
+            np.testing.assert_allclose(
+                np.asarray(e.output),
+                np.full((7,), sum(i + j for i in range(hvd.size())),
+                        "float32"))
+
+
+class _FailingNet:
+    """Ring stub whose allreduce always loses the transport."""
+
+    world = 2
+    rank = 0
+
+    def allreduce(self, buf, op):
+        raise RuntimeError("ring transport lost")
+
+
+class TestLeaseLifecycle:
+    """Fusion-buffer leases must come back on every failure path —
+    transient faults (routine under elastic) must not grow host memory."""
+
+    def _slabs_free(self, mgr):
+        return sum(len(v) for v in mgr._free.values())
+
+    def test_host_ring_failure_releases_lease(self, hvd):
+        from horovod_tpu.core import state
+        from horovod_tpu.runtime.executor import Executor
+
+        ex = Executor(state.global_state().mesh, net=_FailingNet())
+        ex.fusion_buffers = FusionBufferManager(256)
+        entries = [types.TensorTableEntry(
+            name="leak/ring", tensor=np.ones((10,), "float32"),
+            reduce_op=types.REDUCE_SUM)]
+        with pytest.raises(RuntimeError):
+            ex._execute_allreduce_host(entries)
+        assert self._slabs_free(ex.fusion_buffers) == 1, \
+            "slab must return to the free list when the ring raises"
+
+    def test_token_fail_releases_lease(self, hvd):
+        from horovod_tpu.core import state
+        from horovod_tpu.runtime import executor as ex_mod
+
+        ex = ex_mod.Executor(state.global_state().mesh)
+        ex.fusion_buffers = FusionBufferManager(256)
+        lease = ex.fusion_buffers.acquire(1, 100, np.float32)
+        entry = types.TensorTableEntry(name="leak/tok",
+                                       tensor=np.ones((4,), "float32"))
+        tok = ex_mod._PendingOp(ex, types.ALLREDUCE, [entry], None)
+        tok.lease = lease
+        tok.fail(types.Status.UnknownError("cycle aborted"))
+        assert tok.lease is None
+        assert self._slabs_free(ex.fusion_buffers) == 1, \
+            "failing a pending token must release its slab lease"
+        # idempotent: a second fail must not double-release
+        tok.fail(types.Status.UnknownError("again"))
+        assert self._slabs_free(ex.fusion_buffers) == 1
 
 
 class TestKnobParsing:
