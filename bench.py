@@ -576,6 +576,59 @@ def collectives_main():
         log(f"collectives {elems * 4}B/tensor: p50 {rows[-1]['p50_ms']} ms"
             f"  {rows[-1]['payload_gb_s']} GB/s"
             f"  compiles(timed)={new_compiles}")
+
+    # Flight-recorder overhead (the recorder is on by default, so its cost
+    # must be visible next to the latency it taxes): raw emit() throughput,
+    # plus the added p50 step latency at pipeline depth 2 — the same fused
+    # allreduce path timed with the recorder off, then on.
+    from horovod_tpu import flight_recorder
+
+    rec = flight_recorder.recorder()
+    n_emit = 100_000
+    t0 = time.perf_counter()
+    for i in range(n_emit):
+        rec.emit("bench_overhead", op=i)
+    emit_per_sec = n_emit / (time.perf_counter() - t0)
+
+    fr_elems = 4096
+    fr_payload = rng.randn(world, fr_elems).astype(np.float32)
+
+    def depth2_step(step):
+        hs = [hvd.allreduce_async(
+            hvd.stack_per_worker(list(fr_payload + np.float32(step))),
+            name=f"bench/fr/t{j}") for j in range(2)]
+        for h in hs:
+            hvd.synchronize(h)
+
+    for s in range(4):  # warm the fr-name buckets/programs
+        depth2_step(1000 + s)
+    # interleave recorder-off/on steps (A/B pairs) so dispatch-latency
+    # drift does not masquerade as recorder overhead
+    was_enabled = rec.enabled
+    lat_off, lat_on = [], []
+    for s in range(15):
+        for enabled, lat in ((False, lat_off), (True, lat_on)):
+            rec.enabled = enabled
+            t0 = time.perf_counter()
+            depth2_step(2000 + 2 * s + int(enabled))
+            lat.append(time.perf_counter() - t0)
+    rec.enabled = was_enabled
+    p50_off = float(np.median(lat_off))
+    p50_on = float(np.median(lat_on))
+    fr_overhead = {
+        "emit_events_per_sec": round(emit_per_sec),
+        "p50_ms_depth2_recorder_off": round(p50_off * 1e3, 3),
+        "p50_ms_depth2_recorder_on": round(p50_on * 1e3, 3),
+        "added_p50_ms_depth2": round((p50_on - p50_off) * 1e3, 3),
+        "overhead_pct": (round(100.0 * (p50_on - p50_off) / p50_off, 2)
+                         if p50_off > 0 else None),
+    }
+    log("flight recorder: %d events/sec emit; depth-2 p50 %s -> %s ms "
+        "(%s%% overhead)" % (
+            fr_overhead["emit_events_per_sec"],
+            fr_overhead["p50_ms_depth2_recorder_off"],
+            fr_overhead["p50_ms_depth2_recorder_on"],
+            fr_overhead["overhead_pct"]))
     result = {
         "metric": f"fused allreduce p50 latency, {tensors_per_step}-tensor "
                   f"cycle at {rows[-1]['tensor_bytes']}B/tensor "
@@ -587,6 +640,7 @@ def collectives_main():
         "steady_state_compiles": steady_compiles,
         "program_compiles_total": executor_mod._PROGRAM_COMPILES.value,
         "program_cache_hits_total": executor_mod._PROGRAM_CACHE_HITS.value,
+        "flight_recorder": fr_overhead,
     }
     print(json.dumps(result), flush=True)
     return result

@@ -31,6 +31,7 @@ from typing import Optional, Sequence
 
 import jax
 
+from horovod_tpu import flight_recorder
 from horovod_tpu.core import mesh as mesh_mod
 from horovod_tpu.core import state as state_mod
 from horovod_tpu.utils import logging as log
@@ -131,6 +132,12 @@ def init(
             st.size, st.local_size, st.cross_size, st.rank,
         )
 
+        # flight recorder: adopt the (possibly re-formed) rank, hook fatal
+        # signals so a SIGTERM/SIGSEGV leaves a postmortem dump
+        flight_recorder.configure(rank=st.rank)
+        flight_recorder.install_signal_handlers()
+        flight_recorder.emit("init", rank=st.rank, size=st.size)
+
         if st.config.timeline_file:
             from horovod_tpu.timeline import Timeline
 
@@ -184,6 +191,14 @@ def shutdown() -> None:
         from horovod_tpu.ops import collectives
 
         collectives.clear_compiled_cache()
+        flight_recorder.emit("shutdown", rank=st.rank)
+        # leave a final dump behind (and ship it to the launcher) so the
+        # postmortem covers clean exits too — only when a destination is
+        # configured; a bare single-process run writes nothing
+        if flight_recorder.recorder().enabled and (
+                flight_recorder.recorder().dir
+                or flight_recorder._rendezvous_addr() is not None):
+            flight_recorder.recorder().dump("shutdown")
     state_mod.reset()
 
 

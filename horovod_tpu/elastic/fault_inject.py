@@ -6,14 +6,16 @@ the recovery path without real hardware failures. Spec grammar::
 
     <action>:rank=<r>:step=<s>[:code=<c>][:seconds=<t>][:gen=<g>]
 
-* ``action`` — ``kill`` (``os._exit``) or ``hang`` (sleep, so the stall
-  inspector / transport timeout must detect it).
+* ``action`` — ``kill`` (``os._exit``), ``hang`` (one long sleep, so the
+  stall inspector / transport timeout must detect it), or ``slow``
+  (sleep ``seconds`` at EVERY step >= ``step`` — a persistent straggler
+  for attribution tests, not a one-shot fault).
 * ``rank`` — the rank to fault, matched against the worker's ORIGINAL
   launch rank (survivors are renumbered on re-form; the fault must not
   re-fire on whoever inherited the number).
 * ``step`` — fire when the state's step counter reaches this value.
 * ``code`` — exit code for ``kill`` (default 1).
-* ``seconds`` — hang duration (default 3600).
+* ``seconds`` — hang duration (default 3600) or per-step slowdown.
 * ``gen`` — generation (restart count) in which the fault is armed
   (default 0: only before the first recovery).
 
@@ -29,6 +31,7 @@ import sys
 import time
 from typing import Optional
 
+from horovod_tpu import flight_recorder
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.utils import logging as log
 
@@ -38,7 +41,10 @@ _FAULTS_INJECTED = _metrics().counter(
     "horovod_elastic_faults_injected_total",
     "Deterministic faults fired by the HOROVOD_FAULT_INJECT harness.")
 
-_ACTIONS = ("kill", "hang")
+_ACTIONS = ("kill", "hang", "slow")
+
+# "slow" logs on its first firing only (it re-fires every step)
+_slow_announced = False
 
 # the worker's launch-time rank: captured before any elastic re-form
 # renumbers HOROVOD_RANK in os.environ
@@ -100,22 +106,46 @@ def initial_rank() -> int:
 
 def maybe_inject(step: int, rank: Optional[int] = None,
                  generation: int = 0) -> None:
-    """Fire the armed fault if (rank, step, generation) all match."""
+    """Fire the armed fault if (rank, step, generation) all match.
+
+    ``kill`` and ``hang`` fire exactly at ``spec.step``; ``slow`` fires at
+    every step >= ``spec.step`` (a persistent straggler)."""
+    global _slow_announced
     spec = spec_from_env()
     if spec is None:
         return
     if rank is None:
         rank = initial_rank()
-    if (rank != spec.rank or step != spec.step
-            or generation != spec.generation):
+    if rank != spec.rank or generation != spec.generation:
+        return
+    if spec.action == "slow":
+        if step < spec.step:
+            return
+        _FAULTS_INJECTED.inc()
+        if not _slow_announced:
+            _slow_announced = True
+            log.error("fault injection: slowing rank %d by %.3fs per step "
+                      "from step %d on", rank, spec.seconds, spec.step)
+        flight_recorder.emit("fault_inject", action="slow", rank=rank,
+                             step=step, seconds=spec.seconds)
+        time.sleep(spec.seconds)
+        return
+    if step != spec.step:
         return
     _FAULTS_INJECTED.inc()
     if spec.action == "kill":
         log.error("fault injection: killing rank %d at step %d "
                   "(exit code %d)", rank, step, spec.code)
+        # os._exit bypasses atexit and signal handlers, so the flight
+        # recorder must dump here or the postmortem loses the culprit
+        flight_recorder.emit("fault_inject", action="kill", rank=rank,
+                             step=step, code=spec.code)
+        flight_recorder.dump_on_failure("fault_inject_kill")
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(spec.code)
     log.error("fault injection: hanging rank %d at step %d for %.0fs",
               rank, step, spec.seconds)
+    flight_recorder.emit("fault_inject", action="hang", rank=rank,
+                         step=step, seconds=spec.seconds)
     time.sleep(spec.seconds)

@@ -32,7 +32,7 @@ import socket as socket_mod
 import time
 from typing import Iterator, List, Optional
 
-from horovod_tpu import exceptions
+from horovod_tpu import exceptions, flight_recorder
 from horovod_tpu.core import basics
 from horovod_tpu.elastic import fault_inject
 from horovod_tpu.metrics import registry as _metrics
@@ -302,6 +302,9 @@ def _reform(min_workers: int, backoff: Backoff) -> None:
         _WORKERS_REMOVED.inc(old_size - new_size)
     log.warning("elastic: re-formed generation %d — old rank %d -> "
                 "new rank %d of %d", gen, old_rank, new_rank, new_size)
+    flight_recorder.emit("elastic_reform", generation=gen,
+                         old_rank=old_rank, new_rank=new_rank,
+                         size=new_size)
     basics.reinit()
 
 
@@ -348,10 +351,19 @@ def run(func):
             except exceptions.HostsUpdatedInterrupt as exc:
                 log.warning("elastic: %s — re-forming to fold in the new "
                             "host set", exc)
+                flight_recorder.emit("hosts_updated", notice=str(exc)[:200])
                 rollback = None  # re-form without rollback
             except exceptions.WorkersDownError as exc:
                 log.warning("elastic: workers down (%s) — attempting "
                             "recovery", exc)
+                flight_recorder.emit(
+                    "workers_down",
+                    ranks=sorted(getattr(exc, "ranks", None) or []),
+                    error=str(exc)[:200])
+                flight_recorder.dump_on_failure(
+                    "worker_stall"
+                    if isinstance(exc, exceptions.WorkerStallError)
+                    else "worker_lost")
                 rollback = True
 
     return wrapper

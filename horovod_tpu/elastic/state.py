@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from horovod_tpu import flight_recorder
 from horovod_tpu.core import basics
 from horovod_tpu.elastic import fault_inject
 from horovod_tpu.metrics import COMMIT_BUCKETS, registry as _metrics
@@ -122,6 +123,8 @@ class State:
         self.save()
         _COMMITS.inc()
         _COMMIT_DURATION.observe(time.monotonic() - t0)
+        flight_recorder.emit("state_commit", step=step,
+                             seconds=round(time.monotonic() - t0, 6))
         if self._spill_dir:
             payload = self._spill_payload()
             if payload is not None:
@@ -133,6 +136,8 @@ class State:
 
     def restore(self) -> None:
         self.restore_snapshot()
+        flight_recorder.emit("state_restore",
+                             step=int(getattr(self, "step", 0)))
 
     def register_reset_callbacks(self, callbacks) -> None:
         """Callables invoked after a re-form (reference:

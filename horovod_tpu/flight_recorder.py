@@ -1,0 +1,460 @@
+"""Flight recorder: postmortem-grade crash-time evidence for the runtime.
+
+Live metrics (metrics.py) and the Chrome timeline (timeline.py) answer
+"how is the job doing *now*"; nothing answered "what was the runtime
+doing in the seconds before it died". The flight recorder is an
+always-on, bounded, lock-cheap ring buffer of structured events emitted
+from every plane — controller negotiation (begin/end, per-rank request
+arrival, STALE_HIT invalidations), executor dispatch/complete/fail with
+bucket + bytes, pipeline-depth changes, elastic membership generations,
+commit/restore, worker loss — and a dumper that writes the last N events
+plus a full metrics snapshot and the in-flight pending-op state as JSON
+whenever the process is about to become unreadable: fatal signals, stall
+shutdown, ``WorkerLostError``/``WorkerStallError``, a background-cycle
+abort, injected faults, and on demand (``hvd.dump_debug_state()`` or
+``GET /debug`` on the metrics server).
+
+The hot path mirrors the metrics registry's philosophy: ``emit`` is one
+``deque.append`` on a ``maxlen``-bounded deque — atomic under the GIL,
+no lock, old events overwritten in O(1) — so instrumentation never
+contends with the cycle it records. Dump-side work (snapshotting,
+file IO, shipping to the rendezvous server) happens only on failure or
+explicit request.
+
+Dumps are additionally *shipped* to the launcher's rendezvous KV server
+(scope ``flight``) when one is configured, so ``tpurun`` can print a
+merged cross-rank postmortem even for workers whose filesystem died with
+them. Event timestamps are ``time.time()`` epoch seconds; at dump time
+each rank estimates its clock offset against the rendezvous server's
+``/_time`` endpoint so the merged postmortem can interleave events from
+different hosts on one axis.
+
+Knobs: ``HOROVOD_FLIGHT_RECORDER`` (default on; ``0`` disables; an
+integer > 1 sets the ring capacity, default 2048),
+``HOROVOD_FLIGHT_RECORDER_DIR`` (directory for ``flight-rank-N.json``
+dumps; unset = no files, shipping + ``/debug`` still work).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import (DEFAULT_FLIGHT_RECORDER_CAPACITY,
+                                   HOROVOD_FLIGHT_RECORDER,
+                                   HOROVOD_FLIGHT_RECORDER_DIR,
+                                   parse_flight_recorder)
+
+SCHEMA = "horovod-flight-recorder-v1"
+# rendezvous KV scope where workers ship their dumps for the launcher
+RENDEZVOUS_SCOPE = "flight"
+DUMP_PREFIX = "flight-rank-"
+
+_EVENTS_TOTAL = _metrics().counter(
+    "horovod_flight_recorder_events_total",
+    "Structured events recorded into the flight-recorder ring buffer.")
+_DUMPS_TOTAL = _metrics().counter(
+    "horovod_flight_recorder_dumps_total",
+    "Flight-recorder snapshots produced (file dumps, shipped dumps, "
+    "/debug requests and hvd.dump_debug_state calls).")
+
+
+def _rendezvous_addr() -> Optional[Tuple[str, int]]:
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_HTTP_ADDR")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_HTTP_PORT")
+    if not addr or not port:
+        return None
+    try:
+        return addr, int(port)
+    except ValueError:
+        return None
+
+
+def _estimate_clock_offset() -> Optional[float]:
+    """Offset such that ``local_time + offset == launcher_time``, from the
+    rendezvous server's ``/_time`` endpoint (NTP-style: the sample with
+    the smallest round trip wins, server time compared to the midpoint).
+    None when no rendezvous server is configured or reachable."""
+    dest = _rendezvous_addr()
+    if dest is None:
+        return None
+    from urllib.request import urlopen
+
+    best_rtt, best_offset = None, None
+    for _ in range(3):
+        try:
+            t0 = time.time()
+            with urlopen("http://%s:%d/_time" % dest, timeout=2) as resp:
+                server = float(resp.read())
+            t1 = time.time()
+        except (OSError, ValueError):
+            return best_offset
+        rtt = t1 - t0
+        if best_rtt is None or rtt < best_rtt:
+            best_rtt, best_offset = rtt, server - (t0 + t1) / 2.0
+    return best_offset
+
+
+class FlightRecorder:
+    """Bounded ring of structured events + the dump machinery.
+
+    ``emit`` must stay cheap enough for the cycle hot path: build one
+    small dict, append to a maxlen deque. Everything else — state
+    providers, metrics snapshot, clock-offset estimation, file writes,
+    shipping — runs only at dump time.
+    """
+
+    def __init__(self) -> None:
+        enabled, capacity = parse_flight_recorder(
+            os.environ.get(HOROVOD_FLIGHT_RECORDER))
+        self.enabled = enabled
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        # the rank this process was LAUNCHED as — stable across elastic
+        # re-forms (renumbering), so per-process dump files never collide
+        self.launch_rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+        self.rank = self.launch_rank
+        self.dir = os.environ.get(HOROVOD_FLIGHT_RECORDER_DIR, "")
+        self._providers: Dict[str, Callable[[], Any]] = {}
+        self._dump_history: List[dict] = []
+        self._clock_offset: Optional[float] = None
+        self._offset_checked = False
+        self._dump_lock = threading.Lock()
+        self._last_failure_dump = 0.0
+
+    # -- hot path -----------------------------------------------------------
+    def emit(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        ev = {"t": time.time(), "kind": kind}
+        ev.update(fields)
+        self._events.append(ev)  # GIL-atomic; maxlen evicts the oldest
+        _EVENTS_TOTAL.inc()
+
+    def events(self) -> List[dict]:
+        return list(self._events)
+
+    # -- configuration ------------------------------------------------------
+    def configure(self, rank: Optional[int] = None) -> None:
+        """Re-read the env knobs (called from ``hvd.init()`` — including
+        elastic re-init, where the rank may have changed)."""
+        enabled, capacity = parse_flight_recorder(
+            os.environ.get(HOROVOD_FLIGHT_RECORDER))
+        self.enabled = enabled
+        if capacity != self.capacity:
+            self._events = deque(self._events, maxlen=capacity)
+            self.capacity = capacity
+        self.dir = os.environ.get(HOROVOD_FLIGHT_RECORDER_DIR, "")
+        if rank is not None:
+            self.rank = rank
+
+    def set_state_provider(self, name: str,
+                           fn: Optional[Callable[[], Any]]) -> None:
+        """Register a callable whose return value is embedded under
+        ``state[name]`` in every dump. Re-registering replaces (so a
+        re-initialized runtime simply supersedes the dead one); ``None``
+        unregisters."""
+        if fn is None:
+            self._providers.pop(name, None)
+        else:
+            self._providers[name] = fn
+
+    # -- dump side ----------------------------------------------------------
+    def clock_offset(self) -> Optional[float]:
+        if not self._offset_checked:
+            self._offset_checked = True
+            try:
+                self._clock_offset = _estimate_clock_offset()
+            except Exception:
+                self._clock_offset = None
+        return self._clock_offset
+
+    def snapshot(self, reason: str) -> dict:
+        """The full postmortem document: ring contents, provider state,
+        metrics, identity, and enough clock metadata to merge dumps
+        across hosts."""
+        state = {}
+        for name, fn in list(self._providers.items()):
+            try:
+                state[name] = fn()
+            except Exception as exc:  # a dying runtime must not block dumps
+                state[name] = "<state provider failed: %s>" % (exc,)
+        _DUMPS_TOTAL.inc()
+        return {
+            "schema": SCHEMA,
+            "rank": self.rank,
+            "launch_rank": self.launch_rank,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "reason": reason,
+            "wall_time": time.time(),
+            "clock_offset_seconds": self.clock_offset(),
+            "dump_history": list(self._dump_history),
+            "events": self.events(),
+            "state": state,
+            "metrics": _metrics().snapshot(),
+        }
+
+    def _dump_path(self, target: str) -> str:
+        if "{rank}" in target:
+            return target.replace("{rank}", str(self.launch_rank))
+        if target.endswith(".json"):
+            return target
+        return os.path.join(target,
+                            "%s%d.json" % (DUMP_PREFIX, self.launch_rank))
+
+    def dump(self, reason: str, path: Optional[str] = None,
+             ship: bool = True) -> dict:
+        """Snapshot and persist: write ``flight-rank-N.json`` (last dump
+        wins; earlier reasons survive in ``dump_history``) and ship the
+        JSON to the launcher's rendezvous store when one is configured.
+        Never raises — this runs on paths that are already failing."""
+        with self._dump_lock:
+            snap = self.snapshot(reason)
+            self._dump_history.append(
+                {"reason": reason, "t": snap["wall_time"]})
+            payload = None
+            target = path or self.dir
+            if target:
+                try:
+                    out = self._dump_path(target)
+                    parent = os.path.dirname(out)
+                    if parent:
+                        os.makedirs(parent, exist_ok=True)
+                    payload = json.dumps(snap)
+                    with open(out, "w") as f:
+                        f.write(payload)
+                    log.debug("flight recorder: wrote %s (%s)", out, reason)
+                except (OSError, TypeError, ValueError) as exc:
+                    log.warning("flight recorder: dump to %r failed: %s",
+                                target, exc)
+            if ship:
+                try:
+                    self._ship(payload if payload is not None
+                               else json.dumps(snap))
+                except Exception as exc:
+                    log.debug("flight recorder: ship failed: %s", exc)
+            return snap
+
+    def _ship(self, payload: str) -> None:
+        dest = _rendezvous_addr()
+        if dest is None:
+            return
+        from horovod_tpu.run.rendezvous import KVStoreClient
+
+        client = KVStoreClient(dest[0], dest[1], scope=RENDEZVOUS_SCOPE,
+                               timeout=5.0)
+        client.set("rank.%d" % self.launch_rank, payload)
+
+
+_recorder = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def emit(kind: str, **fields) -> None:
+    """Record one structured event (module-level hot-path entry point)."""
+    _recorder.emit(kind, **fields)
+
+
+def set_state_provider(name: str, fn: Optional[Callable[[], Any]]) -> None:
+    _recorder.set_state_provider(name, fn)
+
+
+def configure(rank: Optional[int] = None) -> None:
+    _recorder.configure(rank=rank)
+
+
+def debug_state() -> dict:
+    """Snapshot for the metrics server's ``/debug`` endpoint."""
+    return _recorder.snapshot("debug_endpoint")
+
+
+def dump_debug_state(path: Optional[str] = None,
+                     reason: str = "on_demand") -> dict:
+    """Public API (``hvd.dump_debug_state()``): return the full debug
+    snapshot, and persist it when ``path`` or
+    ``HOROVOD_FLIGHT_RECORDER_DIR`` names a destination."""
+    if path or _recorder.dir:
+        return _recorder.dump(reason, path=path)
+    return _recorder.snapshot(reason)
+
+
+def dump_on_failure(reason: str) -> None:
+    """Best-effort dump from failure paths (cycle abort, stall shutdown,
+    worker loss, fatal signal). Rate-limited so a failure loop can't turn
+    into an IO storm; never raises."""
+    try:
+        if not _recorder.enabled:
+            return
+        now = time.monotonic()
+        if _recorder._last_failure_dump and \
+                now - _recorder._last_failure_dump < 1.0:
+            return
+        _recorder._last_failure_dump = now
+        _recorder.dump(reason)
+    except Exception as exc:
+        try:
+            log.warning("flight recorder: failure dump (%s) failed: %s",
+                        reason, exc)
+        except Exception:
+            pass
+
+
+# -- fatal-signal hook ------------------------------------------------------
+_signals_installed = False
+_prev_handlers: Dict[int, Any] = {}
+
+
+def install_signal_handlers() -> None:
+    """Dump on SIGTERM (then chain to the previous disposition) and on
+    SIGUSR1 (dump and keep running — `kill -USR1` a live job to inspect
+    it). No-op off the main thread or when the recorder is disabled."""
+    global _signals_installed
+    if _signals_installed or not _recorder.enabled:
+        return
+    import signal
+
+    def _fatal(signum, frame):
+        dump_on_failure("signal:%s" % signal.Signals(signum).name)
+        prev = _prev_handlers.get(signum)
+        if prev is signal.SIG_IGN:
+            return
+        if callable(prev):
+            prev(signum, frame)
+            return
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    def _inspect(signum, frame):
+        dump_on_failure("signal:SIGUSR1")
+
+    try:
+        _prev_handlers[signal.SIGTERM] = signal.signal(signal.SIGTERM,
+                                                       _fatal)
+        if hasattr(signal, "SIGUSR1"):
+            signal.signal(signal.SIGUSR1, _inspect)
+        _signals_installed = True
+    except ValueError:
+        pass  # not the main thread (embedded init): skip, dumps still
+        # fire from the runtime/elastic failure paths
+
+
+# -- cross-rank postmortem (launcher side) ----------------------------------
+def load_dumps(directory: str) -> List[dict]:
+    """Read every ``flight-rank-*.json`` in ``directory`` (unreadable or
+    truncated files are skipped with a warning, not fatal — a crash may
+    have cut one short)."""
+    dumps = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return dumps
+    for name in names:
+        if not (name.startswith(DUMP_PREFIX) and name.endswith(".json")):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                dumps.append(json.load(f))
+        except (OSError, ValueError) as exc:
+            log.warning("flight recorder: skipping unreadable dump %s: %s",
+                        path, exc)
+    return dumps
+
+
+def merge_events(dumps: List[dict]) -> List[dict]:
+    """Interleave events across ranks on one time axis: each rank's
+    events are shifted by its estimated clock offset (when it had one)
+    so cross-host ordering is meaningful to ~RTT precision."""
+    merged = []
+    for d in dumps:
+        offset = d.get("clock_offset_seconds") or 0.0
+        rank = d.get("launch_rank", d.get("rank", "?"))
+        for ev in d.get("events", ()):
+            e = dict(ev)
+            e["rank"] = rank
+            e["t_merged"] = float(ev.get("t", 0.0)) + offset
+            merged.append(e)
+    merged.sort(key=lambda e: e["t_merged"])
+    return merged
+
+
+def suspect_culprit(dumps: List[dict]) -> Optional[Tuple[Any, str]]:
+    """Best-effort culprit attribution: explicit evidence first (a rank
+    that recorded its own injected kill; ranks named by workers_down /
+    stall_shutdown events), then the straggler lag EWMA from any
+    coordinator dump."""
+    for d in dumps:
+        for ev in d.get("events", ()):
+            if ev.get("kind") == "fault_inject" and ev.get("action") == \
+                    "kill":
+                return ev.get("rank"), "recorded its own injected kill"
+    named: Dict[Any, int] = {}
+    for d in dumps:
+        for ev in d.get("events", ()):
+            if ev.get("kind") in ("workers_down", "stall_shutdown"):
+                for r in ev.get("ranks", ()) or ():
+                    named[r] = named.get(r, 0) + 1
+    if named:
+        rank = max(named, key=lambda r: named[r])
+        return rank, ("named missing/lost by %d workers_down/stall event(s)"
+                      % named[rank])
+    best = None
+    for d in dumps:
+        lag = d.get("metrics", {}).get("horovod_straggler_lag_seconds")
+        for row in (lag or {}).get("values", ()):
+            value = row.get("value", 0.0)
+            if best is None or value > best[1]:
+                best = (row.get("labels", {}).get("rank"), value)
+    # same-cycle arrival jitter is microseconds; a real straggler lags by
+    # whole cycles — below that, naming a rank would be noise-as-blame
+    if best is not None and best[1] >= 0.05:
+        return best[0], ("highest straggler lag EWMA (%.3fs)" % best[1])
+    return None
+
+
+def format_postmortem(dumps: List[dict], last_n: int = 40) -> str:
+    """Human-readable merged postmortem: per-rank dump inventory, the
+    last ``last_n`` interleaved events, and the suspected culprit."""
+    lines = ["=== flight-recorder postmortem (%d dump%s) ==="
+             % (len(dumps), "" if len(dumps) == 1 else "s")]
+    for d in sorted(dumps, key=lambda d: d.get("launch_rank", 0)):
+        offset = d.get("clock_offset_seconds")
+        lines.append(
+            "rank %s: reason=%s host=%s pid=%s events=%d%s" % (
+                d.get("launch_rank", d.get("rank", "?")),
+                d.get("reason", "?"), d.get("host", "?"), d.get("pid", "?"),
+                len(d.get("events", ())),
+                (" clock_offset=%+.4fs" % offset) if offset is not None
+                else ""))
+    merged = merge_events(dumps)
+    tail = merged[-last_n:]
+    if len(merged) > len(tail):
+        lines.append("... %d earlier events omitted ..."
+                     % (len(merged) - len(tail)))
+    for ev in tail:
+        t = ev["t_merged"]
+        stamp = time.strftime("%H:%M:%S", time.localtime(t)) + \
+            (".%03d" % int((t % 1) * 1000))
+        extras = " ".join(
+            "%s=%s" % (k, v) for k, v in ev.items()
+            if k not in ("t", "t_merged", "kind", "rank"))
+        lines.append("%s [rank %s] %s%s"
+                     % (stamp, ev["rank"], ev["kind"],
+                        (" " + extras) if extras else ""))
+    culprit = suspect_culprit(dumps)
+    if culprit is not None:
+        lines.append("suspected culprit: rank %s (%s)" % culprit)
+    else:
+        lines.append("suspected culprit: none identified")
+    return "\n".join(lines)

@@ -268,12 +268,26 @@ class MetricsRegistry:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API)
-                if self.path.split("?")[0].rstrip("/") in ("", "/metrics"):
+                route = self.path.split("?")[0].rstrip("/")
+                if route in ("", "/metrics"):
                     body = reg.prometheus_text().encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
                         "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif route == "/debug":
+                    # flight-recorder debug state: ring-buffer events,
+                    # in-flight ops and the metrics snapshot, as JSON
+                    from horovod_tpu import flight_recorder
+
+                    body = json.dumps(
+                        flight_recorder.debug_state(),
+                        default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

@@ -125,6 +125,9 @@ def env_from_args(args) -> dict:
     put_bool("HOROVOD_LOG_HIDE_TIME",
              getattr(args, "log_hide_timestamp", None))
 
+    put("HOROVOD_FLIGHT_RECORDER_DIR",
+        getattr(args, "flight_recorder_dir", None))
+
     put("HOROVOD_MESH_SHAPE", getattr(args, "mesh_shape", None))
     return env
 

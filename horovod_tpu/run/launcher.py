@@ -23,6 +23,7 @@ import sys
 import threading
 from typing import Dict, List, Optional
 
+from horovod_tpu import flight_recorder
 from horovod_tpu.run import util
 from horovod_tpu.run.hosts import SlotInfo
 from horovod_tpu.run.rendezvous import RendezvousServer
@@ -98,7 +99,8 @@ def launch_job(command: str, slots: List[SlotInfo],
                elastic: bool = False,
                min_workers: int = 1,
                max_workers: Optional[int] = None,
-               discovery_script: Optional[str] = None) -> int:
+               discovery_script: Optional[str] = None,
+               flight_recorder_dir: Optional[str] = None) -> int:
     """Run ``command`` on every slot; returns the job exit code (first
     non-zero worker code, else 0). Starts the rendezvous KV server for the
     job's lifetime. ``backend`` is a :class:`run.backends.LaunchBackend`
@@ -111,10 +113,18 @@ def launch_job(command: str, slots: List[SlotInfo],
     ``min_workers`` workers remain. With a ``discovery_script`` an
     :class:`~horovod_tpu.elastic.driver.ElasticDriver` polls it and
     publishes host-change notices + heartbeat evictions through the
-    rendezvous store."""
+    rendezvous store.
+
+    ``flight_recorder_dir`` closes the observability loop: workers write
+    (and ship, via the rendezvous store) per-rank flight-recorder dumps;
+    the launcher collects the shipped copies for workers whose local
+    filesystem died with them and, when the job fails, prints a merged
+    cross-rank postmortem naming the suspected culprit rank."""
     from horovod_tpu.run.backends import make_backend
 
     base_env = dict(os.environ if env is None else env)
+    if flight_recorder_dir:
+        base_env["HOROVOD_FLIGHT_RECORDER_DIR"] = flight_recorder_dir
     if backend is None:
         # resolve from the CALLER's env mapping (like the NIC-discovery
         # knob below), so programmatic callers control the backend the
@@ -231,6 +241,7 @@ def launch_job(command: str, slots: List[SlotInfo],
         except ValueError:  # not main thread (tests)
             pass
 
+    shipped: Dict[str, bytes] = {}
     try:
         for t in threads:
             t.start()
@@ -241,25 +252,77 @@ def launch_job(command: str, slots: List[SlotInfo],
             signal.signal(sig, handler)
         if elastic_driver is not None:
             elastic_driver.stop()
+        if flight_recorder_dir:
+            # harvest dumps workers shipped into the rendezvous store
+            # BEFORE stopping it — the in-memory store dies with it
+            try:
+                scope = flight_recorder.RENDEZVOUS_SCOPE
+                for key in rendezvous.live_keys(scope):
+                    value = rendezvous.get(scope, key)
+                    if value:
+                        shipped[key] = value
+            except Exception as exc:
+                print(f"tpurun: could not collect shipped flight-recorder "
+                      f"dumps: {exc}", file=sys.stderr)
         rendezvous.stop()
 
-    if elastic:
-        # success = enough workers finished cleanly; lost ranks (non-zero
-        # exits) were absorbed by the survivors' re-form
-        clean = sum(1 for c in exit_codes if c == 0)
-        if clean >= min_workers:
-            return 0
+    def job_exit_code() -> int:
+        if elastic:
+            # success = enough workers finished cleanly; lost ranks
+            # (non-zero exits) were absorbed by the survivors' re-form
+            clean = sum(1 for c in exit_codes if c == 0)
+            if clean >= min_workers:
+                return 0
+            if first_failure[0] is not None:
+                return first_failure[0]
+            for code in exit_codes:
+                if code not in (0, None):
+                    return code
+            return 1
         if first_failure[0] is not None:
             return first_failure[0]
         for code in exit_codes:
             if code not in (0, None):
                 return code
-        return 1
-    if first_failure[0] is not None:
-        return first_failure[0]
-    for code in exit_codes:
-        if code not in (0, None):
-            return code
-    if any(code is None for code in exit_codes):
-        return 1
-    return 0
+        if any(code is None for code in exit_codes):
+            return 1
+        return 0
+
+    code = job_exit_code()
+    if flight_recorder_dir:
+        _finalize_flight_dumps(flight_recorder_dir, shipped, code)
+    return code
+
+
+def _finalize_flight_dumps(directory: str, shipped: Dict[str, bytes],
+                           exit_code: int) -> None:
+    """Persist rendezvous-shipped dumps (only for ranks that left no local
+    file — a worker-written file is at least as fresh) and, when the job
+    failed, print the merged cross-rank postmortem."""
+    try:
+        os.makedirs(directory, exist_ok=True)
+    except OSError as exc:
+        print(f"tpurun: cannot write flight-recorder dumps to "
+              f"{directory!r}: {exc}", file=sys.stderr)
+        return
+    for key, value in shipped.items():
+        if not key.startswith("rank."):
+            continue
+        path = os.path.join(
+            directory, f"{flight_recorder.DUMP_PREFIX}"
+            f"{key[len('rank.'):]}.json")
+        if os.path.exists(path):
+            continue
+        try:
+            with open(path, "wb") as f:
+                f.write(value)
+        except OSError as exc:
+            print(f"tpurun: could not write {path}: {exc}", file=sys.stderr)
+    if exit_code == 0:
+        return
+    dumps = flight_recorder.load_dumps(directory)
+    if dumps:
+        print(flight_recorder.format_postmortem(dumps), file=sys.stderr)
+    else:
+        print(f"tpurun: job failed but no flight-recorder dumps were found "
+              f"in {directory!r}", file=sys.stderr)

@@ -89,6 +89,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = urlsplit(self.path).path
+        if path == "/_time":
+            # launcher wall clock: workers sample this (NTP-style) so the
+            # flight-recorder postmortem can merge cross-host event times
+            body = repr(time.time()).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if path.startswith("/_keys/"):
             return self._do_keys(path[len("/_keys/"):].strip("/"))
         sk = self._split()

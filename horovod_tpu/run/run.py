@@ -143,8 +143,21 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
          action="store_true",
          help="Aggregate per-rank metrics dumps (written at shutdown when "
               "HOROVOD_METRICS_DUMP is set) into a cross-rank min/median/"
-              "max table and exit; dump files follow as positional "
-              "arguments.")
+              "max table and exit; dump files (or directories containing "
+              "metrics-rank-*.json) follow as positional arguments. Exits "
+              "non-zero when no dump files are found.")
+
+    flight = parser.add_argument_group("flight recorder")
+    _add(flight, "--flight-recorder-dir", dest="flight_recorder_dir",
+         help="Directory for per-rank flight-recorder dumps "
+              "(flight-rank-N.json): workers write them on failure/exit, "
+              "the launcher collects rendezvous-shipped copies for dead "
+              "workers, and on a failed job a merged cross-rank "
+              "postmortem is printed. Sets HOROVOD_FLIGHT_RECORDER_DIR.")
+    _add(flight, "--postmortem", dest="postmortem", metavar="DIR",
+         help="Print the merged cross-rank postmortem from the "
+              "flight-recorder dumps in DIR and exit (non-zero when DIR "
+              "holds no dumps).")
 
     autotune = parser.add_argument_group("autotune")
     _add(autotune, "--autotune", dest="autotune", action="store_true",
@@ -328,17 +341,41 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
               f"{args.merge_trace}")
         return 0
     if args.metrics_summary:
+        import glob as _glob
+
         from horovod_tpu.metrics import format_summary, summarize_dumps
 
         if not command:
             sys.stderr.write("tpurun --metrics-summary: no dump files\n")
             return 2
+        # a directory argument stands for its metrics-rank-*.json dumps
+        paths: List[str] = []
+        for arg in command:
+            if os.path.isdir(arg):
+                paths.extend(sorted(_glob.glob(
+                    os.path.join(arg, "metrics-rank-*.json"))))
+            else:
+                paths.append(arg)
+        if not paths:
+            sys.stderr.write("tpurun --metrics-summary: no metrics dump "
+                             "files found\n")
+            return 1
         try:
-            rows = summarize_dumps(command)
+            rows = summarize_dumps(paths)
         except (OSError, ValueError, KeyError) as exc:
             sys.stderr.write(f"tpurun --metrics-summary: {exc}\n")
             return 2
-        print(format_summary(rows, n_ranks=len(command)))
+        print(format_summary(rows, n_ranks=len(paths)))
+        return 0
+    if args.postmortem:
+        from horovod_tpu import flight_recorder
+
+        dumps = flight_recorder.load_dumps(args.postmortem)
+        if not dumps:
+            sys.stderr.write(f"tpurun --postmortem: no flight-recorder "
+                             f"dumps found in {args.postmortem!r}\n")
+            return 1
+        print(flight_recorder.format_postmortem(dumps))
         return 0
     if not command:
         sys.stderr.write("tpurun: no command given\n")
@@ -397,7 +434,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         start_timeout=args.start_timeout, backend=backend,
         elastic=elastic, min_workers=min_workers,
         max_workers=args.max_workers,
-        discovery_script=args.host_discovery_script)
+        discovery_script=args.host_discovery_script,
+        flight_recorder_dir=args.flight_recorder_dir)
 
 
 def main() -> None:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu import flight_recorder
 from horovod_tpu.runtime import fusion
 from horovod_tpu.runtime import message as msg
 from horovod_tpu.runtime import types
@@ -43,24 +44,35 @@ class MessageTable:
         # inspector's age baseline (reference: stall_inspector.cc stamps
         # on IncrementTensorCount, not on its own scan)
         self._first_request_time: Dict[str, float] = {}
+        # name -> {rank: monotonic arrival time} — straggler attribution:
+        # which rank announced when (resolution = one controller cycle)
+        self._arrivals: Dict[str, Dict[int, float]] = {}
 
     def increment(self, request: msg.Request, world: int) -> bool:
         """Record one worker's announcement; True when all workers have
         announced this tensor."""
+        now = time.monotonic()
         reqs = self._table.setdefault(request.tensor_name, [])
         if not reqs:
-            self._first_request_time[request.tensor_name] = time.monotonic()
+            self._first_request_time[request.tensor_name] = now
+        self._arrivals.setdefault(request.tensor_name, {})[request.rank] = now
         reqs.append(request)
         return len(reqs) == world
 
     def pop(self, name: str) -> List[msg.Request]:
         self._first_request_time.pop(name, None)
+        self._arrivals.pop(name, None)
         return self._table.pop(name, [])
 
     def first_request_time(self, name: str) -> Optional[float]:
         """Monotonic timestamp of the first announcement for ``name``, or
         None if the tensor is not pending."""
         return self._first_request_time.get(name)
+
+    def arrivals(self, name: str) -> Dict[int, float]:
+        """Per-rank monotonic arrival stamps for ``name`` (empty dict when
+        the tensor is not pending)."""
+        return self._arrivals.get(name, {})
 
     def pending(self) -> Dict[str, List[msg.Request]]:
         return self._table
@@ -205,6 +217,9 @@ class Controller:
         self._deferred_first_seen: Dict[str, float] = {}
         # synchronized invalidation notices queued for the next slow path
         self._invalidate_queue: List[str] = []
+        # coordinator-side straggler attribution, attached by the runtime
+        # (stall.StragglerTracker); None on workers / when unwired
+        self.straggler = None
 
     # -- transport verbs (reference: controller.h:98-124) ------------------
     def sync_bitvectors(self, bits: int) -> Tuple[int, int]:
@@ -278,6 +293,8 @@ class Controller:
                     self._invalidate_queue.append(name)
                     coordinator.set_invalid_in_queue()
                     self._deferred_first_seen.pop(name, None)
+                    flight_recorder.emit("cache_invalidate", name=name,
+                                         stale=stale)
                 coordinator.set_uncached_in_queue()
                 uncached_to_send.append(r)
 
@@ -295,7 +312,8 @@ class Controller:
                 and len(self.message_table):
             try:
                 if stall_inspector.check(self.message_table,
-                                         world=self.world):
+                                         world=self.world,
+                                         straggler=self.straggler):
                     self.request_shutdown()
             except Exception as stall_exc:
                 from horovod_tpu.exceptions import WorkerStallError
@@ -342,19 +360,35 @@ class Controller:
                             if r.tensor_name not in invalidate_names:
                                 invalidate_names.append(r.tensor_name)
                             continue
+                        fresh = (r.tensor_name
+                                 not in self.message_table.pending())
                         if timeline is not None:
-                            if r.tensor_name not in self.message_table.pending():
+                            if fresh:
                                 timeline.negotiate_start(r.tensor_name,
                                                          r.request_type)
                             timeline.negotiate_rank_ready(r.tensor_name,
                                                           r.rank)
+                        if fresh:
+                            flight_recorder.emit("negotiate_begin",
+                                                 name=r.tensor_name,
+                                                 type=r.request_type)
+                        flight_recorder.emit("rank_request",
+                                             name=r.tensor_name, rank=r.rank)
                         if self.message_table.increment(r, self.world):
                             ready_names.append(r.tensor_name)
                 negotiated: List[msg.Response] = []
                 for name in ready_names:
+                    arrivals = self.message_table.arrivals(name)
+                    if self.straggler is not None and arrivals:
+                        self.straggler.observe(name, arrivals)
+                    skew = (round(max(arrivals.values())
+                                  - min(arrivals.values()), 6)
+                            if arrivals else 0.0)
                     reqs = self.message_table.pop(name)
                     if timeline is not None:
                         timeline.negotiate_end(name)
+                    flight_recorder.emit("negotiate_end", name=name,
+                                         skew=skew)
                     negotiated.append(construct_response(reqs))
                 final = []
                 if invalidate_names:

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from horovod_tpu import flight_recorder
 from horovod_tpu import timeline as timeline_mod
 from horovod_tpu.core import mesh as mesh_mod
 from horovod_tpu.metrics import registry as _metrics
@@ -127,7 +128,7 @@ class _PendingOp:
     completed in dispatch order (the cycle body's drain preserves it)."""
 
     __slots__ = ("executor", "op", "entries", "timeline", "name0", "t0",
-                 "finish", "done", "lease")
+                 "finish", "done", "lease", "nbytes", "bucket")
 
     def __init__(self, executor: "Executor", op: str, entries, timeline):
         self.executor = executor
@@ -139,6 +140,10 @@ class _PendingOp:
         self.finish: Optional[Callable[[], None]] = None
         self.done = False
         self.lease = None
+        self.nbytes = sum(types.entry_nbytes(e) for e in entries)
+        # fused size bucket (elements per row), filled by allreduce
+        # dispatch paths that pad to one; None for unbucketed ops
+        self.bucket: Optional[int] = None
 
     def _close(self) -> None:
         self.done = True
@@ -157,6 +162,9 @@ class _PendingOp:
         if self.done:
             return
         _OP_ERRORS.labels(op=self.op).inc()
+        flight_recorder.emit("op_fail", op=self.op, name=self.name0,
+                             bytes=self.nbytes, bucket=self.bucket,
+                             error=str(status.reason)[:200])
         for e in self.entries:
             e.complete(status, None)
         self._close()
@@ -181,6 +189,10 @@ class _PendingOp:
             ok = types.Status.OK()
             _OP_BYTES.labels(op=self.op).inc(
                 sum(types.entry_nbytes(e) for e in self.entries))
+            flight_recorder.emit(
+                "op_complete", op=self.op, name=self.name0,
+                bytes=self.nbytes, bucket=self.bucket,
+                seconds=round(time.perf_counter() - self.t0, 6))
             for e in self.entries:
                 e.complete(ok, e.output)
             self._close()
@@ -320,6 +332,8 @@ class Executor:
         same either way.
         """
         pend = _PendingOp(self, response.response_type, entries, timeline)
+        flight_recorder.emit("op_dispatch", op=pend.op, name=pend.name0,
+                             tensors=len(entries), bytes=pend.nbytes)
         try:
             if timeline is not None:
                 timeline.start(pend.name0, response.response_type)
@@ -363,7 +377,7 @@ class Executor:
                     self._execute_allreduce_host(entries, timeline)
                 else:
                     pend.finish = self._dispatch_allreduce(
-                        response, entries, timeline)
+                        response, entries, timeline, pend)
             elif response.response_type == types.ALLGATHER:
                 if self.net is not None:
                     self._execute_allgather_host(response, entries)
@@ -422,7 +436,8 @@ class Executor:
         return lease, total
 
     # -- single-controller XLA data plane ----------------------------------
-    def _dispatch_allreduce(self, response, entries, timeline=None):
+    def _dispatch_allreduce(self, response, entries, timeline=None,
+                            pend=None):
         """Fused allreduce over the global mesh, entirely on device: the
         worker-stacked entries are flattened, concatenated and
         identity-padded to the size bucket with eager XLA ops (the
@@ -462,6 +477,8 @@ class Executor:
         shapes = [tuple(e.tensor.shape[1:]) for e in stacked]
         total = sum(sizes)
         capacity = self.fusion_buffers.bucket_elems(total, dtype.itemsize)
+        if pend is not None:
+            pend.bucket = capacity
         if timeline is not None:
             timeline.activity_start(name0,
                                     timeline_mod.MEMCPY_IN_FUSION_BUFFER)
@@ -603,6 +620,7 @@ class Executor:
                                         reduce_op)
         if pend is not None:
             pend.lease = lease
+            pend.bucket = lease.capacity
         flat = lease.array  # (1, bucket) — already the row layout
         mesh = self._proc_mesh
         n_proc = mesh.devices.size

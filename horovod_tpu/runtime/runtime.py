@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 import jax
 
+from horovod_tpu import flight_recorder
 from horovod_tpu.core import state as state_mod
 from horovod_tpu.metrics import COUNT_BUCKETS, registry as _metrics
 from horovod_tpu.runtime import message as msg
@@ -154,6 +155,19 @@ class Runtime:
         # stale deferred hits renegotiate on the same clock as stall warnings
         self.controller.STALE_HIT_SECONDS = st.config.stall_check_time_seconds
         self._cycle_time_s = st.config.cycle_time_ms / 1000.0
+        # Straggler attribution rides the coordinator's negotiation table
+        # (per-rank arrival stamps); the tracker feeds the lag EWMA gauge,
+        # skew histogram, periodic report and enriched stall warnings.
+        if self.controller.is_coordinator:
+            from horovod_tpu.stall import StragglerTracker
+
+            self.controller.straggler = StragglerTracker(
+                world=getattr(self.controller, "world", 1),
+                report_seconds=st.config.straggler_report_seconds)
+        # postmortem visibility into the live cycle: the flight recorder
+        # embeds this runtime's in-flight state in every dump
+        self._cycle_pending: "Optional[collections.deque]" = None
+        flight_recorder.set_state_provider("runtime", self._debug_state)
 
         # Autotuning (reference: parameter_manager wired into RunLoopOnce +
         # SynchronizeParameters each cycle, operations.cc:500-550 /
@@ -467,6 +481,10 @@ class Runtime:
             # race and callers see a generic abort instead of
             # WorkersDownError.
             self._record_failure(exc)
+            flight_recorder.emit(
+                "cycle_abort",
+                error="%s: %s" % (type(exc).__name__, str(exc)[:200]))
+            flight_recorder.dump_on_failure("cycle_abort")
             # The popped requests' entries would otherwise be stranded in
             # the table with their handles never completing (and the names
             # permanently poisoned for re-enqueue) — fail them loudly.
@@ -522,11 +540,13 @@ class Runtime:
         # in-flight collective). Completions drain in dispatch order.
         depth = max(1, self._st.config.cycle_pipeline_depth)
         pending: "collections.deque" = collections.deque()
+        self._cycle_pending = pending  # dump-visible while ops in flight
 
         def drain_one() -> None:
             nonlocal cycle_bytes
             tok, tok_entries = pending.popleft()
             _PIPELINE_DEPTH.set(len(pending))
+            flight_recorder.emit("pipeline_depth", depth=len(pending))
             tok.complete()  # never raises: failures become entry statuses
             if self._autotune_active:
                 # JAX dispatch is async: block so the score measures the
@@ -549,6 +569,7 @@ class Runtime:
                                              timeline=self.timeline)
                 pending.append((tok, entries))
                 _PIPELINE_DEPTH.set(len(pending))
+                flight_recorder.emit("pipeline_depth", depth=len(pending))
                 while len(pending) >= depth:
                     drain_one()
             while pending:
@@ -581,6 +602,34 @@ class Runtime:
         _CYCLE_DURATION.observe(time.monotonic() - cycle_t0)
         self._emit_timeline_counters()
         return not shut_down
+
+    def _debug_state(self) -> dict:
+        """In-flight runtime state for flight-recorder dumps: live
+        pending-op tokens, watchdog-tracked entry ages, parked waiters,
+        and the recorded failure. Read without the cycle lock — a dying
+        process must not block on the thread that may be wedged; the
+        values are advisory snapshots."""
+        now = time.monotonic()
+        with self._inflight_lock:
+            inflight = {n: round(now - t, 3)
+                        for n, t in self._inflight_names.items()}
+            waiters = self._waiters
+        ops = []
+        cycle_pending = self._cycle_pending
+        if cycle_pending:
+            for tok, _ in list(cycle_pending):
+                ops.append({
+                    "op": tok.op, "name": tok.name0,
+                    "bytes": tok.nbytes, "bucket": tok.bucket,
+                    "age_seconds":
+                        round(time.perf_counter() - tok.t0, 3)})
+        return {
+            "in_flight_names": inflight,
+            "waiters": waiters,
+            "pending_ops": ops,
+            "failure": repr(self.failure) if self.failure else None,
+            "stopped": self._stop.is_set(),
+        }
 
     def _emit_timeline_counters(self) -> None:
         """Overlay the quantitative plane on the per-tensor trace: one
@@ -637,6 +686,7 @@ class Runtime:
         so every worker exits its cycle loop together (reference:
         response_cache.h:128-132 + controller shutdown propagation)."""
         self._deliberate_stop = True
+        flight_recorder.emit("runtime_stop")
         if getattr(self.controller, "net", None) is not None \
                 and self._thread.is_alive():
             self.controller.request_shutdown()

@@ -16,8 +16,9 @@ Knobs (reference: common.h:78-80): ``HOROVOD_STALL_CHECK_DISABLE``,
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu import flight_recorder
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.utils import logging as log
 
@@ -29,6 +30,85 @@ _STALL_SHUTDOWNS = _metrics().counter(
     "horovod_stall_shutdowns_total",
     "Stall scans that exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS and "
     "triggered a global shutdown.")
+_STRAGGLER_LAG = _metrics().gauge(
+    "horovod_straggler_lag_seconds",
+    "Per-rank negotiation lateness EWMA on the coordinator: how long "
+    "after the first announcing rank this rank's request arrives, "
+    "smoothed across negotiations.", labelnames=("rank",))
+_NEGOTIATE_SKEW = _metrics().histogram(
+    "horovod_negotiate_skew_seconds",
+    "Cross-rank arrival skew (last minus first announcement) per "
+    "completed negotiation on the coordinator.")
+
+
+class StragglerTracker:
+    """Coordinator-side straggler attribution from per-rank arrival
+    timestamps carried by the negotiation message table.
+
+    Every completed negotiation yields one arrival map
+    ``{rank: monotonic_time}``; from it the tracker feeds the cross-rank
+    skew histogram, a per-rank lateness EWMA gauge
+    (``horovod_straggler_lag_seconds{rank=...}``), and a periodic log
+    report naming the consistently-last ranks — the live half of the
+    attribution whose postmortem half is the flight recorder. Arrival
+    resolution is one controller cycle (a fast rank and a slightly-fast
+    rank that announce in the same cycle read as simultaneous); a real
+    straggler lags by many cycles and dominates the EWMA."""
+
+    def __init__(self, world: int, alpha: float = 0.2,
+                 report_seconds: float = 60.0):
+        self.world = world
+        self.alpha = alpha
+        self.report_seconds = report_seconds
+        self.lag_ewma: Dict[int, float] = {}
+        self.last_counts: Dict[int, int] = {}
+        self.samples = 0
+        self._last_report = time.monotonic()
+
+    def observe(self, name: str, arrivals: Dict[int, float]) -> None:
+        if not arrivals:
+            return
+        t_first = min(arrivals.values())
+        skew = max(arrivals.values()) - t_first
+        _NEGOTIATE_SKEW.observe(skew)
+        for rank, t in arrivals.items():
+            lag = t - t_first
+            prev = self.lag_ewma.get(rank)
+            ewma = lag if prev is None else prev + self.alpha * (lag - prev)
+            self.lag_ewma[rank] = ewma
+            _STRAGGLER_LAG.labels(rank=rank).set(ewma)
+        if skew > 0:
+            last_rank = max(arrivals, key=lambda r: arrivals[r])
+            self.last_counts[last_rank] = \
+                self.last_counts.get(last_rank, 0) + 1
+        self.samples += 1
+        self.maybe_report()
+
+    def ranking(self) -> List[Tuple[int, float]]:
+        return sorted(self.lag_ewma.items(), key=lambda kv: -kv[1])
+
+    def lag_summary(self, ranks=None) -> str:
+        items = self.ranking()
+        if ranks:
+            wanted = [kv for kv in items if kv[0] in set(ranks)]
+            items = wanted or items
+        return ", ".join("rank %d=%.3fs" % kv for kv in items[:8])
+
+    def maybe_report(self) -> None:
+        if self.report_seconds <= 0 or not self.samples:
+            return
+        now = time.monotonic()
+        if now - self._last_report < self.report_seconds:
+            return
+        self._last_report = now
+        leader, lag = self.ranking()[0]
+        last_frac = self.last_counts.get(leader, 0) / self.samples
+        log.info(
+            "straggler report: over %d negotiations the lateness EWMA is "
+            "%s; rank %d arrived last in %.0f%% of them",
+            self.samples, self.lag_summary(), leader, 100.0 * last_frac)
+        flight_recorder.emit("straggler_report", leader=leader,
+                             lag=round(lag, 6), samples=self.samples)
 
 
 class StallInspector:
@@ -51,8 +131,8 @@ class StallInspector:
         # by up to one warning interval — ~2x delay before the warning).
         self._first_seen: Dict[str, float] = {}
 
-    def check(self, message_table, cache=None, world: Optional[int] = None
-              ) -> bool:
+    def check(self, message_table, cache=None, world: Optional[int] = None,
+              straggler: "Optional[StragglerTracker]" = None) -> bool:
         """Scan for stalled tensors; returns True if a stall exceeded the
         shutdown threshold (reference: CheckForStalledTensors,
         stall_inspector.cc:26-110)."""
@@ -67,6 +147,7 @@ class StallInspector:
         stalled_msgs = []
         shutdown = False
         missing_ranks: set = set()
+        warn_missing: set = set()
         seen_names = set()
         arrival_time = getattr(message_table, "first_request_time", None)
         for name, requests in pending.items():
@@ -87,6 +168,7 @@ class StallInspector:
             stalled_msgs.append(
                 f"{name} [ready ranks: {ready}"
                 + (f", missing ranks: {missing}]" if missing else "]"))
+            warn_missing.update(missing)
             # NOTE: stalled *cached* tensors re-enter negotiation through
             # the controller's synchronized STALE_HIT invalidation protocol
             # (controller.py) — invalidating the coordinator's cache here
@@ -101,20 +183,32 @@ class StallInspector:
 
         if stalled_msgs:
             _STALL_WARNINGS.inc(len(stalled_msgs))
+            lag_note = ""
+            if straggler is not None:
+                summary = straggler.lag_summary(warn_missing or None)
+                if summary:
+                    lag_note = (" Straggler lag EWMA (seconds since first "
+                                "announcing rank): %s." % summary)
             log.warning(
                 "One or more tensors were submitted to be reduced, gathered "
                 "or broadcasted by subset of ranks and are waiting for "
                 "remainder of ranks for more than %.0f seconds. This may "
                 "indicate that different ranks are trying to submit "
                 "different tensors or that only subset of ranks is "
-                "submitting tensors. Stalled ops: %s",
-                self.warning_time, "; ".join(stalled_msgs))
+                "submitting tensors. Stalled ops: %s%s",
+                self.warning_time, "; ".join(stalled_msgs), lag_note)
+            flight_recorder.emit("stall_warning",
+                                 tensors=len(stalled_msgs),
+                                 missing=sorted(warn_missing))
         if shutdown:
             _STALL_SHUTDOWNS.inc()
             log.error(
                 "Stalled tensors exceeded "
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (%.0fs); "
                 "shutting down.", self.shutdown_time)
+            flight_recorder.emit("stall_shutdown",
+                                 ranks=sorted(missing_ranks))
+            flight_recorder.dump_on_failure("stall_shutdown")
             if self.elastic:
                 from horovod_tpu.exceptions import WorkerStallError
 

@@ -38,7 +38,7 @@ from typing import Optional
 from horovod_tpu.metrics import registry as _metrics
 
 _TL_DROPPED = _metrics().counter(
-    "horovod_timeline_events_dropped_total",
+    "horovod_timeline_dropped_events_total",
     "Timeline events discarded after the writer became unhealthy or its "
     "ring overflowed.")
 

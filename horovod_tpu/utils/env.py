@@ -47,12 +47,17 @@ HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
 HOROVOD_CYCLE_PIPELINE_DEPTH = "HOROVOD_CYCLE_PIPELINE_DEPTH"
 HOROVOD_FUSION_BUCKET_QUANTUM = "HOROVOD_FUSION_BUCKET_QUANTUM"
+HOROVOD_FLIGHT_RECORDER = "HOROVOD_FLIGHT_RECORDER"
+HOROVOD_FLIGHT_RECORDER_DIR = "HOROVOD_FLIGHT_RECORDER_DIR"
+HOROVOD_STRAGGLER_REPORT_SECONDS = "HOROVOD_STRAGGLER_REPORT_SECONDS"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference: operations.cc:386
 DEFAULT_CACHE_CAPACITY = 1024  # reference: global_state.h:88
 DEFAULT_CYCLE_PIPELINE_DEPTH = 2
 DEFAULT_FUSION_BUCKET_QUANTUM_BYTES = 64 * 1024
+DEFAULT_FLIGHT_RECORDER_CAPACITY = 2048
+DEFAULT_STRAGGLER_REPORT_SECONDS = 60.0
 
 
 def _get_int(name: str, default: int) -> int:
@@ -80,6 +85,22 @@ def _get_bool(name: str, default: bool = False) -> bool:
     if value is None or value == "":
         return default
     return value.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def parse_flight_recorder(value: "str | None") -> "tuple[bool, int]":
+    """``HOROVOD_FLIGHT_RECORDER`` -> (enabled, ring capacity). Unset or
+    truthy = on at the default capacity; an integer > 1 is the capacity;
+    0/false/no/off disables."""
+    if value is None or value.strip() == "":
+        return True, DEFAULT_FLIGHT_RECORDER_CAPACITY
+    v = value.strip().lower()
+    if v in ("0", "false", "no", "off"):
+        return False, DEFAULT_FLIGHT_RECORDER_CAPACITY
+    try:
+        n = int(v)
+    except ValueError:
+        return True, DEFAULT_FLIGHT_RECORDER_CAPACITY
+    return True, (n if n > 1 else DEFAULT_FLIGHT_RECORDER_CAPACITY)
 
 
 @dataclasses.dataclass
@@ -118,6 +139,13 @@ class Config:
     # size-bucket quantum for the fused program cache; payloads at or
     # under it keep exact sizes, larger ones pad to a power of two
     fusion_bucket_quantum: int = DEFAULT_FUSION_BUCKET_QUANTUM_BYTES
+    # flight recorder: always-on bounded event ring + crash dumps
+    flight_recorder: bool = True
+    flight_recorder_capacity: int = DEFAULT_FLIGHT_RECORDER_CAPACITY
+    flight_recorder_dir: str = ""
+    # coordinator straggler report interval (0 disables the log line;
+    # the lag gauge/skew histogram stay on either way)
+    straggler_report_seconds: float = DEFAULT_STRAGGLER_REPORT_SECONDS
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -158,6 +186,16 @@ class Config:
             fusion_bucket_quantum=_get_int(
                 HOROVOD_FUSION_BUCKET_QUANTUM,
                 DEFAULT_FUSION_BUCKET_QUANTUM_BYTES,
+            ),
+            flight_recorder=parse_flight_recorder(
+                os.environ.get(HOROVOD_FLIGHT_RECORDER))[0],
+            flight_recorder_capacity=parse_flight_recorder(
+                os.environ.get(HOROVOD_FLIGHT_RECORDER))[1],
+            flight_recorder_dir=os.environ.get(
+                HOROVOD_FLIGHT_RECORDER_DIR, ""),
+            straggler_report_seconds=_get_float(
+                HOROVOD_STRAGGLER_REPORT_SECONDS,
+                DEFAULT_STRAGGLER_REPORT_SECONDS,
             ),
         )
 

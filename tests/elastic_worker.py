@@ -50,6 +50,11 @@ def main() -> int:
           f"w={w:g} generation={restarts} "
           f"elastic_restarts_total={_RESTARTS_TOTAL.value:g}",
           flush=True)
+    # straggler attribution (coordinator only has samples; empty elsewhere)
+    lag = hvd.metrics().get("horovod_straggler_lag_seconds", {})
+    for row in lag.get("values", ()):
+        print(f"LAG rank={row['labels'].get('rank')} "
+              f"value={row['value']:.6f}", flush=True)
     if state.step != TOTAL_STEPS or abs(w - TOTAL_STEPS) > 1e-5:
         return 3
     hvd.shutdown()

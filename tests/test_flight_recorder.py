@@ -1,0 +1,384 @@
+"""Flight recorder tests: ring semantics, dump triggers, straggler
+attribution, and the launcher-side postmortem merge (ISSUE.md PR 4).
+
+The multiprocess half (a killed worker leaving a readable dump naming
+itself; an injected-slow rank leading the straggler gauge) lives in
+tests/test_flight_recorder_multiprocess.py.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import flight_recorder
+from horovod_tpu.flight_recorder import SCHEMA, FlightRecorder
+from horovod_tpu.utils.env import (DEFAULT_FLIGHT_RECORDER_CAPACITY,
+                                   parse_flight_recorder)
+
+
+@pytest.fixture
+def rec(monkeypatch):
+    """A private recorder instance so tests never disturb the module
+    global the production code paths share."""
+    monkeypatch.delenv("HOROVOD_FLIGHT_RECORDER", raising=False)
+    monkeypatch.delenv("HOROVOD_FLIGHT_RECORDER_DIR", raising=False)
+    monkeypatch.delenv("HOROVOD_RANK", raising=False)
+    monkeypatch.delenv("HOROVOD_RENDEZVOUS_HTTP_ADDR", raising=False)
+    monkeypatch.delenv("HOROVOD_RENDEZVOUS_HTTP_PORT", raising=False)
+    return FlightRecorder()
+
+
+class TestParseKnob:
+    def test_default_on(self):
+        assert parse_flight_recorder(None) == \
+            (True, DEFAULT_FLIGHT_RECORDER_CAPACITY)
+        assert parse_flight_recorder("") == \
+            (True, DEFAULT_FLIGHT_RECORDER_CAPACITY)
+
+    @pytest.mark.parametrize("v", ["0", "false", "no", "off", " OFF "])
+    def test_disable(self, v):
+        assert parse_flight_recorder(v)[0] is False
+
+    def test_integer_sets_capacity(self):
+        assert parse_flight_recorder("512") == (True, 512)
+        # 1/true-ish keep the default capacity
+        assert parse_flight_recorder("1") == \
+            (True, DEFAULT_FLIGHT_RECORDER_CAPACITY)
+        assert parse_flight_recorder("yes") == \
+            (True, DEFAULT_FLIGHT_RECORDER_CAPACITY)
+
+
+class TestRing:
+    def test_ring_overwrites_oldest(self, rec, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER", "8")
+        rec.configure()
+        assert rec.capacity == 8
+        for i in range(20):
+            rec.emit("tick", i=i)
+        evs = rec.events()
+        assert len(evs) == 8
+        assert [e["i"] for e in evs] == list(range(12, 20))
+        assert all(e["kind"] == "tick" and "t" in e for e in evs)
+
+    def test_configure_capacity_change_keeps_recent(self, rec, monkeypatch):
+        for i in range(10):
+            rec.emit("tick", i=i)
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER", "4")
+        rec.configure()
+        assert [e["i"] for e in rec.events()] == [6, 7, 8, 9]
+
+    def test_disabled_emits_nothing(self, rec, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER", "0")
+        rec.configure()
+        rec.emit("tick")
+        assert rec.events() == []
+
+    def test_concurrent_emit_is_safe(self, rec, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER", "256")
+        rec.configure()
+        barrier = threading.Barrier(8)
+
+        def hammer(tid):
+            barrier.wait()
+            for i in range(2000):
+                rec.emit("hammer", tid=tid, i=i)
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        evs = rec.events()
+        assert len(evs) == 256
+        # every surviving event is a complete record, no torn writes
+        assert all(e["kind"] == "hammer" and "tid" in e and "i" in e
+                   for e in evs)
+
+
+class TestDump:
+    def test_snapshot_shape(self, rec):
+        rec.emit("tick", i=1)
+        rec.set_state_provider("thing", lambda: {"depth": 3})
+        snap = rec.snapshot("unit")
+        assert snap["schema"] == SCHEMA
+        assert snap["reason"] == "unit"
+        assert snap["state"]["thing"] == {"depth": 3}
+        assert snap["events"][-1]["kind"] == "tick"
+        assert "metrics" in snap and "pid" in snap and "host" in snap
+
+    def test_failing_state_provider_does_not_block(self, rec):
+        rec.set_state_provider("bad", lambda: 1 / 0)
+        snap = rec.snapshot("unit")
+        assert "state provider failed" in snap["state"]["bad"]
+
+    def test_dump_path_variants(self, rec, tmp_path):
+        rec.launch_rank = 3
+        assert rec._dump_path(str(tmp_path)) == \
+            str(tmp_path / "flight-rank-3.json")
+        assert rec._dump_path(str(tmp_path / "x-{rank}.json")) == \
+            str(tmp_path / "x-3.json")
+        assert rec._dump_path(str(tmp_path / "exact.json")) == \
+            str(tmp_path / "exact.json")
+
+    def test_dump_writes_file_and_history(self, rec, tmp_path):
+        rec.emit("tick", i=1)
+        rec.dump("first", path=str(tmp_path))
+        rec.dump("second", path=str(tmp_path))
+        # last dump wins the file; earlier reasons survive in history
+        with open(tmp_path / "flight-rank-0.json") as f:
+            doc = json.load(f)
+        assert doc["reason"] == "second"
+        assert [h["reason"] for h in doc["dump_history"]] == ["first"]
+        assert doc["events"][-1]["kind"] == "tick"
+
+    def test_dump_never_raises_on_bad_dir(self, rec):
+        rec.dump("unit", path="/proc/does/not/exist/x.json")
+
+    def test_dump_on_failure_rate_limited(self, tmp_path, monkeypatch):
+        g = flight_recorder.recorder()
+        monkeypatch.setattr(g, "enabled", True)
+        monkeypatch.setattr(g, "dir", str(tmp_path))
+        monkeypatch.setattr(g, "_dump_history", [])
+        monkeypatch.setattr(g, "_last_failure_dump", 0.0)
+        flight_recorder.dump_on_failure("one")
+        flight_recorder.dump_on_failure("two")  # within 1s: suppressed
+        assert [h["reason"] for h in g._dump_history] == ["one"]
+
+    def test_dump_debug_state_public_api(self, tmp_path, monkeypatch):
+        import horovod_tpu as hvd
+        g = flight_recorder.recorder()
+        monkeypatch.setattr(g, "dir", "")
+        snap = hvd.dump_debug_state()
+        assert snap["schema"] == SCHEMA
+        out = tmp_path / "dbg.json"
+        hvd.dump_debug_state(path=str(out))
+        assert json.load(open(out))["reason"] == "on_demand"
+
+
+class TestDebugEndpoint:
+    def test_debug_route_serves_snapshot(self):
+        from horovod_tpu.metrics import registry
+        reg = registry()
+        port = reg.serve(0)
+        try:
+            flight_recorder.emit("debug_probe", x=1)
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/debug" % port, timeout=5) as resp:
+                assert resp.headers["Content-Type"] == "application/json"
+                doc = json.loads(resp.read())
+            assert doc["schema"] == SCHEMA
+            assert doc["reason"] == "debug_endpoint"
+            assert any(e["kind"] == "debug_probe" for e in doc["events"])
+        finally:
+            reg.stop_server()
+
+
+class TestRuntimeIntegration:
+    def test_cycle_abort_emits_and_dumps(self, hvd, tmp_path, monkeypatch):
+        from horovod_tpu.runtime.runtime import get_runtime
+        rt = get_runtime()
+        g = flight_recorder.recorder()
+        monkeypatch.setattr(g, "dir", str(tmp_path))
+        monkeypatch.setattr(g, "_last_failure_dump", 0.0)
+
+        def boom(*a, **k):
+            raise RuntimeError("injected cycle failure")
+
+        monkeypatch.setattr(rt.controller, "compute_response_list", boom)
+        h = hvd.allreduce_async(
+            hvd.stack_per_worker(
+                [np.ones((2,), "float32")] * hvd.size()),
+            name="fr/abort")
+        with pytest.raises(Exception):
+            hvd.synchronize(h)
+        deadline = time.monotonic() + 10
+        path = tmp_path / ("flight-rank-%d.json" % g.launch_rank)
+        while not path.exists() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        doc = json.load(open(path))
+        assert doc["reason"] == "cycle_abort"
+        aborts = [e for e in doc["events"] if e["kind"] == "cycle_abort"]
+        assert aborts and "injected cycle failure" in aborts[-1]["error"]
+
+    def test_init_registers_runtime_state_provider(self, hvd):
+        snap = flight_recorder.debug_state()
+        assert "runtime" in snap["state"]
+        assert any(e["kind"] == "init" for e in snap["events"])
+
+
+class TestStragglerTracker:
+    def test_lag_ewma_names_slow_rank(self):
+        from horovod_tpu.stall import StragglerTracker
+        tr = StragglerTracker(world=3, report_seconds=0)
+        for i in range(10):
+            tr.observe("t%d" % i, {0: 100.0 + i, 1: 100.0 + i,
+                                   2: 100.4 + i})
+        ranking = tr.ranking()
+        assert ranking[0][0] == 2
+        assert ranking[0][1] == pytest.approx(0.4, abs=1e-6)
+        assert tr.last_counts[2] == 10
+        assert "rank 2=0.400s" in tr.lag_summary()
+        # subset filter keeps only the wanted ranks
+        assert tr.lag_summary(ranks=[0]).startswith("rank 0=")
+
+    def test_report_emits_flight_event(self):
+        from horovod_tpu.stall import StragglerTracker
+        tr = StragglerTracker(world=2, report_seconds=0.001)
+        tr._last_report = time.monotonic() - 60
+        tr.observe("t", {0: 1.0, 1: 1.2})
+        evs = flight_recorder.recorder().events()
+        reports = [e for e in evs if e["kind"] == "straggler_report"]
+        assert reports and reports[-1]["leader"] == 1
+
+
+class _Req:
+    def __init__(self, rank):
+        self.rank = rank
+
+
+class _Table:
+    def __init__(self, pending, first):
+        self._pending, self._first = pending, first
+
+    def pending(self):
+        return self._pending
+
+    def first_request_time(self, name):
+        return self._first.get(name)
+
+
+class TestStallInspectorAttribution:
+    def test_warning_enriched_with_lag(self, monkeypatch):
+        from horovod_tpu import stall
+        tr = stall.StragglerTracker(world=2, report_seconds=0)
+        tr.lag_ewma = {1: 0.5}
+        insp = stall.StallInspector(warning_time_seconds=0.0,
+                                    shutdown_time_seconds=0.0)
+        table = _Table({"grad/x": [_Req(0)]},
+                       {"grad/x": time.monotonic() - 100})
+        warnings = []
+        monkeypatch.setattr(stall.log, "warning",
+                            lambda fmt, *a: warnings.append(fmt % a))
+        assert insp.check(table, world=2, straggler=tr) is False
+        assert warnings and "rank 1=0.500s" in warnings[-1]
+        warn = [e for e in flight_recorder.recorder().events()
+                if e["kind"] == "stall_warning"]
+        assert warn and warn[-1]["missing"] == [1]
+
+    def test_elastic_shutdown_raises_with_ranks(self):
+        from horovod_tpu.exceptions import WorkerStallError
+        from horovod_tpu.stall import StallInspector
+        insp = StallInspector(warning_time_seconds=0.0,
+                              shutdown_time_seconds=0.001, elastic=True)
+        table = _Table({"grad/x": [_Req(0)]},
+                       {"grad/x": time.monotonic() - 100})
+        with pytest.raises(WorkerStallError) as ei:
+            insp.check(table, world=2)
+        assert ei.value.ranks == (1,)
+        down = [e for e in flight_recorder.recorder().events()
+                if e["kind"] == "stall_shutdown"]
+        assert down and down[-1]["ranks"] == [1]
+
+
+# -- launcher-side postmortem -------------------------------------------------
+def _dump(rank, events, offset=None, reason="test", metrics=None):
+    return {"schema": SCHEMA, "rank": rank, "launch_rank": rank,
+            "pid": 1000 + rank, "host": "host%d" % rank, "reason": reason,
+            "wall_time": 0.0, "clock_offset_seconds": offset,
+            "dump_history": [], "events": events, "state": {},
+            "metrics": metrics or {}}
+
+
+class TestPostmortem:
+    def test_load_dumps_skips_garbage(self, tmp_path):
+        (tmp_path / "flight-rank-0.json").write_text(
+            json.dumps(_dump(0, [])))
+        (tmp_path / "flight-rank-9.json").write_text("{truncated")
+        (tmp_path / "unrelated.json").write_text("{}")
+        dumps = flight_recorder.load_dumps(str(tmp_path))
+        assert len(dumps) == 1 and dumps[0]["launch_rank"] == 0
+        assert flight_recorder.load_dumps(str(tmp_path / "missing")) == []
+
+    def test_merge_applies_clock_offsets(self):
+        dumps = [
+            _dump(0, [{"t": 10.0, "kind": "a"}], offset=5.0),
+            _dump(1, [{"t": 12.0, "kind": "b"}], offset=0.0),
+        ]
+        merged = flight_recorder.merge_events(dumps)
+        # rank 0's event lands at 15.0 merged time, after rank 1's 12.0
+        assert [e["kind"] for e in merged] == ["b", "a"]
+        assert merged[1]["t_merged"] == 15.0
+        assert merged[0]["rank"] == 1
+
+    def test_culprit_priority_kill_wins(self):
+        dumps = [
+            _dump(0, [{"t": 1, "kind": "workers_down", "ranks": [2]}]),
+            _dump(1, [{"t": 1, "kind": "fault_inject", "action": "kill",
+                       "rank": 1}]),
+        ]
+        rank, why = flight_recorder.suspect_culprit(dumps)
+        assert rank == 1 and "injected kill" in why
+
+    def test_culprit_from_workers_down_votes(self):
+        dumps = [
+            _dump(0, [{"t": 1, "kind": "workers_down", "ranks": [2]},
+                      {"t": 2, "kind": "stall_shutdown", "ranks": [2]}]),
+            _dump(1, [{"t": 1, "kind": "workers_down", "ranks": [2, 3]}]),
+        ]
+        rank, why = flight_recorder.suspect_culprit(dumps)
+        assert rank == 2 and "workers_down" in why
+
+    def test_culprit_from_straggler_lag(self):
+        metrics = {"horovod_straggler_lag_seconds": {"values": [
+            {"labels": {"rank": "0"}, "value": 0.01},
+            {"labels": {"rank": "2"}, "value": 0.42},
+        ]}}
+        dumps = [_dump(0, [], metrics=metrics)]
+        rank, why = flight_recorder.suspect_culprit(dumps)
+        assert rank == "2" and "straggler lag" in why
+
+    def test_culprit_none(self):
+        assert flight_recorder.suspect_culprit([_dump(0, [])]) is None
+
+    def test_format_postmortem(self):
+        dumps = [
+            _dump(0, [{"t": 10.0 + i, "kind": "tick", "i": i}
+                      for i in range(50)], offset=0.0),
+            _dump(1, [{"t": 100.0, "kind": "fault_inject", "action": "kill",
+                       "rank": 1}], reason="fault_inject_kill"),
+        ]
+        text = flight_recorder.format_postmortem(dumps, last_n=10)
+        assert "2 dumps" in text
+        assert "rank 1: reason=fault_inject_kill" in text
+        assert "earlier events omitted" in text
+        assert "suspected culprit: rank 1 (recorded its own injected kill)" \
+            in text
+        # the tail carries the per-event extras
+        assert "action=kill" in text
+
+
+class TestCli:
+    def test_postmortem_exits_nonzero_when_empty(self, tmp_path, capsys):
+        from horovod_tpu.run.run import run_commandline
+        assert run_commandline(["--postmortem", str(tmp_path)]) == 1
+        assert "no flight-recorder dumps" in capsys.readouterr().err
+
+    def test_postmortem_prints_report(self, tmp_path, capsys):
+        from horovod_tpu.run.run import run_commandline
+        (tmp_path / "flight-rank-0.json").write_text(json.dumps(
+            _dump(0, [{"t": 1.0, "kind": "fault_inject", "action": "kill",
+                       "rank": 0}])))
+        assert run_commandline(["--postmortem", str(tmp_path)]) == 0
+        assert "suspected culprit: rank 0" in capsys.readouterr().out
+
+    def test_metrics_summary_exits_nonzero_when_empty(self, tmp_path,
+                                                      capsys):
+        from horovod_tpu.run.run import run_commandline
+        assert run_commandline(["--metrics-summary", str(tmp_path)]) == 1
+        assert "no metrics dump" in capsys.readouterr().err

@@ -1,0 +1,94 @@
+"""Flight-recorder acceptance scenarios over real processes (ISSUE.md
+PR 4): a HOROVOD_FAULT_INJECT-killed worker must leave a readable dump
+whose final events identify the dead rank (and the merged postmortem
+must name it), and an injected-slow rank must lead the coordinator's
+``horovod_straggler_lag_seconds`` gauge.
+
+Reuses the elastic multiprocess harness: the pytest process hosts the
+rendezvous HTTP store (standing in for the tpurun launcher), workers run
+tests/elastic_worker.py over the socket/native transport.
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.runtime.native import native_built
+from test_elastic_multiprocess import _launch_elastic
+
+pytestmark = pytest.mark.skipif(
+    not native_built(), reason="native transport not built")
+
+
+def test_killed_rank_leaves_dump_and_postmortem_names_it(tmp_path, capsys):
+    """Acceptance: rank 1 is hard-killed (os._exit) at step 3; its dump —
+    written before the exit — must record the injected kill, survivors
+    must record the worker loss, and ``tpurun --postmortem`` over the
+    dump directory must name rank 1 as the suspected culprit."""
+    flight_dir = tmp_path / "flight"
+    procs, outs = _launch_elastic(
+        3, extra_env={
+            "HOROVOD_FAULT_INJECT": "kill:rank=1:step=3:code=17",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+            "HOROVOD_FLIGHT_RECORDER_DIR": str(flight_dir),
+        })
+    assert procs[1].returncode == 17, outs[1]
+    for i in (0, 2):
+        assert procs[i].returncode == 0, (i, outs[i])
+
+    # the killed rank dumped before os._exit, naming its own death
+    victim = json.load(open(flight_dir / "flight-rank-1.json"))
+    assert victim["reason"] == "fault_inject_kill"
+    kills = [e for e in victim["events"]
+             if e["kind"] == "fault_inject" and e["action"] == "kill"]
+    assert kills and kills[-1]["rank"] == 1 and kills[-1]["step"] == 3
+
+    # every rank left a dump; the survivors recorded a failure-path dump
+    # (the first of cycle_abort / worker_lost wins, the rest are
+    # rate-limited), superseded by the clean-shutdown dump with the
+    # earlier reason preserved in dump_history
+    survivor_events, survivor_reasons = [], []
+    for i in (0, 2):
+        doc = json.load(open(flight_dir / ("flight-rank-%d.json" % i)))
+        survivor_events.extend(doc["events"])
+        survivor_reasons.append(doc["reason"])
+        survivor_reasons.extend(h["reason"] for h in doc["dump_history"])
+    assert any(e["kind"] == "workers_down" for e in survivor_events)
+    assert any(e["kind"] == "elastic_reform" for e in survivor_events)
+    assert {"cycle_abort", "worker_lost", "worker_stall"} & \
+        set(survivor_reasons), survivor_reasons
+
+    # the merged postmortem names the culprit
+    from horovod_tpu.run.run import run_commandline
+    assert run_commandline(["--postmortem", str(flight_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "suspected culprit: rank 1 (recorded its own injected kill)" \
+        in out
+    assert "reason=fault_inject_kill" in out
+
+
+def test_injected_slow_rank_leads_straggler_gauge():
+    """Acceptance: rank 2 sleeps 0.3s at every step >= 2; the coordinator
+    (rank 0) must attribute the lag to rank 2 via the
+    horovod_straggler_lag_seconds EWMA. The response cache is disabled so
+    every step renegotiates and stamps per-rank arrivals."""
+    procs, outs = _launch_elastic(
+        3, extra_env={
+            "HOROVOD_FAULT_INJECT": "slow:rank=2:step=2:seconds=0.3",
+            "HOROVOD_CACHE_CAPACITY": "0",
+        })
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "step=8" in out, out
+
+    lags = {}
+    for line in outs[0].splitlines():
+        if line.startswith("LAG rank="):
+            parts = dict(kv.split("=") for kv in line.split()[1:])
+            lags[int(parts["rank"])] = float(parts["value"])
+    assert lags, "coordinator printed no straggler lag samples:\n" + outs[0]
+    leader = max(lags, key=lags.get)
+    assert leader == 2, lags
+    assert lags[2] > 0.05, lags
+    assert all(lags[r] < lags[2] for r in lags if r != 2), lags

@@ -238,10 +238,10 @@ def test_python_writer_counts_drops_when_unhealthy(tmp_path):
     path = str(tmp_path / "t.json")
     w = _Writer(path)
     w.close()  # writer thread exits; _healthy goes False
-    before = hvd.metrics()["horovod_timeline_events_dropped_total"][
+    before = hvd.metrics()["horovod_timeline_dropped_events_total"][
         "values"][0]["value"]
     w.emit("B", 1, 1.0, name="late")
     w.emit("E", 1, 2.0)
-    after = hvd.metrics()["horovod_timeline_events_dropped_total"][
+    after = hvd.metrics()["horovod_timeline_dropped_events_total"][
         "values"][0]["value"]
     assert after - before == 2
