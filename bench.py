@@ -455,7 +455,7 @@ def transformer_main(family: str, allow_env: bool = True,
     return result
 
 
-def control_plane_main(fast: bool = False):
+def control_plane_main(fast: bool = False, np_override: int = None):
     """Control-plane benchmark (VERDICT r2 ask 4): negotiation latency,
     cache fast path, fusion throughput, autotune — measured over a real
     np=4 multi-process world on the host wire (tools/control_plane_bench
@@ -467,13 +467,19 @@ def control_plane_main(fast: bool = False):
     amortize fixed per-window protocol bytes less; see the tool's
     header comment) but stay the same story; the full protocol (r4:
     5.5 min on a 1-core box) stays behind the explicit
-    --control-plane flag."""
+    --control-plane flag.
+
+    ``np_override``: world size for the trimmed always-run probe (the
+    budget-squeezed sweep runs np=2 so the control-plane rows are never
+    silently absent from the artifact)."""
     import subprocess
 
+    np_workers = (str(np_override) if np_override is not None
+                  else os.environ.get("BENCH_CONTROL_PLANE_NP", "4"))
     cmd = [sys.executable,
            os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tools", "control_plane_bench.py"),
-           "--np", os.environ.get("BENCH_CONTROL_PLANE_NP", "4")]
+           "--np", np_workers]
     if fast:
         cmd.append("--fast")
     raw = subprocess.run(cmd, capture_output=True, text=True, timeout=900,
@@ -498,7 +504,7 @@ def control_plane_main(fast: bool = False):
     return results
 
 
-def collectives_main():
+def collectives_main(tiny: bool = False):
     """Data-plane microbench: steady-state fused allreduce through the
     background runtime — pipelined dispatch, size-bucketed program cache
     and persistent fusion buffer all on the hot path. Emits ONE JSON line
@@ -507,7 +513,11 @@ def collectives_main():
     during the timed (post-warmup) phase. The compile count is the
     regression canary — steady state over fixed named tensors must stay
     at zero new compiles (tests/test_data_plane.py enforces the same
-    invariant at tier 1)."""
+    invariant at tier 1).
+
+    ``tiny`` (--tiny / the tier-1 smoke test): one small size, a couple
+    of steps — exercises every code path in seconds; the numbers are
+    meaningless and the line is marked ``"tiny": true``."""
     hvd.init()
     from horovod_tpu.runtime import executor as executor_mod
     from horovod_tpu.runtime.fusion_buffer import bucket_elems
@@ -515,7 +525,7 @@ def collectives_main():
 
     ex = get_runtime().executor
     world = hvd.size()
-    tensors_per_step = 4
+    tensors_per_step = 2 if tiny else 4
     # Bin groupings are timing-dependent (the background cycle may catch
     # 1..tensors_per_step of the enqueued tensors per bin) but handles are
     # synchronized before the next step, so bins never span steps and the
@@ -524,11 +534,12 @@ def collectives_main():
     # step adds zero compiles, so the timed phase can't hit a first-ever
     # grouping; the early warmup steps enqueue 1, 2, ... tensors to give
     # each total a deliberate chance to compile.
-    max_warmup_steps, timed_steps = 24, 7
+    max_warmup_steps, timed_steps = (6, 2) if tiny else (24, 7)
     rng = np.random.RandomState(0)
     rows = []
     steady_compiles = 0
-    for elems in (4096, 65536, 1 << 20):  # 16 KiB .. 4 MiB per tensor
+    # 16 KiB .. 4 MiB per tensor (tiny: one 1 KiB size)
+    for elems in ((256,) if tiny else (4096, 65536, 1 << 20)):
         payload = rng.randn(world, elems).astype(np.float32)
 
         def one_step(step, count=tensors_per_step):
@@ -584,7 +595,7 @@ def collectives_main():
     from horovod_tpu import flight_recorder
 
     rec = flight_recorder.recorder()
-    n_emit = 100_000
+    n_emit = 1_000 if tiny else 100_000
     t0 = time.perf_counter()
     for i in range(n_emit):
         rec.emit("bench_overhead", op=i)
@@ -600,13 +611,13 @@ def collectives_main():
         for h in hs:
             hvd.synchronize(h)
 
-    for s in range(4):  # warm the fr-name buckets/programs
+    for s in range(2 if tiny else 4):  # warm the fr-name buckets/programs
         depth2_step(1000 + s)
     # interleave recorder-off/on steps (A/B pairs) so dispatch-latency
     # drift does not masquerade as recorder overhead
     was_enabled = rec.enabled
     lat_off, lat_on = [], []
-    for s in range(15):
+    for s in range(3 if tiny else 15):
         for enabled, lat in ((False, lat_off), (True, lat_on)):
             rec.enabled = enabled
             t0 = time.perf_counter()
@@ -642,6 +653,153 @@ def collectives_main():
         "program_cache_hits_total": executor_mod._PROGRAM_CACHE_HITS.value,
         "flight_recorder": fr_overhead,
     }
+    if tiny:
+        result["tiny"] = True
+    print(json.dumps(result), flush=True)
+    return result
+
+
+def _bert_large_param_shapes():
+    """BERT-Large parameter shapes (L=24, d=1024, ff=4096, vocab 30522,
+    seq 512) as a flat dict — ~335M params, the flagship workload's
+    optimizer-state footprint without building the model."""
+    shapes = {
+        "embed/token": (30522, 1024), "embed/pos": (512, 1024),
+        "embed/type": (2, 1024),
+        "embed/ln_scale": (1024,), "embed/ln_bias": (1024,),
+        "pooler/kernel": (1024, 1024), "pooler/bias": (1024,),
+    }
+    for i in range(24):
+        p = "layer%02d/" % i
+        shapes.update({
+            p + "q_kernel": (1024, 1024), p + "q_bias": (1024,),
+            p + "k_kernel": (1024, 1024), p + "k_bias": (1024,),
+            p + "v_kernel": (1024, 1024), p + "v_bias": (1024,),
+            p + "o_kernel": (1024, 1024), p + "o_bias": (1024,),
+            p + "mlp_in_kernel": (1024, 4096), p + "mlp_in_bias": (4096,),
+            p + "mlp_out_kernel": (4096, 1024), p + "mlp_out_bias": (1024,),
+            p + "ln1_scale": (1024,), p + "ln1_bias": (1024,),
+            p + "ln2_scale": (1024,), p + "ln2_bias": (1024,),
+        })
+    return shapes
+
+
+def sharded_optimizer_main(tiny: bool = False):
+    """ZeRO-1 sharded-optimizer microbench: the optimizer UPDATE phase
+    (gradient reduction + AdamW + new params on every chip) at the
+    BERT-Large parameter shape, replicated vs sharded.
+
+    Replicated: ``allreduce_gradients`` + jitted f32 optax adamw —
+    every chip holds the full mu/nu. Sharded: ``hvd.sharded_adamw`` —
+    reduce-scatter, fused flat-buffer AdamW on the local fp32
+    master/moment shards, allgather. Reports p50 update ms for both,
+    optimizer-state bytes/chip for both (sharded ≈ replicated/N), and
+    the steady-state program-build count over the timed phase (must be
+    zero — same invariant as the data-plane microbench).
+
+    ``tiny`` (--tiny / the tier-1 smoke test): a toy shape + 2 steps."""
+    import optax as _optax
+
+    from horovod_tpu.parallel.dp import allreduce_gradients
+
+    hvd.init()
+    world = hvd.size()
+    if tiny:
+        shapes = {"w0": (256, 64), "b0": (64,), "w1": (1000,),
+                  "emb": (128, 32)}
+        warmup_steps, timed_steps = 1, 2
+    else:
+        shapes = _bert_large_param_shapes()
+        warmup_steps, timed_steps = 2, 8
+    rng = np.random.RandomState(0)
+    params = {k: jnp.asarray(rng.standard_normal(v).astype(np.float32)
+                             * 0.02)
+              for k, v in shapes.items()}
+    grads = {k: jnp.asarray(rng.standard_normal(v).astype(np.float32))
+             for k, v in shapes.items()}
+    n_params = sum(int(np.prod(v)) for v in shapes.values())
+    log(f"sharded-optimizer bench: {n_params / 1e6:.0f}M params, "
+        f"np={world}{' (tiny)' if tiny else ''}")
+
+    def _tree_bytes(tree):
+        return sum(x.nbytes for x in jax.tree_util.tree_leaves(tree)
+                   if hasattr(x, "nbytes"))
+
+    def _metric_value(name, default=None):
+        m = hvd.metrics().get(name)
+        if not m or not m.get("values"):
+            return default
+        return m["values"][0]["value"]
+
+    # --- replicated baseline: allreduce + full-state adamw on every chip
+    inner = _optax.adamw(1e-4)
+    rep_state = inner.init(params)
+    rep_bytes = _tree_bytes(rep_state)
+
+    @jax.jit
+    def rep_step(g, s, p):
+        upd, s = inner.update(g, s, p)
+        return _optax.apply_updates(p, upd), s
+
+    def replicated_update(p, s, g):
+        g = allreduce_gradients(g, average=True)
+        return rep_step(g, s, p)
+
+    lat_rep = []
+    p, s = params, rep_state
+    for step in range(warmup_steps + timed_steps):
+        t0 = time.perf_counter()
+        p, s = replicated_update(p, s, grads)
+        jax.block_until_ready(jax.tree_util.tree_leaves(p)[0])
+        if step >= warmup_steps:
+            lat_rep.append(time.perf_counter() - t0)
+
+    # --- sharded: RS + fused flat AdamW on the local shard + AG
+    sopt = hvd.sharded_adamw(1e-4)
+    sh_state = sopt.init(params)
+    lat_sh = []
+    builds_before = None
+    p = params
+    for step in range(warmup_steps + timed_steps):
+        if step == warmup_steps:
+            builds_before = _metric_value(
+                "horovod_sharded_program_builds_total", 0)
+        t0 = time.perf_counter()
+        p, sh_state = sopt.apply(p, sh_state, grads)
+        jax.block_until_ready(jax.tree_util.tree_leaves(p)[0])
+        if step >= warmup_steps:
+            lat_sh.append(time.perf_counter() - t0)
+    steady_builds = (_metric_value("horovod_sharded_program_builds_total",
+                                   0) - builds_before)
+    sharded_bytes = _metric_value("horovod_sharded_state_bytes",
+                                  _tree_bytes(sh_state))
+
+    p50_rep = float(np.median(lat_rep))
+    p50_sh = float(np.median(lat_sh))
+    result = {
+        "metric": f"sharded optimizer update p50 (ZeRO-1 fused AdamW, "
+                  f"BERT-Large shape {n_params / 1e6:.0f}M params, "
+                  f"np={world})",
+        "value": round(p50_sh * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(p50_rep / p50_sh, 3) if p50_sh > 0 else None,
+        "replicated_p50_ms": round(p50_rep * 1e3, 2),
+        "sharded_p50_ms": round(p50_sh * 1e3, 2),
+        "opt_state_bytes_per_chip": {
+            "replicated": int(rep_bytes),
+            "sharded": int(sharded_bytes),
+        },
+        "state_bytes_reduction_x": (
+            round(rep_bytes / sharded_bytes, 2) if sharded_bytes else None),
+        "steady_state_program_builds": int(steady_builds),
+    }
+    if tiny:
+        result["tiny"] = True
+    log(f"update p50: replicated {result['replicated_p50_ms']} ms, "
+        f"sharded {result['sharded_p50_ms']} ms; state bytes/chip "
+        f"{rep_bytes} -> {sharded_bytes} "
+        f"({result['state_bytes_reduction_x']}x); steady-state program "
+        f"builds {steady_builds}")
     print(json.dumps(result), flush=True)
     return result
 
@@ -667,9 +825,25 @@ if __name__ == "__main__":
                         help="microbench the data plane: steady-state "
                              "fused allreduce latency vs payload size + "
                              "XLA compile count (one JSON line)")
+    parser.add_argument("--sharded-optimizer", action="store_true",
+                        help="microbench the ZeRO-1 sharded optimizer "
+                             "update phase (replicated vs sharded AdamW "
+                             "at the BERT-Large shape; one JSON line)")
+    parser.add_argument("--tiny", action="store_true",
+                        help="toy sizes + a couple of steps for "
+                             "--collectives/--sharded-optimizer — the "
+                             "tier-1 smoke-test mode; numbers are "
+                             "meaningless")
+    parser.add_argument("--budget-seconds", type=float, default=None,
+                        help="wall-clock budget for the no-flag sweep; "
+                             "bonus workloads are trimmed or skipped "
+                             "(loudly) once it would be exceeded "
+                             "(default: BENCH_TIME_BUDGET env, 660)")
     cli = parser.parse_args()
     if cli.collectives:
-        collectives_main()
+        collectives_main(tiny=cli.tiny)
+    elif cli.sharded_optimizer:
+        sharded_optimizer_main(tiny=cli.tiny)
     elif cli.control_plane:
         control_plane_main()
     elif cli.model is not None and not cli.all:
@@ -712,7 +886,8 @@ if __name__ == "__main__":
         # workload runs only if its rough cost still fits (skips are
         # LOUD — a silent cap would read as "covered everything").
         t_start = time.perf_counter()
-        budget = float(os.environ.get("BENCH_TIME_BUDGET", "660"))
+        budget = (cli.budget_seconds if cli.budget_seconds is not None
+                  else float(os.environ.get("BENCH_TIME_BUDGET", "660")))
         sweep = [
             # (fn, arg, core?, rough cold-cache cost s, micro-step cap)
             # caps keep rounds in the 10-20 s fidelity band (long enough
@@ -725,27 +900,48 @@ if __name__ == "__main__":
             (transformer_main, "gpt2", True, 90, 128),
             (main, "inception", False, 85, None),
             (main, "vgg", False, 95, None),
+            (sharded_optimizer_main, "sharded-optimizer", False, 60,
+             None),
             (control_plane_main, None, False, 150, None),
         ]
         for fn, arg, core, est, cap in sweep:
             elapsed = time.perf_counter() - t_start
+            trimmed = False
             if not core and elapsed + est > budget:
-                log(f"SKIPPED {arg or 'control-plane'}: {elapsed:.0f}s "
-                    f"elapsed + ~{est}s would exceed the "
-                    f"{budget:.0f}s budget (BENCH_TIME_BUDGET); run "
-                    f"`python bench.py --model {arg}` for this row"
-                    if arg else
-                    f"SKIPPED control-plane: over budget; run "
-                    f"`python bench.py --control-plane`")
-                continue
+                if fn is control_plane_main:
+                    # never silently drop the control-plane rows: a
+                    # trimmed np=2 fast probe (~40 s) still measures the
+                    # protocol's byte/step counters
+                    trimmed = True
+                    log(f"TRIMMED control-plane: {elapsed:.0f}s elapsed "
+                        f"+ ~{est}s would exceed the {budget:.0f}s "
+                        f"budget (--budget-seconds/BENCH_TIME_BUDGET); "
+                        f"running the np=2 fast probe instead — run "
+                        f"`python bench.py --control-plane` for the "
+                        f"full protocol")
+                elif fn is sharded_optimizer_main:
+                    trimmed = True
+                    log(f"TRIMMED sharded-optimizer: over the "
+                        f"{budget:.0f}s budget; running --tiny probe — "
+                        f"run `python bench.py --sharded-optimizer` "
+                        f"for the real row")
+                else:
+                    log(f"SKIPPED {arg}: {elapsed:.0f}s elapsed + "
+                        f"~{est}s would exceed the {budget:.0f}s budget "
+                        f"(--budget-seconds/BENCH_TIME_BUDGET); run "
+                        f"`python bench.py --model {arg}` for this row")
+                    continue
             try:
                 if fn is transformer_main:
                     results.append(fn(arg, allow_env=False,
                                       micro_step_cap=cap))
-                elif arg is not None:
-                    results.append(fn(arg, allow_env=False))
+                elif fn is sharded_optimizer_main:
+                    results.append(fn(tiny=trimmed))
+                elif fn is control_plane_main:
+                    results.extend(control_plane_main(
+                        fast=True, np_override=2 if trimmed else None))
                 else:
-                    results.extend(control_plane_main(fast=True))
+                    results.append(fn(arg, allow_env=False))
             except Exception:
                 traceback.print_exc(file=sys.stderr)
             if results:

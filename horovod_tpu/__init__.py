@@ -22,6 +22,12 @@ Canonical usage (mirrors reference: examples/*.py):
 
 from horovod_tpu.version import __version__
 
+# JAX API-drift shims (jax.shard_map spelling, lax.axis_size) — must be
+# in place before any data-plane module is imported.
+from horovod_tpu.utils import compat as _compat
+
+_compat.install()
+
 # Load the metrics submodule BEFORE binding the hvd.metrics() API below:
 # the first import of a submodule sets it as a package attribute, which
 # would clobber the function whenever internal code lazily imported the
@@ -84,6 +90,12 @@ from horovod_tpu.parallel.dp import (
     broadcast_optimizer_state,
     broadcast_object,
 )
+from horovod_tpu.parallel.zero import (
+    FlatAdamState,
+    ShardedOptState,
+    sharded_adamw,
+    sharded_update,
+)
 from horovod_tpu.parallel.sparse import (
     SparseGrad,
     sparse_allgather,
@@ -141,6 +153,8 @@ __all__ = [
     "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "Compression",
+    # ZeRO-1 sharded optimizer states (TPU-first extension)
+    "sharded_update", "sharded_adamw", "ShardedOptState", "FlatAdamState",
     # sparse/embedding gradients
     "SparseGrad", "sparse_allgather", "with_sparse_embedding_grad",
     # long-context / sequence parallelism (TPU-first extensions)

@@ -248,14 +248,33 @@ class ArrayState(State):
 
     def sync(self, root_rank: int = 0) -> None:
         """Re-broadcast from ``root_rank`` (after a re-form: the lowest
-        surviving rank, renumbered 0 — see runner._reform)."""
+        surviving rank, renumbered 0 — see runner._reform).
+
+        ZeRO-sharded optimizer leaves (``zero.is_sharded_state``) are NOT
+        broadcast — rank 0's shard would clobber every other rank's
+        distinct shard; they re-shard collectively via ``zero.resync``
+        against the just-broadcast params (``_tree_names`` orders params
+        first, so the fp32-master refill sees synced values)."""
+        import jax
+
         from horovod_tpu.ops import collectives
-        from horovod_tpu.parallel import dp
+        from horovod_tpu.parallel import dp, zero
 
         st = basics._ensure_init()
         for name in self._tree_names:
             tree = getattr(self, name)
-            if tree is not None:
+            if tree is None:
+                continue
+            flat, treedef = jax.tree_util.tree_flatten(
+                tree, is_leaf=zero.is_sharded_state)
+            if any(zero.is_sharded_state(x) for x in flat):
+                flat = [zero.resync(x, self.params, root_rank)
+                        if zero.is_sharded_state(x)
+                        else dp.broadcast_parameters(x, root_rank=root_rank)
+                        for x in flat]
+                setattr(self, name,
+                        jax.tree_util.tree_unflatten(treedef, flat))
+            else:
                 setattr(self, name,
                         dp.broadcast_parameters(tree, root_rank=root_rank))
         if st.size > 1:

@@ -31,14 +31,18 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from horovod_tpu.utils import compat
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from horovod_tpu import flight_recorder
 from horovod_tpu.compression import Compression
 from horovod_tpu.core import basics, mesh as mesh_mod, state as state_mod
 
@@ -681,6 +685,23 @@ def grouped_allreduce(
     return out
 
 
+def _op_event(op: str, st, x, fn):
+    """Bracket an eager single-controller collective dispatch with
+    flight-recorder ``op_dispatch``/``op_complete`` events (shard index +
+    bytes), mirroring the executor's events on the multi-process path —
+    postmortems attribute a stalled sharded step to the right phase."""
+    nbytes = int(np.prod(np.shape(x), dtype=np.int64)
+                 * np.dtype(x.dtype).itemsize)
+    flight_recorder.emit("op_dispatch", op=op, shard=int(st.rank),
+                         bytes=nbytes)
+    t0 = time.monotonic()
+    out = fn()
+    flight_recorder.emit("op_complete", op=op, shard=int(st.rank),
+                         bytes=nbytes,
+                         seconds=round(time.monotonic() - t0, 6))
+    return out
+
+
 def allgather(tensor, name: Optional[str] = None, axis_name=None):
     """Concatenate each worker's tensor along axis 0; all workers get the
     concatenation.
@@ -725,8 +746,11 @@ def allgather(tensor, name: Optional[str] = None, axis_name=None):
             )
         if (st.config.hierarchical_allgather
                 and _hierarchical_enabled(st)):
-            return _hierarchical_gather_stacked_fn(st.mesh)(x)
-        return _gather_stacked_fn(st.mesh)(x)
+            return _op_event(
+                "allgather", st, x,
+                lambda: _hierarchical_gather_stacked_fn(st.mesh)(x))
+        return _op_event("allgather", st, x,
+                         lambda: _gather_stacked_fn(st.mesh)(x))
     if x.ndim < 1:
         raise ValueError("allgather requires tensors of rank >= 1")
     if _multiprocess_world(st) and not _is_globally_replicated(x, st):
@@ -802,12 +826,12 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[int] = No
             if red_op == Average:
                 # divide by the size of the axes actually reduced, not
                 # the global world size (they differ for axis_name='local')
-                out = out / lax.axis_size(axes)
+                out = out / compat.axis_size(axes)
             return out
         # XLA's reduce-scatter primitive is sum-only; min/max/product
         # decompose into all_to_all + local reduce — same bytes on the
         # wire as a reduce-scatter (each device sends shard j to owner j)
-        world = lax.axis_size(axes)
+        world = compat.axis_size(axes)
         if tensor.shape[0] % world != 0:
             raise ValueError(
                 f"reducescatter dim 0 ({tensor.shape[0]}) must divide "
@@ -841,7 +865,9 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[int] = No
             f"reducescatter dim 1 ({x.shape[1]}) must divide evenly by "
             f"size ({st.size})"
         )
-    return _reducescatter_stacked_fn(st.mesh, red_op, st.size)(x)
+    return _op_event(
+        "reducescatter", st, x,
+        lambda: _reducescatter_stacked_fn(st.mesh, red_op, st.size)(x))
 
 
 def alltoall(tensor, name: Optional[str] = None, axis_name=None):

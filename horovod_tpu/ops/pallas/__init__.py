@@ -7,6 +7,7 @@ from horovod_tpu.ops.pallas.flash_attention import (
     merge_partials,
 )
 from horovod_tpu.ops.pallas.fused_adamw import FusedAdamW, fused_adamw
+from horovod_tpu.ops.pallas.fused_optimizer import flat_adamw_shard
 
 __all__ = [
     "flash_attention",
@@ -15,4 +16,5 @@ __all__ = [
     "attention_reference",
     "fused_adamw",
     "FusedAdamW",
+    "flat_adamw_shard",
 ]

@@ -35,6 +35,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from horovod_tpu.utils import compat
 from horovod_tpu.utils import env as env_mod
 
 NEG_INF = float("-inf")
@@ -351,8 +352,8 @@ def _flash_fwd(q, k, v, q_offset, k_offset, *, sm_scale, causal,
             in_specs=[_OFF_SPEC, _OFF_SPEC, sq_spec, sk_spec, sk_spec],
             out_specs=[sq_spec, srow_spec],
             out_shape=[
-                jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
-                jax.ShapeDtypeStruct((batch, heads, q_seq, LANES),
+                compat.sds(q.shape, q.dtype, vma=vma),
+                compat.sds((batch, heads, q_seq, LANES),
                                      jnp.float32, vma=vma),
             ],
             compiler_params=pltpu.CompilerParams(
@@ -371,8 +372,8 @@ def _flash_fwd(q, k, v, q_offset, k_offset, *, sm_scale, causal,
         in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, k_spec, k_spec],
         out_specs=[q_spec, qrow_spec],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((batch, heads, q_seq, LANES), jnp.float32,
+            compat.sds(q.shape, q.dtype, vma=vma),
+            compat.sds((batch, heads, q_seq, LANES), jnp.float32,
                                  vma=vma),
         ],
         scratch_shapes=[
@@ -756,9 +757,9 @@ def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
                       bh_k_spec, bh_q_spec, bh_row_spec, bh_row_spec],
             out_specs=[bh_q_spec, bh_k_spec, bh_k_spec],
             out_shape=[
-                jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
-                jax.ShapeDtypeStruct(k.shape, k.dtype, vma=vma),
-                jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma),
+                compat.sds(q.shape, q.dtype, vma=vma),
+                compat.sds(k.shape, k.dtype, vma=vma),
+                compat.sds(v.shape, v.dtype, vma=vma),
             ],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel")),
@@ -778,7 +779,7 @@ def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
             in_specs=[_OFF_SPEC, _OFF_SPEC, sq_spec, sk_spec, sk_spec,
                       sq_spec, srow_spec, srow_spec],
             out_specs=sq_spec,
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+            out_shape=compat.sds(q.shape, q.dtype, vma=vma),
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel",) * 3),
             interpret=interpret,
@@ -799,8 +800,8 @@ def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
                       gq_spec, grow_spec, grow_spec],
             out_specs=[gk_spec, gk_spec],
             out_shape=[
-                jax.ShapeDtypeStruct(k.shape, k.dtype, vma=vma),
-                jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma),
+                compat.sds(k.shape, k.dtype, vma=vma),
+                compat.sds(v.shape, v.dtype, vma=vma),
             ],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel",) * 3),
@@ -819,7 +820,7 @@ def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
             in_specs=[_OFF_SPEC, _OFF_SPEC, q_spec, k_spec, k_spec,
                       q_spec, qrow_spec, qrow_spec],
             out_specs=q_spec,
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+            out_shape=compat.sds(q.shape, q.dtype, vma=vma),
             scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
             compiler_params=_compiler_params(4),
             interpret=interpret,
@@ -845,8 +846,8 @@ def _flash_bwd(q, k, v, o, lse, do, q_offset, k_offset, *, sm_scale, causal,
                       kq_k_spec, kq_q_spec, kq_qrow_spec, kq_qrow_spec],
             out_specs=[kq_k_spec, kq_k_spec],
             out_shape=[
-                jax.ShapeDtypeStruct(k.shape, k.dtype, vma=vma),
-                jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma),
+                compat.sds(k.shape, k.dtype, vma=vma),
+                compat.sds(v.shape, v.dtype, vma=vma),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block_k, dim), jnp.float32),

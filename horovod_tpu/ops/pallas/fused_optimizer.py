@@ -1,0 +1,123 @@
+"""Fused flat-buffer optimizer passes for ZeRO-1 shards.
+
+Companion to :mod:`horovod_tpu.ops.pallas.fused_adamw`, reshaped for the
+sharded data plane (:mod:`horovod_tpu.parallel.zero`): instead of one
+kernel per parameter leaf, ONE kernel runs over the whole flat fp32
+master/moment shard of a dtype group. That removes the per-leaf launch
+overhead that sank the per-leaf fused AdamW (docs/perf_experiments.md
+round 4 — ~400 sequential pallas_calls forfeit XLA's cross-leaf
+scheduling): a BERT-Large f32 group is a single ~83M-element buffer, a
+single grid. The minimum HBM traffic per element is read master, mu, nu
+(f32) + grad and write all four again — and only 1/N of it happens on
+each chip.
+
+The kernel keeps fp32 master weights: ``mw`` carries the authoritative
+parameters; the emitted ``p_out`` is the master cast to the parameter
+dtype (bf16 master-weight training). Math matches optax.adamw
+(bias-corrected moments, decoupled weight decay folded into the lr
+step), so the jnp fallback and the kernel agree with the replicated
+optax chain at fp32.
+
+``HOROVOD_SHARDED_FUSED_KERNEL`` gates the Pallas path (default: on
+when the backend is TPU, off elsewhere); the jnp fallback is always
+available and is also used for shapes Pallas can't tile well (tiny
+shards, non-multiple-of-128 lengths, stacked 2-D single-controller
+layouts where the buffer is sharded across devices).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from horovod_tpu.ops.pallas.fused_adamw import _use_interpret
+from horovod_tpu.utils import env as env_mod
+
+# Same tiling policy as fused_adamw: skip Pallas below this (launch not
+# worth it), and grid-step this many elements (256 KB f32 blocks).
+_MIN_PALLAS = 16 * 1024
+_BLOCK = env_mod._get_int("FUSED_OPTIMIZER_BLOCK", 64 * 1024)
+
+
+def _use_kernel() -> bool:
+    default = jax.devices()[0].platform == "tpu"
+    return env_mod._get_bool(env_mod.HOROVOD_SHARDED_FUSED_KERNEL,
+                             default)
+
+
+def _flat_adamw_kernel(sc_ref, mw_ref, m_ref, v_ref, g_ref,
+                       p_out, mw_out, m_out, v_out, *, eps):
+    g = g_ref[...].astype(jnp.float32)
+    m = m_ref[...]
+    v = v_ref[...]
+    w = mw_ref[...]
+    # scalars in SMEM: b1, b2, 1/(1-b1^t), 1/(1-b2^t), lr, wd
+    b1 = sc_ref[0]
+    b2 = sc_ref[1]
+    inv_bc1 = sc_ref[2]
+    inv_bc2 = sc_ref[3]
+    lr = sc_ref[4]
+    wd = sc_ref[5]
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * g * g
+    w = w - lr * ((m * inv_bc1) / (jnp.sqrt(v * inv_bc2) + eps) + wd * w)
+    p_out[...] = w.astype(p_out.dtype)
+    mw_out[...] = w
+    m_out[...] = m
+    v_out[...] = v
+
+
+def _jnp_flat(master, mu, nu, grad, scalars, eps, out_dtype):
+    b1, b2, inv_bc1, inv_bc2, lr, wd = (scalars[i] for i in range(6))
+    gf = grad.astype(jnp.float32)
+    m2 = b1 * mu + (1.0 - b1) * gf
+    v2 = b2 * nu + (1.0 - b2) * gf * gf
+    w2 = master - lr * ((m2 * inv_bc1)
+                        / (jnp.sqrt(v2 * inv_bc2) + eps) + wd * master)
+    return w2.astype(out_dtype), w2, m2, v2
+
+
+def flat_adamw_shard(master, mu, nu, grad, scalars, *, eps, out_dtype):
+    """One fused AdamW pass over a flat fp32 master shard.
+
+    ``master``/``mu``/``nu`` are f32 buffers, ``grad`` the reduced
+    gradient shard (any float dtype), ``scalars`` the 6-vector
+    [b1, b2, 1/(1-b1^t), 1/(1-b2^t), lr, wd]. Returns
+    ``(params_shard[out_dtype], master', mu', nu')``.
+    """
+    out_dtype = jnp.dtype(out_dtype)
+    if isinstance(master, jax.core.Tracer) or master.ndim != 1:
+        # traced under shard_map (Pallas-per-device would need careful
+        # vmem accounting inside the spmd body) or a stacked 2-D
+        # single-controller buffer sharded across devices: the XLA
+        # elementwise chain is the right program
+        return _jnp_flat(master, mu, nu, grad, scalars, eps, out_dtype)
+    n = int(master.shape[0])
+    if not _use_kernel() or n < _MIN_PALLAS or n % 128:
+        return _jnp_flat(master, mu, nu, grad, scalars, eps, out_dtype)
+    rows = n // 128
+    block_rows = min(rows, _BLOCK // 128)
+    while rows % block_rows:
+        block_rows -= 1
+    if block_rows < 8:
+        return _jnp_flat(master, mu, nu, grad, scalars, eps, out_dtype)
+    flat = lambda a: a.reshape((rows, 128))
+    spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
+    p2, w2, m2, v2 = pl.pallas_call(
+        functools.partial(_flat_adamw_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  spec, spec, spec, spec],
+        out_specs=[spec, spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((rows, 128), out_dtype),
+                   jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, 128), jnp.float32)],
+        interpret=_use_interpret(),
+    )(scalars, flat(master), flat(mu), flat(nu), flat(grad))
+    return (p2.reshape(n), w2.reshape(n), m2.reshape(n), v2.reshape(n))

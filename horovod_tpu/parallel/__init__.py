@@ -11,6 +11,12 @@ from horovod_tpu.parallel.dp import (
 )
 from horovod_tpu.parallel.ring import ring_attention
 from horovod_tpu.parallel.ulysses import ulysses_attention
+from horovod_tpu.parallel.zero import (
+    FlatAdamState,
+    ShardedOptState,
+    sharded_adamw,
+    sharded_update,
+)
 
 __all__ = [
     "DistributedOptimizer",
@@ -21,4 +27,8 @@ __all__ = [
     "broadcast_object",
     "ring_attention",
     "ulysses_attention",
+    "sharded_update",
+    "sharded_adamw",
+    "ShardedOptState",
+    "FlatAdamState",
 ]

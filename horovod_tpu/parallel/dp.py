@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from horovod_tpu.utils import compat
+
 from horovod_tpu.compression import Compression
 from horovod_tpu.core import basics, mesh as mesh_mod
 from horovod_tpu.ops import collectives
@@ -45,7 +47,7 @@ def _bound_axes(axis_name=None) -> tuple:
     bound = []
     for a in axes:
         try:
-            lax.axis_size(a)
+            compat.axis_size(a)
         except NameError:
             continue
         bound.append(a)
@@ -90,12 +92,43 @@ def allreduce_gradients(grads, *, average: bool = True,
     processing (reference: horovod/tensorflow/__init__.py:323-376).
     ``SparseGrad`` leaves ride the allgather path (or are densified first
     when ``sparse_as_dense``); either way the result is dense.
+
+    Eager dense leaves are exchanged through
+    :func:`collectives.grouped_allreduce`, so a whole pytree is one
+    fused submission per dtype group instead of one collective per leaf
+    (reference: the fusion-buffer batching the per-leaf reference path
+    gets from its background coordinator, horovod/common/operations.cc).
+    Tracer leaves keep the in-jit ``lax.pmean``/``psum`` path unchanged.
     """
-    return jax.tree_util.tree_map(
-        lambda g: _allreduce_leaf(g, average, compression, axis_name,
-                                  sparse_as_dense),
-        grads, is_leaf=sparse_mod.is_sparse,
-    )
+    leaves, treedef = jax.tree_util.tree_flatten(
+        grads, is_leaf=sparse_mod.is_sparse)
+    out = list(leaves)
+    dense_eager = []
+    for i, g in enumerate(leaves):
+        if g is None:
+            continue
+        if sparse_mod.is_sparse(g):
+            if sparse_as_dense:
+                g = sparse_mod.densify_leaf(g)
+            else:
+                out[i] = sparse_mod.exchange_sparse_grad(
+                    g, average=average, compression=compression,
+                    axis_name=axis_name,
+                    bound_axes=_bound_axes(axis_name))
+                continue
+        if isinstance(g, jax.core.Tracer):
+            out[i] = _allreduce_leaf(g, average, compression, axis_name,
+                                     False)
+            continue
+        out[i] = g
+        dense_eager.append(i)
+    if dense_eager:
+        reduced = collectives.grouped_allreduce(
+            [out[i] for i in dense_eager], average=average,
+            compression=compression, axis_name=axis_name)
+        for i, r in zip(dense_eager, reduced):
+            out[i] = r
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def DistributedOptimizer(
@@ -106,6 +139,7 @@ def DistributedOptimizer(
     backward_passes_per_step: int = 1,
     axis_name=None,
     sparse_as_dense: bool = False,
+    shard_optimizer_states: bool = False,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so gradients are allreduced across workers
     before each update.
@@ -121,9 +155,28 @@ def DistributedOptimizer(
     ``sparse_as_dense`` densifies ``SparseGrad`` leaves before the
     exchange instead of allgathering them (reference:
     tensorflow/__init__.py:200-203).
+
+    ``shard_optimizer_states=True`` switches to the ZeRO-1 data plane
+    (:mod:`horovod_tpu.parallel.zero`): the allreduce decomposes into
+    reduce-scatter + update-on-shard + allgather, so the inner
+    optimizer's state lives 1/N per chip. Same wire bytes, bit-identical
+    updates for elementwise inner transforms. Requires
+    ``backward_passes_per_step == 1`` (MultiSteps' internal ``lax.cond``
+    would trace the eager sharded data plane).
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    if shard_optimizer_states:
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "shard_optimizer_states does not compose with "
+                "backward_passes_per_step > 1: accumulate in the training "
+                "loop instead")
+        from horovod_tpu.parallel import zero
+
+        return zero.sharded_update(
+            optimizer, average=average, compression=compression,
+            axis_name=axis_name, sparse_as_dense=sparse_as_dense)
 
     def init_fn(params):
         return optimizer.init(params)

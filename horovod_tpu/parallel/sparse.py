@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.utils import compat
+
 from horovod_tpu.core import mesh as mesh_mod
 
 
@@ -148,7 +150,7 @@ def exchange_sparse_grad(sg: SparseGrad, *, average: bool,
         if bound_axes:
             world = 1
             for a in bound_axes:
-                world *= lax.axis_size(a)
+                world *= compat.axis_size(a)
             c_values, ctx = compression.compress(sg.values)
             gathered = sparse_allgather(
                 SparseGrad(sg.indices, c_values, sg.num_rows),

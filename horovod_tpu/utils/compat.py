@@ -1,0 +1,102 @@
+"""JAX version-compatibility shims.
+
+The data plane targets a range of JAX releases; helpers here paper over
+API drift so the rest of the codebase stays on one spelling.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+
+def install() -> None:
+    """Install attribute shims for renamed/moved JAX APIs (idempotent).
+
+    Called once at package import. ``jax.shard_map`` graduated from
+    ``jax.experimental.shard_map``; on releases that only ship the
+    experimental spelling, alias it so the one modern spelling works
+    everywhere (library and tests alike).
+    """
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        import functools
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, *args, **kwargs):
+            # the experimental spelling calls the replication check
+            # ``check_rep``; the graduated API renamed it ``check_vma``
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(f, *args, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax, "typeof"):
+        # jax.typeof(x) is the modern spelling of the abstract value;
+        # callers here only probe optional attrs (e.g. ``vma``) on it
+        jax.typeof = jax.core.get_aval
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams"):
+            # renamed from TPUCompilerParams when pallas graduated it
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except Exception:  # pallas TPU backend unavailable on this build
+        pass
+
+
+def axis_size(axis_name):
+    """Size of a bound mesh axis (or tuple of axes) inside a trace.
+
+    ``lax.axis_size`` where the installed JAX has it; otherwise a psum
+    of the literal 1 over the axis — evaluated statically by tracing to
+    the axis size, with the same contract (``NameError`` when the axis
+    is not bound in the current trace).
+    """
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` as device-varying over ``axis_names`` (tuple of axes).
+
+    Newer JAX spells this ``lax.pcast(..., to="varying")`` (successor of
+    ``lax.pvary``). Releases predating the varying/replicated type system
+    have neither and need no cast — identity there.
+    """
+    fn = getattr(lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axis_names, to="varying")
+    fn = getattr(lax, "pvary", None)
+    if fn is not None:
+        return fn(x, axis_names)
+    return x
+
+
+_SDS_HAS_VMA = None
+
+
+def sds(shape, dtype, *, vma=None):
+    """``jax.ShapeDtypeStruct`` that forwards ``vma`` where supported.
+
+    Releases predating the varying/replicated type system reject the
+    kwarg; there the annotation is meaningless and is dropped.
+    """
+    import jax
+
+    global _SDS_HAS_VMA
+    if vma is None or _SDS_HAS_VMA is False:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        out = jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        _SDS_HAS_VMA = True
+        return out
+    except TypeError:
+        _SDS_HAS_VMA = False
+        return jax.ShapeDtypeStruct(shape, dtype)

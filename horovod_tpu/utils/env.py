@@ -50,6 +50,7 @@ HOROVOD_FUSION_BUCKET_QUANTUM = "HOROVOD_FUSION_BUCKET_QUANTUM"
 HOROVOD_FLIGHT_RECORDER = "HOROVOD_FLIGHT_RECORDER"
 HOROVOD_FLIGHT_RECORDER_DIR = "HOROVOD_FLIGHT_RECORDER_DIR"
 HOROVOD_STRAGGLER_REPORT_SECONDS = "HOROVOD_STRAGGLER_REPORT_SECONDS"
+HOROVOD_SHARDED_FUSED_KERNEL = "HOROVOD_SHARDED_FUSED_KERNEL"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference: operations.cc:386

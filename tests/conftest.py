@@ -36,6 +36,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); big worlds "
+        "and soaks that need a multi-core box")
+
+
 @pytest.fixture
 def hvd():
     """Initialized framework on a 2x4 (cross x local) mesh, torn down after

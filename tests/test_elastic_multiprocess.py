@@ -20,6 +20,8 @@ from horovod_tpu.runtime.native import native_built
 
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "elastic_worker.py")
+ZERO_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "zero_elastic_worker.py")
 
 pytestmark = pytest.mark.skipif(
     not native_built(), reason="native transport not built")
@@ -31,7 +33,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_elastic(world: int, extra_env=None, timeout=240):
+def _launch_elastic(world: int, extra_env=None, timeout=240,
+                    worker=WORKER):
     rendezvous = RendezvousServer(host="127.0.0.1")
     http_port = rendezvous.start()
     socket_port = _free_port()
@@ -56,7 +59,7 @@ def _launch_elastic(world: int, extra_env=None, timeout=240):
             })
             env.update(extra_env or {})
             procs.append(subprocess.Popen(
-                [sys.executable, WORKER],
+                [sys.executable, worker],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True))
         outs = []
@@ -89,6 +92,30 @@ def test_kill_rank1_at_step3_survivors_finish():
         assert "w=8" in outs[i], (i, outs[i])
         assert "size=2" in outs[i], (i, outs[i])
         # metrics satellite: the restart was counted
+        restarts = float(outs[i].split(
+            "elastic_restarts_total=")[1].split()[0])
+        assert restarts >= 1, (i, outs[i])
+
+
+def test_zero_sharded_state_survives_reform():
+    """ZeRO-1 acceptance (ISSUE.md PR 5): the SHARDED optimizer state
+    must survive rank 1 dying at step 3 — ``ArrayState.sync`` resyncs
+    sharded leaves collectively (zero.resync) instead of broadcasting
+    rank 0's shard, the state re-shards to the 2-worker layout, and the
+    training invariant (w == step, every element) holds through the
+    rollback."""
+    procs, outs = _launch_elastic(
+        3, extra_env={
+            "HOROVOD_FAULT_INJECT": "kill:rank=1:step=3:code=17",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        }, worker=ZERO_WORKER)
+    assert procs[1].returncode == 17, outs[1]
+    for i in (0, 2):
+        assert procs[i].returncode == 0, (i, outs[i])
+        assert "step=8" in outs[i], (i, outs[i])
+        assert "w=8" in outs[i], (i, outs[i])
+        assert "size=2" in outs[i], (i, outs[i])
+        assert "shard_world=2" in outs[i], (i, outs[i])
         restarts = float(outs[i].split(
             "elastic_restarts_total=")[1].split()[0])
         assert restarts >= 1, (i, outs[i])

@@ -250,6 +250,21 @@ def test_soak_combined_stress():
         assert "soak:" in out
 
 
+@pytest.mark.parametrize("world", [1, 2, 4,
+                                   pytest.param(8, marks=pytest.mark.slow)])
+def test_zero_sharded_optimizer_parity(world):
+    """ZeRO-1 sharded optimizer over the real wire at 1/2/4/8 ranks:
+    reduce-scatter + shard update + allgather must reproduce the
+    replicated update bit-exactly for SGD (integer-valued f32 grads,
+    power-of-two worlds => exact ring math) and to f32 round-off for
+    the fused flat AdamW. 8 ranks is slow-marked: one-core CI boxes
+    serialize 8 jax processes (see test_soak_combined_stress)."""
+    procs, outs = _launch("zero_parity", world, timeout=240)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK rank=" in out
+
+
 @pytest.mark.parametrize("world", [2, 3])
 def test_unnamed_eager_collectives_communicate(world):
     """Plain hvd.allreduce/allgather/broadcast (no name) in a

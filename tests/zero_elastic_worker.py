@@ -1,0 +1,87 @@
+"""Worker script for the ZeRO-1 elastic acceptance test.
+
+Launched by tests/test_elastic_multiprocess.py with world=3 and
+``HOROVOD_FAULT_INJECT=kill:rank=1:step=3``: the optimizer state is
+SHARDED (``hvd.sharded_update``), so a membership reform cannot just
+re-broadcast rank 0's copy — ``ArrayState.sync`` must route the
+sharded leaves through ``zero.resync`` (allgather surviving shards,
+rebuild the flat buffer, slice the new 2-world shard) while still
+broadcasting the params.
+
+Invariant: grads of ones with lr=-1 SGD add exactly 1.0 to every
+parameter element per step regardless of world size, so ``w == step``
+at every commit. Surviving the reform with w intact proves the sharded
+reduce-scatter/allgather data plane AND the shard-aware rollback, not
+just the re-form handshake.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "8"))
+# deliberately NOT divisible by 2 or 3: both the pre- and post-reform
+# shards are zero-padded, so the resync slicing is exercised for real
+N = 37
+
+OPT = None
+
+
+@elastic.run
+def train(state):
+    import jax.numpy as jnp
+    import optax
+
+    while state.step < TOTAL_STEPS:
+        grads = {"w": jnp.ones((N,), jnp.float32)}
+        updates, state.optimizer = OPT.update(
+            grads, state.optimizer, state.params)
+        state.params = optax.apply_updates(state.params, updates)
+        state.step += 1
+        state.commit()
+    return state
+
+
+def main() -> int:
+    global OPT
+    import jax.numpy as jnp
+    import optax
+
+    hvd.init()
+    # lr=-1: optax.sgd emits updates == +grads, apply_updates ADDS them
+    OPT = hvd.sharded_update(optax.sgd(-1.0))
+    params = {"w": jnp.zeros((N,), jnp.float32)}
+    state = elastic.ArrayState(
+        params=params, optimizer=OPT.init(params), step=0)
+    train(state)
+
+    w_arr = np.asarray(state.params["w"])
+    w = float(w_arr[0])
+    restarts = elastic.restarts()
+    from horovod_tpu.elastic.runner import _RESTARTS_TOTAL
+
+    spec = state.optimizer.spec
+    print(f"DONE rank={hvd.rank()} size={hvd.size()} step={state.step} "
+          f"w={w:g} generation={restarts} "
+          f"elastic_restarts_total={_RESTARTS_TOTAL.value:g} "
+          f"shard_world={spec.world} shard_rank={spec.rank}",
+          flush=True)
+    if state.step != TOTAL_STEPS:
+        return 3
+    # every element moved in lockstep — not just [0]
+    if not np.all(np.abs(w_arr - TOTAL_STEPS) < 1e-5):
+        return 3
+    # the re-sharded state must describe the CURRENT world, or the next
+    # update would pack against a stale layout
+    if spec.world != hvd.size() or spec.rank != hvd.rank():
+        return 4
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
