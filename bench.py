@@ -117,6 +117,36 @@ def mfu(flops_per_sec_per_chip):
     return round(flops_per_sec_per_chip / peak, 4)
 
 
+def enable_profiler(flops_per_step=None):
+    """Turn on the step profiler for the timed rounds (hvd.profiler): every
+    headline then carries a step_breakdown + comm_hidden_fraction, and the
+    FLOPs hint feeds the rolling horovod_mfu gauge. Called AFTER warmup so
+    compile time never pollutes the step history."""
+    os.environ.setdefault("HOROVOD_PROFILE", "1")
+    hvd.profiler.configure()
+    if flops_per_step is not None:
+        hvd.profiler.set_flops_per_step(flops_per_step,
+                                        peak_flops_per_chip())
+
+
+def step_profile(n_rounds):
+    """(step_breakdown, comm_hidden_fraction) over the last ``n_rounds``
+    profiled steps — this workload's timed rounds; the no-flag sweep's
+    earlier workloads share the profiler ring, so slice instead of using
+    the whole-ring summary()."""
+    steps = hvd.profiler.history()[-n_rounds:]
+    if not steps:
+        return None, None
+    n = len(steps)
+    breakdown = {k: round(sum(s["phases"][k] for s in steps) / n, 6)
+                 for k in ("host", "compute", "exposed_comm", "optimizer")}
+    total = sum(s["comm"]["total_seconds"] for s in steps)
+    exposed = sum(s["comm"]["exposed_seconds"] for s in steps)
+    hidden = (min(1.0, max(0.0, 1.0 - exposed / total))
+              if total > 0 else 0.0)
+    return breakdown, round(hidden, 4)
+
+
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
@@ -178,15 +208,19 @@ def main(model_name: str = "resnet50", allow_env: bool = True):
     log(f"warmup done in {time.perf_counter() - t0:.1f}s "
         f"(loss={float(loss):.3f})")
 
+    enable_profiler(batch_per_chip * BATCHES_PER_ROUND
+                    * train_flops_per_image)
     rates = []
     for r in range(TIMED_ROUNDS):
         t0 = time.perf_counter()
-        loss, params, stats, opt_state = round_fn(params, stats, opt_state,
-                                                  images, labels)
-        jax.block_until_ready(loss)
+        with hvd.profiler.step(f"{label} round {r}"):
+            loss, params, stats, opt_state = round_fn(
+                params, stats, opt_state, images, labels)
+            jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
         rates.append(global_batch * BATCHES_PER_ROUND / dt)
         log(f"round {r}: {rates[-1]:.1f} img/s")
+    breakdown, hidden_fraction = step_profile(TIMED_ROUNDS)
 
     # median, not mean: a single tunnel hiccup (reconnect mid-round) can
     # make one round read 20x slow — a transport artifact, not the chip
@@ -205,6 +239,8 @@ def main(model_name: str = "resnet50", allow_env: bool = True):
             round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3)
             if model_name == "resnet50" else None),
         "mfu": mfu(per_chip * train_flops_per_image),
+        "step_breakdown": breakdown,
+        "comm_hidden_fraction": hidden_fraction,
     }
     print(json.dumps(result), flush=True)
     return result
@@ -426,15 +462,19 @@ def transformer_main(family: str, allow_env: bool = True,
     log(f"warmup done in {time.perf_counter() - t0:.1f}s "
         f"(loss={float(loss):.3f})")
 
+    enable_profiler(batch * accum * seq * updates_per_round
+                    * flops_per_token)
     rates = []
     for r in range(TIMED_ROUNDS):
         t0 = time.perf_counter()
-        params, opt_state, loss = round_fn(params, opt_state, tokens,
-                                           mask, positions, labels)
-        jax.block_until_ready(loss)
+        with hvd.profiler.step(f"{label} round {r}"):
+            params, opt_state, loss = round_fn(params, opt_state, tokens,
+                                               mask, positions, labels)
+            jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
         rates.append(global_batch * accum * seq * updates_per_round / dt)
         log(f"round {r}: {rates[-1]:.0f} tokens/s")
+    breakdown, hidden_fraction = step_profile(TIMED_ROUNDS)
 
     tokens_per_sec = float(np.median(rates))  # robust to tunnel hiccups
     per_chip = tokens_per_sec / n_chips
@@ -450,6 +490,8 @@ def transformer_main(family: str, allow_env: bool = True,
         "vs_baseline": None,  # the reference publishes no absolute
         # transformer number (docs/benchmarks.rst is ResNet/VGG only)
         "mfu": mfu(per_chip * flops_per_token),
+        "step_breakdown": breakdown,
+        "comm_hidden_fraction": hidden_fraction,
     }
     print(json.dumps(result), flush=True)
     return result
@@ -804,6 +846,68 @@ def sharded_optimizer_main(tiny: bool = False):
     return result
 
 
+def tiny_main():
+    """Bare ``--tiny``: a toy flagship headline through the REAL measured
+    path — DistributedOptimizer + make_train_round + the step profiler —
+    in seconds on any backend. The tier-1 smoke for the step_breakdown /
+    comm_hidden_fraction fields; the numbers are meaningless."""
+    import flax.linen as nn
+
+    class TinyNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(10)(nn.relu(nn.Dense(32)(x)))
+
+    hvd.init()
+    n_chips = hvd.size()
+    batch_per_chip, steps_per_round, rounds = 8, 2, 3
+    global_batch = batch_per_chip * n_chips
+    model = TinyNet()
+    optimizer = hvd.DistributedOptimizer(optax.sgd(0.01 * n_chips))
+    state = training.create_train_state(model, optimizer, (1, 8, 8, 3))
+    round_fn, batch_sharding = training.make_train_round(
+        model, optimizer, steps=steps_per_round)
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        rng.uniform(-1, 1, (global_batch, 8, 8, 3)).astype(np.float32),
+        batch_sharding)
+    labels = jax.device_put(
+        rng.randint(0, 10, (global_batch,)).astype(np.int32),
+        batch_sharding)
+    params, stats, opt_state = (state.params, state.batch_stats,
+                                state.opt_state)
+    loss, params, stats, opt_state = round_fn(params, stats, opt_state,
+                                              images, labels)  # warmup
+    jax.block_until_ready(loss)
+    # ~2x3e4 MACs/image through the two dense layers; fwd+bwd ≈ 3x
+    flops_per_image = 3 * 2 * (8 * 8 * 3 * 32 + 32 * 10)
+    enable_profiler(batch_per_chip * steps_per_round * flops_per_image)
+    rates = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        with hvd.profiler.step(f"tiny round {r}"):
+            loss, params, stats, opt_state = round_fn(
+                params, stats, opt_state, images, labels)
+            jax.block_until_ready(loss)
+        rates.append(global_batch * steps_per_round
+                     / (time.perf_counter() - t0))
+    breakdown, hidden_fraction = step_profile(rounds)
+    per_chip = float(np.median(rates)) / n_chips
+    result = {
+        "metric": "images/sec/chip (tiny MLP smoke, synthetic)",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "mfu": mfu(per_chip * flops_per_image),
+        "step_breakdown": breakdown,
+        "comm_hidden_fraction": hidden_fraction,
+        "tiny": True,
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -831,8 +935,10 @@ if __name__ == "__main__":
                              "at the BERT-Large shape; one JSON line)")
     parser.add_argument("--tiny", action="store_true",
                         help="toy sizes + a couple of steps for "
-                             "--collectives/--sharded-optimizer — the "
-                             "tier-1 smoke-test mode; numbers are "
+                             "--collectives/--sharded-optimizer, or (with "
+                             "no workload flag) a toy flagship headline "
+                             "with step_breakdown/comm_hidden_fraction — "
+                             "the tier-1 smoke-test mode; numbers are "
                              "meaningless")
     parser.add_argument("--budget-seconds", type=float, default=None,
                         help="wall-clock budget for the no-flag sweep; "
@@ -851,6 +957,8 @@ if __name__ == "__main__":
             transformer_main(cli.model)
         else:
             main(cli.model)
+    elif cli.tiny:
+        tiny_main()
     else:
         # No flags (or --all) = the full perf picture in one run (VERDICT
         # r3 ask 2): the driver's artifact then carries every headline,

@@ -138,6 +138,12 @@ def init(
         flight_recorder.install_signal_handlers()
         flight_recorder.emit("init", rank=st.rank, size=st.size)
 
+        # step profiler: adopt the rank and register its flight-recorder
+        # state provider (HOROVOD_PROFILE / HOROVOD_PROFILE_DIR)
+        from horovod_tpu import profiler
+
+        profiler.configure(rank=st.rank)
+
         if st.config.timeline_file:
             from horovod_tpu.timeline import Timeline
 
@@ -191,6 +197,11 @@ def shutdown() -> None:
         from horovod_tpu.ops import collectives
 
         collectives.clear_compiled_cache()
+        # step profiler: close any implicit step, dump + ship the profile
+        # (no-op unless HOROVOD_PROFILE / HOROVOD_PROFILE_DIR enabled it)
+        from horovod_tpu import profiler
+
+        profiler.finalize()
         flight_recorder.emit("shutdown", rank=st.rank)
         # leave a final dump behind (and ship it to the launcher) so the
         # postmortem covers clean exits too — only when a destination is

@@ -291,6 +291,20 @@ class MetricsRegistry:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif route == "/profile":
+                    # step-profiler state: the last N per-step phase
+                    # breakdowns + summary (rate-limited snapshot, see
+                    # profiler.profile_state)
+                    from horovod_tpu import profiler
+
+                    body = json.dumps(
+                        profiler.profile_state(),
+                        default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_error(404)
 

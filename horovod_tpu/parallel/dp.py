@@ -182,10 +182,24 @@ def DistributedOptimizer(
         return optimizer.init(params)
 
     def update_fn(grads, opt_state, params=None, **extra):
+        # step-profiler hook (profiler.py): on the eager path each update
+        # is a step boundary, and the inner update is the optimizer phase.
+        # Inside jit/shard_map everything is a tracer — the whole step is
+        # one program and the profiler attributes it as compute.
+        from horovod_tpu import profiler as _profiler
+
+        eager = _profiler.enabled() and not any(
+            isinstance(g, jax.core.Tracer)
+            for g in jax.tree_util.tree_leaves(grads))
+        if eager:
+            _profiler.auto_step()
         reduced = allreduce_gradients(
             grads, average=average, compression=compression,
             axis_name=axis_name, sparse_as_dense=sparse_as_dense,
         )
+        if eager:
+            with _profiler.annotate("optimizer"):
+                return optimizer.update(reduced, opt_state, params, **extra)
         return optimizer.update(reduced, opt_state, params, **extra)
 
     tx = optax.GradientTransformationExtraArgs(init_fn, update_fn)

@@ -71,6 +71,12 @@ def build_worker_env(slot: SlotInfo, base_env: Dict[str, str],
     (the analogue of the reference's rendezvous server)."""
     env = dict(base_env)
     env.update(slot.to_env())
+    # per-rank file templating (the metrics dump supports the same
+    # placeholder): one launcher-side setting fans out to rank-unique
+    # paths — used by --profile-dir for timeline-rank-N.json
+    if "{rank}" in env.get("HOROVOD_TIMELINE", ""):
+        env["HOROVOD_TIMELINE"] = env["HOROVOD_TIMELINE"].format(
+            rank=slot.rank)
     env.update({
         "HOROVOD_CONTROLLER": env.get("HOROVOD_CONTROLLER", "socket"),
         "HOROVOD_CPU_OPERATIONS": env.get("HOROVOD_CPU_OPERATIONS", "socket"),
@@ -100,7 +106,8 @@ def launch_job(command: str, slots: List[SlotInfo],
                min_workers: int = 1,
                max_workers: Optional[int] = None,
                discovery_script: Optional[str] = None,
-               flight_recorder_dir: Optional[str] = None) -> int:
+               flight_recorder_dir: Optional[str] = None,
+               profile_dir: Optional[str] = None) -> int:
     """Run ``command`` on every slot; returns the job exit code (first
     non-zero worker code, else 0). Starts the rendezvous KV server for the
     job's lifetime. ``backend`` is a :class:`run.backends.LaunchBackend`
@@ -119,12 +126,32 @@ def launch_job(command: str, slots: List[SlotInfo],
     (and ship, via the rendezvous store) per-rank flight-recorder dumps;
     the launcher collects the shipped copies for workers whose local
     filesystem died with them and, when the job fails, prints a merged
-    cross-rank postmortem naming the suspected culprit rank."""
+    cross-rank postmortem naming the suspected culprit rank.
+
+    ``profile_dir`` turns on the step profiler on every worker
+    (``HOROVOD_PROFILE_DIR`` — per-rank timelines land in the same
+    directory); after the job the launcher harvests shipped profile
+    dumps, merges every rank's runtime timeline + step markers (+ any
+    jax.profiler device traces) onto one clock-corrected Chrome trace,
+    and prints the cross-rank step-time report naming the slowest phase
+    and rank."""
     from horovod_tpu.run.backends import make_backend
 
     base_env = dict(os.environ if env is None else env)
     if flight_recorder_dir:
         base_env["HOROVOD_FLIGHT_RECORDER_DIR"] = flight_recorder_dir
+    if profile_dir:
+        base_env["HOROVOD_PROFILE_DIR"] = profile_dir
+        # each rank's runtime Chrome trace feeds the merged view; an
+        # explicit HOROVOD_TIMELINE (single shared path — wrong for
+        # multi-rank anyway) is overridden by the per-rank template
+        base_env["HOROVOD_TIMELINE"] = os.path.join(
+            profile_dir, "timeline-rank-{rank}.json")
+        try:
+            os.makedirs(profile_dir, exist_ok=True)
+        except OSError as exc:
+            print(f"tpurun: cannot create profile dir {profile_dir!r}: "
+                  f"{exc}", file=sys.stderr)
     if backend is None:
         # resolve from the CALLER's env mapping (like the NIC-discovery
         # knob below), so programmatic callers control the backend the
@@ -242,6 +269,7 @@ def launch_job(command: str, slots: List[SlotInfo],
             pass
 
     shipped: Dict[str, bytes] = {}
+    shipped_profile: Dict[str, bytes] = {}
     try:
         for t in threads:
             t.start()
@@ -264,6 +292,18 @@ def launch_job(command: str, slots: List[SlotInfo],
             except Exception as exc:
                 print(f"tpurun: could not collect shipped flight-recorder "
                       f"dumps: {exc}", file=sys.stderr)
+        if profile_dir:
+            # same store, the profiler's scope: per-rank step profiles
+            try:
+                from horovod_tpu import profiler
+
+                for key in rendezvous.live_keys(profiler.RENDEZVOUS_SCOPE):
+                    value = rendezvous.get(profiler.RENDEZVOUS_SCOPE, key)
+                    if value:
+                        shipped_profile[key] = value
+            except Exception as exc:
+                print(f"tpurun: could not collect shipped profiles: {exc}",
+                      file=sys.stderr)
         rendezvous.stop()
 
     def job_exit_code() -> int:
@@ -291,6 +331,8 @@ def launch_job(command: str, slots: List[SlotInfo],
     code = job_exit_code()
     if flight_recorder_dir:
         _finalize_flight_dumps(flight_recorder_dir, shipped, code)
+    if profile_dir:
+        _finalize_profile(profile_dir, shipped_profile)
     return code
 
 
@@ -326,3 +368,43 @@ def _finalize_flight_dumps(directory: str, shipped: Dict[str, bytes],
     else:
         print(f"tpurun: job failed but no flight-recorder dumps were found "
               f"in {directory!r}", file=sys.stderr)
+
+
+def _finalize_profile(directory: str, shipped: Dict[str, bytes]) -> None:
+    """Persist rendezvous-shipped per-rank profiles (worker-written local
+    files win — they are at least as fresh), merge every rank's timeline /
+    device trace / step markers onto one corrected clock, and print the
+    cross-rank step-time report."""
+    from horovod_tpu import profiler
+
+    try:
+        os.makedirs(directory, exist_ok=True)
+    except OSError as exc:
+        print(f"tpurun: cannot write profiles to {directory!r}: {exc}",
+              file=sys.stderr)
+        return
+    for key, value in shipped.items():
+        if not key.startswith("rank."):
+            continue
+        path = os.path.join(
+            directory,
+            f"{profiler.DUMP_PREFIX}{key[len('rank.'):]}.json")
+        if os.path.exists(path):
+            continue
+        try:
+            with open(path, "wb") as f:
+                f.write(value)
+        except OSError as exc:
+            print(f"tpurun: could not write {path}: {exc}", file=sys.stderr)
+    try:
+        merged_path, n_events = profiler.merge_profile_dir(directory)
+    except Exception as exc:
+        print(f"tpurun: could not merge profile traces: {exc}",
+              file=sys.stderr)
+        merged_path, n_events = None, 0
+    dumps = profiler.load_dumps(directory)
+    if dumps:
+        print(profiler.format_step_report(dumps))
+    if merged_path and n_events:
+        print(f"tpurun: merged trace ({n_events} events) written to "
+              f"{merged_path} — load it in Perfetto / chrome://tracing")

@@ -159,6 +159,20 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
               "flight-recorder dumps in DIR and exit (non-zero when DIR "
               "holds no dumps).")
 
+    profile = parser.add_argument_group("profiler")
+    _add(profile, "--profile-dir", dest="profile_dir",
+         help="Directory for per-rank step profiles. Sets "
+              "HOROVOD_PROFILE_DIR (enabling the step profiler) and a "
+              "per-rank HOROVOD_TIMELINE; after the job the launcher "
+              "collects every rank's profile + timeline + device trace, "
+              "merges them onto one clock-corrected Chrome trace "
+              "(merged-trace.json), and prints a cross-rank step-time "
+              "report naming the slowest rank and its dominant phase.")
+    _add(profile, "--profile-report", dest="profile_report", metavar="DIR",
+         help="Print the cross-rank step-time report from the profile "
+              "dumps in DIR (re-merging the trace) and exit; non-zero "
+              "when DIR holds no dumps.")
+
     autotune = parser.add_argument_group("autotune")
     _add(autotune, "--autotune", dest="autotune", action="store_true",
          help="Enable Bayesian autotuning of fusion/cycle parameters.")
@@ -367,6 +381,26 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             return 2
         print(format_summary(rows, n_ranks=len(paths)))
         return 0
+    if args.profile_report:
+        from horovod_tpu import profiler
+
+        dumps = profiler.load_dumps(args.profile_report)
+        if not dumps:
+            sys.stderr.write(f"tpurun --profile-report: no profile dumps "
+                             f"found in {args.profile_report!r}\n")
+            return 1
+        try:
+            merged_path, n_events = profiler.merge_profile_dir(
+                args.profile_report)
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"tpurun --profile-report: merge failed: "
+                             f"{exc}\n")
+            merged_path, n_events = None, 0
+        print(profiler.format_step_report(dumps))
+        if merged_path and n_events:
+            print(f"tpurun: merged trace ({n_events} events) written to "
+                  f"{merged_path}")
+        return 0
     if args.postmortem:
         from horovod_tpu import flight_recorder
 
@@ -435,7 +469,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         elastic=elastic, min_workers=min_workers,
         max_workers=args.max_workers,
         discovery_script=args.host_discovery_script,
-        flight_recorder_dir=args.flight_recorder_dir)
+        flight_recorder_dir=args.flight_recorder_dir,
+        profile_dir=args.profile_dir)
 
 
 def main() -> None:

@@ -70,6 +70,52 @@ _PAD_BYTES = _metrics().counter(
     "horovod_executor_pad_bytes_total",
     "Identity-padding bytes appended to fused payloads for size-bucketed "
     "program reuse.")
+_COMM_EXPOSED = _metrics().counter(
+    "horovod_comm_exposed_seconds_total",
+    "Collective wall time NOT hidden behind other in-flight work: dispatch "
+    "busy time plus drain (device sync + unpack) time, summed across ops. "
+    "Compare against the horovod_executor_op_duration_seconds sum for the "
+    "comm-hidden fraction.")
+
+
+class _CommClock:
+    """Cumulative comm-exposure accounting consumed by the step profiler
+    (profiler.py diffs these at step boundaries). Per completed op the
+    lifetime splits into dispatch-busy (pack + launch), an overlap window
+    (token parked in the pipeline deque while later responses dispatch —
+    the only part hidden from the caller), and drain-busy (device sync +
+    unpack). Plain float adds under the GIL — same hot-path philosophy as
+    the metrics registry."""
+
+    __slots__ = ("total_seconds", "exposed_seconds", "total_bytes",
+                 "hidden_bytes")
+
+    def __init__(self) -> None:
+        self.total_seconds = 0.0
+        self.exposed_seconds = 0.0
+        self.total_bytes = 0
+        self.hidden_bytes = 0.0
+
+    def record(self, total: float, exposed: float, nbytes: int) -> None:
+        self.total_seconds += total
+        self.exposed_seconds += exposed
+        self.total_bytes += nbytes
+        if total > 0.0:
+            self.hidden_bytes += nbytes * (1.0 - exposed / total)
+        _COMM_EXPOSED.inc(exposed)
+
+
+_comm_clock = _CommClock()
+
+
+def comm_totals() -> dict:
+    """Snapshot of the cumulative comm-exposure accumulators (the step
+    profiler diffs two of these to attribute one step's collectives)."""
+    c = _comm_clock
+    return {"total_seconds": c.total_seconds,
+            "exposed_seconds": c.exposed_seconds,
+            "total_bytes": c.total_bytes,
+            "hidden_bytes": c.hidden_bytes}
 
 
 # reduce_op name -> stacked-axis reducer for the XLA fused programs
@@ -128,7 +174,8 @@ class _PendingOp:
     completed in dispatch order (the cycle body's drain preserves it)."""
 
     __slots__ = ("executor", "op", "entries", "timeline", "name0", "t0",
-                 "finish", "done", "lease", "nbytes", "bucket")
+                 "finish", "done", "lease", "nbytes", "bucket",
+                 "t_disp_end", "t_drain_start")
 
     def __init__(self, executor: "Executor", op: str, entries, timeline):
         self.executor = executor
@@ -144,13 +191,26 @@ class _PendingOp:
         # fused size bucket (elements per row), filled by allreduce
         # dispatch paths that pad to one; None for unbucketed ops
         self.bucket: Optional[int] = None
+        # comm-exposure stamps: dispatch() sets t_disp_end when staging
+        # returns; complete()/fail() set t_drain_start on entry. The gap
+        # between them is the token's pipeline-overlap window — comm time
+        # hidden behind later dispatches (profiler.py's hidden fraction).
+        self.t_disp_end: Optional[float] = None
+        self.t_drain_start: Optional[float] = None
 
     def _close(self) -> None:
         self.done = True
         if self.lease is not None:
             self.executor.fusion_buffers.release(self.lease)
             self.lease = None
-        _OP_LATENCY.labels(op=self.op).observe(time.perf_counter() - self.t0)
+        t_end = time.perf_counter()
+        total = t_end - self.t0
+        _OP_LATENCY.labels(op=self.op).observe(total)
+        disp_end = self.t_disp_end if self.t_disp_end is not None else t_end
+        drain_start = (self.t_drain_start if self.t_drain_start is not None
+                       else t_end)
+        hidden = max(0.0, min(drain_start, t_end) - min(disp_end, t_end))
+        _comm_clock.record(total, max(0.0, total - hidden), self.nbytes)
         if self.timeline is not None:
             self.timeline.end(self.name0)
 
@@ -161,6 +221,8 @@ class _PendingOp:
         the cycle body's abort sweep can fail the whole pending deque."""
         if self.done:
             return
+        if self.t_drain_start is None:
+            self.t_drain_start = time.perf_counter()
         _OP_ERRORS.labels(op=self.op).inc()
         flight_recorder.emit("op_fail", op=self.op, name=self.name0,
                              bytes=self.nbytes, bucket=self.bucket,
@@ -183,6 +245,8 @@ class _PendingOp:
     def complete(self) -> None:
         if self.done:
             return
+        if self.t_drain_start is None:
+            self.t_drain_start = time.perf_counter()
         try:
             if self.finish is not None:
                 self.finish()
@@ -408,6 +472,8 @@ class Executor:
                     f"unknown response type {response.response_type}")
         except Exception as exc:
             pend.fail_exc(exc)
+        if pend.t_disp_end is None:
+            pend.t_disp_end = time.perf_counter()
         return pend
 
     # -- fused pack/pad helpers --------------------------------------------

@@ -158,12 +158,13 @@ def make_train_step(model, optimizer,
     """
     batch_sharding, repl = _shardings()
     one_step = _make_one_step(model, optimizer, loss_fn or _default_loss_fn)
-    return jax.jit(
+    step_fn = jax.jit(
         one_step,
         in_shardings=(repl, repl, repl, batch_sharding, batch_sharding),
         out_shardings=(repl, repl, repl, repl),
         donate_argnums=(0, 1, 2) if donate else (),
-    ), batch_sharding
+    )
+    return _with_profiler_hook(step_fn), batch_sharding
 
 
 def make_train_round(model, optimizer,
@@ -194,9 +195,29 @@ def make_train_round(model, optimizer,
             body, (params, batch_stats, opt_state), None, length=steps)
         return losses[-1], params, batch_stats, opt_state
 
-    return jax.jit(
+    round_jit = jax.jit(
         round_fn,
         in_shardings=(repl, repl, repl, batch_sharding, batch_sharding),
         out_shardings=(repl, repl, repl, repl),
         donate_argnums=(0, 1, 2) if donate else (),
-    ), batch_sharding
+    )
+    return _with_profiler_hook(round_jit), batch_sharding
+
+
+def _with_profiler_hook(step_fn):
+    """Mark a step boundary per invocation when profiling is enabled
+    (profiler.py auto-step: step time = call-to-call interval; the whole
+    jitted body attributes as compute). Disabled profiling returns the
+    jitted callable untouched — zero wrapper overhead and the jit object's
+    own API (``.lower`` etc.) stays reachable."""
+    from horovod_tpu import profiler
+
+    if not profiler.enabled():
+        return step_fn
+
+    def profiled(*args, **kwargs):
+        profiler.auto_step()
+        return step_fn(*args, **kwargs)
+
+    profiled.__wrapped__ = step_fn
+    return profiled

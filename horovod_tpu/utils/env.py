@@ -51,6 +51,10 @@ HOROVOD_FLIGHT_RECORDER = "HOROVOD_FLIGHT_RECORDER"
 HOROVOD_FLIGHT_RECORDER_DIR = "HOROVOD_FLIGHT_RECORDER_DIR"
 HOROVOD_STRAGGLER_REPORT_SECONDS = "HOROVOD_STRAGGLER_REPORT_SECONDS"
 HOROVOD_SHARDED_FUSED_KERNEL = "HOROVOD_SHARDED_FUSED_KERNEL"
+HOROVOD_PROFILE = "HOROVOD_PROFILE"
+HOROVOD_PROFILE_DIR = "HOROVOD_PROFILE_DIR"
+HOROVOD_PROFILE_HISTORY = "HOROVOD_PROFILE_HISTORY"
+HOROVOD_PROFILE_JAX = "HOROVOD_PROFILE_JAX"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference: operations.cc:386
@@ -59,6 +63,7 @@ DEFAULT_CYCLE_PIPELINE_DEPTH = 2
 DEFAULT_FUSION_BUCKET_QUANTUM_BYTES = 64 * 1024
 DEFAULT_FLIGHT_RECORDER_CAPACITY = 2048
 DEFAULT_STRAGGLER_REPORT_SECONDS = 60.0
+DEFAULT_PROFILE_HISTORY = 64
 
 
 def _get_int(name: str, default: int) -> int:
@@ -147,6 +152,13 @@ class Config:
     # coordinator straggler report interval (0 disables the log line;
     # the lag gauge/skew histogram stay on either way)
     straggler_report_seconds: float = DEFAULT_STRAGGLER_REPORT_SECONDS
+    # step profiler (profiler.py): per-step phase attribution, comm-hidden
+    # fraction and MFU; a profile dir also turns profiling on
+    profile: bool = False
+    profile_dir: str = ""
+    profile_history: int = DEFAULT_PROFILE_HISTORY
+    # additionally capture a jax.profiler device trace into the profile dir
+    profile_jax: bool = False
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -198,6 +210,12 @@ class Config:
                 HOROVOD_STRAGGLER_REPORT_SECONDS,
                 DEFAULT_STRAGGLER_REPORT_SECONDS,
             ),
+            profile=(_get_bool(HOROVOD_PROFILE)
+                     or os.environ.get(HOROVOD_PROFILE_DIR, "") != ""),
+            profile_dir=os.environ.get(HOROVOD_PROFILE_DIR, ""),
+            profile_history=_get_int(HOROVOD_PROFILE_HISTORY,
+                                     DEFAULT_PROFILE_HISTORY),
+            profile_jax=_get_bool(HOROVOD_PROFILE_JAX),
         )
 
 

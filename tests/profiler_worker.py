@@ -1,0 +1,35 @@
+"""Worker script for the 2-rank profiler merge test (tests/
+test_profiler.py): run a few explicitly profiled steps whose collective
+goes over the real socket/native transport, then shut down — the
+profiler dumps ``profile-rank-N.json`` into HOROVOD_PROFILE_DIR and
+ships a copy to the rendezvous store, exactly what ``tpurun
+--profile-dir`` harvests."""
+
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+STEPS = int(os.environ.get("PROFILER_WORKER_STEPS", "4"))
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.profiler.enabled(), "HOROVOD_PROFILE_DIR must enable it"
+    for step in range(STEPS):
+        with hvd.profiler.step(f"step {step}"):
+            with hvd.profiler.annotate("host"):
+                batch = np.ones(64, np.float32)
+            out = hvd.allreduce(batch, average=True, name="prof_grad")
+    assert float(np.asarray(out)[0]) == 1.0
+    summary = hvd.profiler.summary()
+    print(f"DONE rank={hvd.rank()} steps={summary['steps']} "
+          f"wall={summary['wall_seconds']:.6f}", flush=True)
+    hvd.shutdown()  # dumps + ships the profile
+    return 0 if summary["steps"] == STEPS else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

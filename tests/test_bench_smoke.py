@@ -54,3 +54,115 @@ def test_sharded_optimizer_tiny(bench, capsys):
     assert result["steady_state_program_builds"] == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["value"] == result["value"]
+
+
+def test_tiny_flagship_emits_step_breakdown(bench, capsys, monkeypatch):
+    """PR 6 acceptance: bare ``python bench.py --tiny`` — here its entry
+    function — emits a headline carrying step_breakdown +
+    comm_hidden_fraction from the step profiler."""
+    result = bench.tiny_main()
+    monkeypatch.delenv("HOROVOD_PROFILE", raising=False)
+    assert result["tiny"] is True
+    phases = result["step_breakdown"]
+    assert set(phases) == {"host", "compute", "exposed_comm", "optimizer"}
+    assert sum(phases.values()) > 0
+    assert 0.0 <= result["comm_hidden_fraction"] <= 1.0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["step_breakdown"] == phases
+
+
+# ---------------------------------------------------------------------------
+# bench_compare regression gate
+# ---------------------------------------------------------------------------
+
+_REPO_TOOLS = os.path.join(_REPO, "tools")
+
+
+@pytest.fixture
+def bench_compare():
+    if _REPO_TOOLS not in sys.path:
+        sys.path.insert(0, _REPO_TOOLS)
+    import bench_compare as mod
+
+    return mod
+
+
+def _artifact(path, rows):
+    tail = "\n".join(["benchmark log noise"]
+                     + [json.dumps(r) for r in rows])
+    with open(path, "w") as f:
+        json.dump({"n": 1, "cmd": "python bench.py", "rc": 0,
+                   "tail": tail}, f)
+    return str(path)
+
+
+_BASE_ROW = {"metric": "images/sec/chip (ResNet-50 synthetic)",
+             "value": 2000.0, "unit": "images/sec/chip", "mfu": 0.5,
+             "step_breakdown": {"host": 0.002, "compute": 0.04,
+                                "exposed_comm": 0.003, "optimizer": 0.005}}
+
+
+def test_bench_compare_clean_pass(bench_compare, tmp_path, capsys):
+    base = _artifact(tmp_path / "base.json", [_BASE_ROW])
+    cand_row = dict(_BASE_ROW, value=1980.0)  # -1%: inside the gate
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    assert bench_compare.main([base, cand]) == 0
+    out = capsys.readouterr().out
+    assert "no regressions" in out
+
+
+def test_bench_compare_degraded_candidate_fails(bench_compare, tmp_path,
+                                                capsys):
+    base = _artifact(tmp_path / "base.json", [_BASE_ROW])
+    cand_row = dict(_BASE_ROW, value=1500.0)  # -25% throughput
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    rc = bench_compare.main(["--baseline", base, "--candidate", cand,
+                             "--threshold-pct", "5"])
+    assert rc == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_bench_compare_phase_regression_fails(bench_compare, tmp_path,
+                                              capsys):
+    # throughput flat but exposed comm tripled: the phase row catches it
+    base = _artifact(tmp_path / "base.json", [_BASE_ROW])
+    cand_row = dict(_BASE_ROW)
+    cand_row["step_breakdown"] = dict(_BASE_ROW["step_breakdown"],
+                                      exposed_comm=0.009)
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    assert bench_compare.main([base, cand]) == 1
+    assert "exposed_comm seconds" in capsys.readouterr().out
+
+
+def test_bench_compare_expands_summary_and_skips_tiny(bench_compare,
+                                                      tmp_path):
+    # truncated-run shape: the only row is a cumulative summary line
+    summary = {"metric": "summary — all headlines", "value": 1.0,
+               "unit": "tokens/sec/chip",
+               "results": [_BASE_ROW,
+                           {"metric": "tiny row", "value": 5.0,
+                            "unit": "ms", "tiny": True}]}
+    rows = bench_compare.derived_rows(
+        bench_compare.parse_artifact(
+            _artifact(tmp_path / "sum.json", [summary])))
+    assert "images/sec/chip (ResNet-50 synthetic)" in rows
+    assert not any("tiny" in k for k in rows)
+    assert not any(k.startswith("summary") for k in rows)
+
+
+def test_bench_compare_real_artifacts(bench_compare):
+    """The repo's own trajectory must pass its own gate (PR 6
+    acceptance: r04 -> r05 runs clean)."""
+    r04 = os.path.join(_REPO, "BENCH_r04.json")
+    r05 = os.path.join(_REPO, "BENCH_r05.json")
+    if not (os.path.exists(r04) and os.path.exists(r05)):
+        pytest.skip("BENCH artifacts not present")
+    assert bench_compare.main([r04, r05]) == 0
+
+
+def test_bench_compare_usage_errors(bench_compare, tmp_path):
+    assert bench_compare.main([]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json")
+    good = _artifact(tmp_path / "good.json", [_BASE_ROW])
+    assert bench_compare.main([str(bad), good]) == 2

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Perf regression gate: diff two bench.py artifact trajectories.
+
+The driver snapshots each round's ``python bench.py`` output as
+``BENCH_rNN.json`` — ``{"n", "cmd", "rc", "tail"}`` where ``tail`` holds
+the run's last stdout lines, a mix of log text and the one-JSON-line-per-
+headline protocol (bench.py prints a cumulative ``summary`` line whose
+``results`` array re-states every completed headline, so even an rc=124
+truncated artifact carries everything that finished). This tool parses
+both artifacts, matches headlines by metric name, and fails loudly when
+the candidate regresses past the threshold:
+
+    python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_compare.py --baseline BENCH_r05.json \
+        --candidate /tmp/new.json --threshold-pct 3
+
+Direction comes from the unit: rates (``*/sec*``), ``mfu`` and
+``x``-factors are higher-is-better; ``ms``/``us``/``seconds``/``bytes``
+are lower-is-better. Rows marked ``"tiny": true`` (smoke-test mode —
+bench.py's own docs call the numbers meaningless) are ignored. The
+embedded per-headline MFU and step-phase seconds (``step_breakdown``,
+PR 6) are compared as derived sub-metrics; phases under 1 ms are skipped
+(pure jitter at that scale). Exit status: 0 clean, 1 regression(s),
+2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# derived step-phase rows below this baseline value are noise, not signal
+MIN_PHASE_SECONDS = 1e-3
+
+LOWER_IS_BETTER_UNITS = ("ms", "us", "seconds", "s", "bytes")
+
+
+def parse_artifact(path: str) -> Dict[str, dict]:
+    """Metric-name -> headline dict for one artifact. Later lines win
+    (bench.py re-emits the cumulative summary after every workload), and
+    a summary's ``results`` array is expanded so truncated runs still
+    contribute every completed headline."""
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError as exc:
+            raise ValueError(f"{path}: not JSON: {exc}")
+    if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+        lines = doc["tail"].splitlines()
+    elif isinstance(doc, dict) and "metric" in doc:
+        lines = [json.dumps(doc)]
+    elif isinstance(doc, list):
+        lines = [json.dumps(o) for o in doc]
+    else:
+        raise ValueError(f"{path}: no 'tail' field and not a headline "
+                         "document")
+
+    rows: Dict[str, dict] = {}
+
+    def take(obj: dict) -> None:
+        if not isinstance(obj, dict) or "metric" not in obj:
+            return
+        for sub in obj.get("results") or ():
+            take(sub)
+        if obj.get("tiny"):
+            return
+        if obj["metric"].startswith("summary"):
+            return  # its results were expanded above; the row itself
+            # just mirrors the flagship and would double-count it
+        if isinstance(obj.get("value"), (int, float)):
+            rows[obj["metric"]] = obj
+
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        take(obj)
+    return rows
+
+
+def higher_is_better(metric: str, unit: Optional[str]) -> bool:
+    u = (unit or "").strip().lower()
+    if u in LOWER_IS_BETTER_UNITS:
+        return False
+    if metric.endswith("[mfu]") or "/sec" in u or u in ("x", ""):
+        return True
+    return True
+
+
+def derived_rows(rows: Dict[str, dict]) -> Dict[str, Tuple[float, str]]:
+    """Flatten headlines to comparable (value, unit) rows, adding the
+    per-headline MFU and step-phase sub-metrics."""
+    flat: Dict[str, Tuple[float, str]] = {}
+    for metric, obj in rows.items():
+        flat[metric] = (float(obj["value"]), obj.get("unit") or "")
+        if isinstance(obj.get("mfu"), (int, float)):
+            flat[f"{metric} [mfu]"] = (float(obj["mfu"]), "mfu")
+        breakdown = obj.get("step_breakdown")
+        if isinstance(breakdown, dict):
+            for phase, seconds in breakdown.items():
+                if isinstance(seconds, (int, float)):
+                    flat[f"{metric} [{phase} seconds]"] = (
+                        float(seconds), "seconds")
+    return flat
+
+
+def compare(baseline: Dict[str, Tuple[float, str]],
+            candidate: Dict[str, Tuple[float, str]],
+            threshold_pct: float) -> Tuple[List[str], List[str]]:
+    """Returns (report lines, regression lines)."""
+    report: List[str] = []
+    regressions: List[str] = []
+    common = sorted(set(baseline) & set(candidate))
+    for metric in common:
+        base, unit = baseline[metric]
+        cand, _ = candidate[metric]
+        if unit == "seconds" and base < MIN_PHASE_SECONDS:
+            continue
+        if base == 0:
+            continue
+        delta_pct = (cand - base) / abs(base) * 100.0
+        hib = higher_is_better(metric, unit)
+        worse_pct = -delta_pct if hib else delta_pct
+        verdict = "REGRESSION" if worse_pct > threshold_pct else "ok"
+        line = (f"{verdict:>10}  {metric}: {base:g} -> {cand:g} {unit} "
+                f"({delta_pct:+.2f}%, {'higher' if hib else 'lower'} is "
+                f"better, threshold {threshold_pct:g}%)")
+        report.append(line)
+        if verdict == "REGRESSION":
+            regressions.append(line)
+    only_base = sorted(set(baseline) - set(candidate))
+    only_cand = sorted(set(candidate) - set(baseline))
+    for metric in only_base:
+        report.append(f"{'missing':>10}  {metric}: in baseline only "
+                      "(not compared)")
+    for metric in only_cand:
+        report.append(f"{'new':>10}  {metric}: in candidate only "
+                      "(not compared)")
+    return report, regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Fail when a bench.py artifact regresses vs a "
+                    "baseline artifact.")
+    parser.add_argument("files", nargs="*",
+                        help="BASELINE CANDIDATE (positional form)")
+    parser.add_argument("--baseline", help="baseline BENCH_*.json")
+    parser.add_argument("--candidate", help="candidate BENCH_*.json")
+    parser.add_argument("--threshold-pct", type=float, default=5.0,
+                        help="worsening beyond this %% fails the gate "
+                             "(default 5; rates/MFU measured round-to-"
+                             "round jitter is well under that)")
+    args = parser.parse_args(argv)
+
+    baseline_path = args.baseline
+    candidate_path = args.candidate
+    positional = list(args.files)
+    if baseline_path is None and positional:
+        baseline_path = positional.pop(0)
+    if candidate_path is None and positional:
+        candidate_path = positional.pop(0)
+    if positional or baseline_path is None or candidate_path is None:
+        parser.print_usage(sys.stderr)
+        sys.stderr.write("bench_compare: need exactly a baseline and a "
+                         "candidate artifact\n")
+        return 2
+
+    try:
+        base_rows = derived_rows(parse_artifact(baseline_path))
+        cand_rows = derived_rows(parse_artifact(candidate_path))
+    except (OSError, ValueError) as exc:
+        sys.stderr.write(f"bench_compare: {exc}\n")
+        return 2
+    if not base_rows:
+        sys.stderr.write(f"bench_compare: no headline rows in "
+                         f"{baseline_path!r}\n")
+        return 2
+    if not cand_rows:
+        sys.stderr.write(f"bench_compare: no headline rows in "
+                         f"{candidate_path!r}\n")
+        return 2
+
+    report, regressions = compare(base_rows, cand_rows,
+                                  args.threshold_pct)
+    compared = sum(1 for line in report
+                   if line.lstrip().startswith(("ok", "REGRESSION")))
+    print(f"bench_compare: {baseline_path} -> {candidate_path} "
+          f"({compared} compared metrics)")
+    for line in report:
+        print(line)
+    if regressions:
+        print(f"bench_compare: {len(regressions)} regression(s) past "
+              f"{args.threshold_pct:g}%", file=sys.stderr)
+        return 1
+    if not compared:
+        sys.stderr.write("bench_compare: artifacts share no comparable "
+                         "metrics\n")
+        return 2
+    print("bench_compare: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
