@@ -1,0 +1,44 @@
+"""Static + runtime concurrency and collective-safety analysis.
+
+Six PRs grew the runtime into a genuinely concurrent system — a background
+cycle thread, pipelined pending-op dispatch/drain, fusion-buffer leases,
+elastic generation reforms — with invariants (lease released on every
+path, collectives issued in identical order on every rank, no blocking
+call under the cycle lock) that nothing proved. This package is the
+correctness backstop:
+
+* :mod:`lockgraph` — AST analyzer over the package: extracts every lock
+  acquisition, builds the lock-order graph, reports order-inversion
+  cycles, blocking calls made while a lock is held, and mutations of
+  ``# guarded-by:``-annotated shared attributes outside their lock.
+* :mod:`divergence` — SPMD collective-divergence linter: collective calls
+  reachable only under rank-/size-conditional control flow, or carrying
+  non-deterministic ``name=`` arguments, diverge the cross-rank program
+  order — the silent-deadlock class negotiation can't always catch.
+* :mod:`witness` — runtime deadlock witness (``HOROVOD_DEBUG_LOCKS=1``):
+  a drop-in lock wrapper used by the runtime's own locks in debug mode
+  that records per-thread acquisition order, detects inversions,
+  waits-for deadlock cycles and over-threshold hold times live, and
+  emits ``lock_acquire``/``lock_hold`` events into the flight recorder.
+* :mod:`baseline` — checked-in accepted-findings file
+  (``tools/analysis_baseline.json``): new violations fail CI, reviewed
+  pre-existing ones are suppressed and enumerated.
+
+CLI: ``python tools/hvd_analyze.py`` (tier-1 enforced by
+tests/test_analysis.py). Docs: docs/analysis.md.
+"""
+
+from horovod_tpu.analysis.report import Finding  # noqa: F401
+from horovod_tpu.analysis import baseline  # noqa: F401
+from horovod_tpu.analysis import divergence  # noqa: F401
+from horovod_tpu.analysis import lockgraph  # noqa: F401
+from horovod_tpu.analysis import witness  # noqa: F401
+
+
+def run_static_passes(paths, root=None):
+    """Run every static pass over ``paths`` (files or directories).
+    Returns (findings, lock_order_edges) — the edges feed the runtime
+    witness's static-order assertion."""
+    lg = lockgraph.analyze_paths(paths, root=root)
+    dv = divergence.analyze_paths(paths, root=root)
+    return lg.findings + dv, lg.edges

@@ -1,0 +1,73 @@
+"""Accepted-findings baseline (tools/analysis_baseline.json).
+
+The baseline is a reviewed suppression list, not a dumping ground: every
+entry carries a ``reason`` string explaining why the finding is accepted
+rather than fixed. ``compare()`` splits a run's findings into *new*
+(fail CI) and *suppressed* (enumerated), and reports *stale* suppressions
+whose code no longer trips the analyzer so the file shrinks as fixes land.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from horovod_tpu.analysis.report import Finding, sort_findings
+
+SCHEMA = "hvd-analyze-baseline-v1"
+
+
+def load(path: str) -> Dict[str, Dict[str, object]]:
+    """Return {fingerprint: suppression-entry}. Missing file → empty."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    if data.get("schema") != SCHEMA:
+        raise ValueError(f"{path}: unknown baseline schema {data.get('schema')!r}")
+    out = {}
+    for entry in data.get("suppressions", []):
+        fp = entry.get("fingerprint")
+        if not fp:
+            raise ValueError(f"{path}: suppression missing fingerprint: {entry}")
+        if not entry.get("reason"):
+            raise ValueError(f"{path}: suppression {fp} has no reason string")
+        out[fp] = entry
+    return out
+
+
+def compare(
+    findings: List[Finding], baseline: Dict[str, Dict[str, object]]
+) -> Tuple[List[Finding], List[Finding], List[Dict[str, object]]]:
+    """Split findings into (new, suppressed) and list stale suppressions."""
+    new, suppressed = [], []
+    seen = set()
+    for f in sort_findings(findings):
+        seen.add(f.fingerprint)
+        (suppressed if f.fingerprint in baseline else new).append(f)
+    stale = [e for fp, e in sorted(baseline.items()) if fp not in seen]
+    return new, suppressed, stale
+
+
+def write(path: str, findings: List[Finding], reasons: Dict[str, str] | None = None) -> None:
+    """Write a baseline accepting every finding in ``findings``.
+
+    ``reasons`` maps fingerprints to reason strings; entries without one
+    get a placeholder that a human must replace (load() accepts it — the
+    review gate is code review, not the loader).
+    """
+    reasons = reasons or {}
+    sup = []
+    for f in sort_findings(findings):
+        sup.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "file": f.file,
+            "symbol": f.symbol,
+            "message": f.message,
+            "reason": reasons.get(f.fingerprint, "TODO: reviewed-by a human — explain why this is accepted"),
+        })
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"schema": SCHEMA, "suppressions": sup}, f, indent=2, sort_keys=False)
+        f.write("\n")

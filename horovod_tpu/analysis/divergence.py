@@ -1,0 +1,265 @@
+"""SPMD collective-divergence linter.
+
+Collectives must be issued in identical order with identical names on
+every rank; a collective reachable only on some ranks deadlocks the rest
+silently (the negotiation stall warning fires minutes later with no
+culprit). Three rules:
+
+* ``rank-conditional-collective`` — a collective call lexically inside a
+  rank-conditional branch (``rank``/``local_rank``/``process_index``/
+  ``is_coordinator`` … in the test) whose sibling branch does not issue
+  the same collective. Symmetric patterns — the same collective name in
+  both arms, or in a terminal (return/raise) arm and the fall-through
+  code — are accepted: those keep cross-rank order aligned.
+* ``size-conditional-collective`` — same, for world-size conditionals
+  (``size``/``world_size``/``num_processes`` …). Lower confidence:
+  size is uniform across ranks, so this diverges *configurations* rather
+  than ranks (the classic "works at N=1, hangs at N=8" bug). Early-exit
+  ``if size <= 1: return`` guards are not flagged — only collectives
+  *inside* a size branch.
+* ``nondeterministic-collective-name`` — a collective whose ``name=``
+  argument embeds ``id()``/``uuid*``/time/random calls (directly or via
+  f-string interpolation): ranks disagree on the name and never match.
+
+The matcher covers the public lanes (``allreduce*``, ``allgather*``,
+``broadcast*``/``bcast*``, ``reducescatter``/``reduce_scatter``,
+``alltoall*``, ``psum*``/``pmean``/``pmin``/``pmax``, ``barrier``,
+``grouped_*``, ``sharded_*``) by callee-name prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Sequence, Set
+
+from horovod_tpu.analysis.report import Finding, sort_findings
+from horovod_tpu.analysis.lockgraph import _iter_py_files, _rel, _call_name
+
+COLLECTIVE_RE = re.compile(
+    r"^(allreduce|allgather|all_gather|alltoall|all_to_all|broadcast|bcast"
+    r"|reducescatter|reduce_scatter|psum|pmean|pmin|pmax|barrier"
+    r"|grouped_|sharded_)"
+)
+
+RANK_TOKENS = {
+    "rank", "local_rank", "cross_rank", "process_index", "launch_rank",
+    "is_coordinator", "rank0", "is_root", "is_leader",
+}
+# root_rank/rank counts as uniform when it's a *parameter* compared against
+# a constant — but st.rank/hvd.rank() in the test is per-rank. We exclude
+# only the conventional uniform parameter name.
+UNIFORM_NAMES = {"root_rank"}
+
+SIZE_TOKENS = {
+    "size", "world_size", "local_size", "cross_size", "num_processes",
+    "process_count", "nproc", "world",
+}
+
+NONDET_CALLS = {
+    "id", "uuid1", "uuid4", "time", "time_ns", "monotonic", "monotonic_ns",
+    "perf_counter", "perf_counter_ns", "random", "randint", "randrange",
+    "getrandbits", "token_hex", "token_urlsafe", "urandom", "getpid",
+}
+
+
+def is_collective_name(name: Optional[str]) -> bool:
+    return bool(name) and bool(COLLECTIVE_RE.match(name))
+
+
+def _test_tokens(test: ast.expr) -> Set[str]:
+    toks: Set[str] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name):
+            toks.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            toks.add(node.attr)
+        elif isinstance(node, ast.Call):
+            n = _call_name(node.func)
+            if n:
+                toks.add(n)
+    return toks
+
+
+def _classify_test(test: ast.expr) -> Optional[str]:
+    toks = _test_tokens(test) - UNIFORM_NAMES
+    if toks & RANK_TOKENS:
+        return "rank"
+    if toks & SIZE_TOKENS:
+        return "size"
+    return None
+
+
+def _collectives_in(stmts: Sequence[ast.stmt]) -> List[ast.Call]:
+    out = []
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, ast.Call) and is_collective_name(_call_name(node.func)):
+                out.append(node)
+            # Nested defs run later on their own schedule — skip.
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                break
+    return out
+
+
+def _is_terminal(stmts: Sequence[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], (ast.Return, ast.Raise,
+                                                  ast.Continue, ast.Break))
+
+
+def _nondet_name_expr(expr: ast.expr) -> Optional[str]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            n = _call_name(node.func)
+            if n in NONDET_CALLS:
+                return n
+    return None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.findings: List[Finding] = []
+        self.symbol_stack: List[str] = []
+
+    def _symbol(self) -> str:
+        return ".".join(self.symbol_stack) if self.symbol_stack else "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.symbol_stack.append(node.name)
+        self.generic_visit(node)
+        self.symbol_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.symbol_stack.append(node.name)
+        for block in self._blocks_under(node):
+            self._check_body_block(block)
+        self.generic_visit(node)
+        self.symbol_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _blocks_under(fn: ast.FunctionDef) -> List[Sequence[ast.stmt]]:
+        """Every statement block in the function (body, branch arms, loop
+        bodies, try arms) — but not blocks of nested function defs."""
+        blocks: List[Sequence[ast.stmt]] = []
+        stack: List[ast.stmt] = list(fn.body)
+        blocks.append(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(node, attr, None)
+                if sub:
+                    blocks.append(sub)
+                    stack.extend(sub)
+            for h in getattr(node, "handlers", []) or []:
+                blocks.append(h.body)
+                stack.extend(h.body)
+        return blocks
+
+    # --- rule: conditional collectives ----------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        kind = _classify_test(node.test)
+        if kind == "rank" and not node.orelse and _is_terminal(node.body):
+            # `if rank…: return/raise` — an early exit, not a branch pair.
+            # _check_body_block compares the exiting arm against the
+            # fall-through code so symmetric patterns like
+            # `if rank == 0: return bcast(x)` / `return bcast(None)` pass.
+            kind = None
+        if kind is not None:
+            body_calls = _collectives_in(node.body)
+            else_calls = _collectives_in(node.orelse)
+            body_names = {_call_name(c.func) for c in body_calls}
+            else_names = {_call_name(c.func) for c in else_calls}
+            for call in body_calls:
+                if _call_name(call.func) not in else_names:
+                    self._flag_conditional(kind, call, node, side="then")
+            for call in else_calls:
+                if _call_name(call.func) not in body_names:
+                    self._flag_conditional(kind, call, node, side="else")
+        self.generic_visit(node)
+
+    def _flag_conditional(self, kind: str, call: ast.Call, ifnode: ast.If,
+                          side: str) -> None:
+        n = _call_name(call.func)
+        rule = f"{kind}-conditional-collective"
+        self.findings.append(Finding(
+            rule=rule, file=self.rel, line=call.lineno, symbol=self._symbol(),
+            message=(f"collective {n}() reachable only under {kind}-conditional "
+                     f"branch ({side}-arm of if at line {ifnode.lineno}) with no "
+                     f"matching collective on the other arm"),
+            detail=f"{n} in {side}-arm {kind}-cond within {self._symbol()}",
+        ))
+
+    # --- rule: early-exit divergence ------------------------------------
+    def _check_body_block(self, stmts: Sequence[ast.stmt]) -> None:
+        """Rank-conditional early exits: ``if rank != 0: return`` followed by
+        collectives in the fall-through makes the collective rank-gated.
+        Symmetric early returns (the terminal arm issues the same
+        collectives as the fall-through) are accepted."""
+        for i, s in enumerate(stmts):
+            if not isinstance(s, ast.If) or s.orelse:
+                continue
+            if _classify_test(s.test) != "rank":
+                continue
+            if not _is_terminal(s.body):
+                continue
+            arm_names = {_call_name(c.func) for c in _collectives_in(s.body)}
+            rest = stmts[i + 1:]
+            for call in _collectives_in(rest):
+                n = _call_name(call.func)
+                if n not in arm_names:
+                    self.findings.append(Finding(
+                        rule="rank-conditional-collective",
+                        file=self.rel, line=call.lineno, symbol=self._symbol(),
+                        message=(f"collective {n}() only reachable past the "
+                                 f"rank-conditional early exit at line {s.lineno}"),
+                        detail=f"{n} after rank early-exit in {self._symbol()}",
+                    ))
+            for call in _collectives_in(s.body):
+                n = _call_name(call.func)
+                rest_names = {_call_name(c.func) for c in _collectives_in(rest)}
+                if n not in rest_names:
+                    self.findings.append(Finding(
+                        rule="rank-conditional-collective",
+                        file=self.rel, line=call.lineno, symbol=self._symbol(),
+                        message=(f"collective {n}() issued only on the exiting "
+                                 f"side of the rank conditional at line {s.lineno}"),
+                        detail=f"{n} in rank early-exit arm in {self._symbol()}",
+                    ))
+
+    # --- rule: nondeterministic names -----------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        n = _call_name(node.func)
+        if is_collective_name(n):
+            for kw in node.keywords:
+                if kw.arg and kw.arg.endswith("name"):
+                    bad = _nondet_name_expr(kw.value)
+                    if bad:
+                        self.findings.append(Finding(
+                            rule="nondeterministic-collective-name",
+                            file=self.rel, line=node.lineno, symbol=self._symbol(),
+                            message=(f"collective {n}() name= embeds {bad}() — "
+                                     f"ranks will disagree on the tensor name"),
+                            detail=f"{n} name embeds {bad} in {self._symbol()}",
+                        ))
+        self.generic_visit(node)
+
+
+def analyze_paths(paths: Sequence[str], root: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in _iter_py_files(list(paths)):
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue  # lockgraph reports parse errors
+        linter = _Linter(_rel(path, root))
+        linter.visit(tree)
+        findings.extend(linter.findings)
+    return sort_findings(findings)

@@ -1,0 +1,700 @@
+"""AST concurrency analyzer: lock-order graph, blocking-under-lock,
+guarded-by annotation checking.
+
+What it understands (documented honestly in docs/analysis.md):
+
+* Lock definitions: ``self.X = threading.Lock()/RLock()/Condition()``,
+  module-level equivalents, ``dataclasses.field(default_factory=
+  threading.RLock)`` on a class attribute, and ``make_lock("Name", ...)``
+  from the runtime witness — in which case the *string argument* becomes
+  the lock's id, so static ids and witness ids agree by construction.
+  Other locks get ``Class.attr`` / ``module.attr`` ids.
+* Acquisitions: ``with self.X:`` (including multi-item ``with``). Bare
+  ``.acquire()`` calls are not tracked for ordering (the runtime witness
+  covers them); they don't appear in this codebase outside the witness.
+* Lock-order edges: lock A held (lexically or via the interprocedural
+  closure below) while lock B is acquired → edge A→B. Cycles in the
+  resulting graph are reported as ``lock-order-cycle``.
+* Interprocedural closure: per-function summaries (locks acquired,
+  blocking calls, callees) are joined to a fixpoint. Call resolution is
+  deliberately conservative: bare names resolve to same-module functions
+  or classes (→ ``__init__``), ``self.m()`` to methods of the enclosing
+  class. Unresolvable calls contribute nothing — except the project's
+  known network verbs (``bcast_blob``, ``barrier``, ``probe_and_seed``,
+  …) which are treated as blocking wherever they appear.
+* Blocking calls: ``.get()``/``.join()``/``.wait()`` with no positional
+  args and no ``timeout=``/``block=`` kwarg, ``.recv``/``.recv_into``/
+  ``.accept``/``.connect`` (no ``timeout=``), ``block_until_ready``,
+  ``time.sleep``, ``urlopen``, and the network verbs above.
+* ``# guarded-by: <lock>`` trailing an ``self.X = …`` assignment declares
+  that every mutation of ``self.X`` outside ``__init__`` must hold the
+  named lock (an attr name of a lock in the same class, or a full lock
+  id). ``# guarded-by: <something-in-angle-brackets>`` declares thread
+  confinement instead: mutations through non-``self`` expressions from
+  other classes are flagged, in-class mutations are trusted.
+  ``# holds-lock: <lock>`` trailing a ``def`` line declares a caller-side
+  precondition the analyzer assumes (and propagates) inside that method.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from horovod_tpu.analysis.report import Finding, sort_findings
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+# Calls that block indefinitely regardless of signature.
+ALWAYS_BLOCKING_ATTRS = {
+    "recv", "recv_into", "accept", "connect", "block_until_ready",
+    "serve_forever", "communicate",
+}
+# Project-specific network verbs (socket controller / rendezvous / host
+# collectives): blocking wherever they appear, held lock or not — the
+# finding fires only when a lock is held.
+NETWORK_VERBS = {
+    "bcast_blob", "bcast", "barrier", "gatherv", "bit_and_or",
+    "probe_and_seed", "blocking_key_value_get", "allreduce", "allgatherv",
+    "urlopen", "compute_response_list",
+}
+# Zero-positional-arg calls that block without a timeout kwarg.
+TIMEOUT_GATED_ATTRS = {"get", "join", "wait"}
+
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "pop", "popitem", "popleft", "appendleft",
+    "remove", "clear", "update", "setdefault", "add", "discard", "sort",
+    "reverse", "move_to_end",
+}
+
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*(<[^>]+>|[\w.]+)")
+HOLDS_LOCK_RE = re.compile(r"#\s*holds-lock:\s*([\w.]+)")
+ATTR_ASSIGN_RE = re.compile(r"self\.(\w+)\s*(?::[^=]+)?=")
+
+
+@dataclasses.dataclass
+class LockDef:
+    lock_id: str     # "Class.attr", "module.attr", or make_lock name
+    file: str
+    line: int
+    cls: Optional[str]   # owning class name, if any
+    attr: str            # final attribute / variable name
+
+
+@dataclasses.dataclass
+class FuncSummary:
+    key: str             # "file::Class.meth" or "file::func"
+    file: str
+    symbol: str          # "Class.meth" / "func"
+    line: int
+    # locks this function may acquire (transitively filled by fixpoint)
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    # (desc, line) blocking calls made directly in this function
+    blocking: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+    # resolved callee keys with the locks held at the call site
+    calls: List[Tuple[str, int, Tuple[str, ...]]] = dataclasses.field(default_factory=list)
+    # does this function (transitively) block?
+    blocks: bool = False
+    # representative blocking description for transitive reporting
+    blocks_via: str = ""
+
+
+@dataclasses.dataclass
+class GuardRule:
+    cls: str
+    attr: str
+    guard: str           # lock id, or "<token>" for confinement
+    file: str
+    line: int
+
+    @property
+    def confined(self) -> bool:
+        return self.guard.startswith("<")
+
+
+@dataclasses.dataclass
+class Analysis:
+    findings: List[Finding]
+    edges: List[Tuple[str, str]]          # deduped lock-order edges
+    locks: Dict[str, LockDef]
+    guards: List[GuardRule]
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d not in ("__pycache__",))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def _rel(path: str, root: Optional[str]) -> str:
+    if root:
+        try:
+            return os.path.relpath(path, root).replace(os.sep, "/")
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+def _call_name(node: ast.expr) -> Optional[str]:
+    """Final identifier of a call target: f() → f, a.b.c() → c."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_lock_factory(call: ast.Call) -> bool:
+    name = _call_name(call.func)
+    return name in LOCK_FACTORIES
+
+
+def _make_lock_name(call: ast.Call) -> Optional[str]:
+    """make_lock("Name", ...) → "Name"."""
+    if _call_name(call.func) == "make_lock" and call.args:
+        a = call.args[0]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+def _dataclass_field_lock(call: ast.Call) -> bool:
+    """dataclasses.field(default_factory=threading.RLock) and friends."""
+    if _call_name(call.func) != "field":
+        return False
+    for kw in call.keywords:
+        if kw.arg == "default_factory":
+            v = kw.value
+            if isinstance(v, (ast.Name, ast.Attribute)) and _call_name(v) in LOCK_FACTORIES:
+                return True
+            if isinstance(v, ast.Lambda):
+                b = v.body
+                if isinstance(b, ast.Call) and (_is_lock_factory(b) or _make_lock_name(b)):
+                    return True
+                if isinstance(b, ast.Call) and _call_name(b.func) == "make_lock":
+                    return True
+    return False
+
+
+def _dataclass_field_make_lock_name(call: ast.Call) -> Optional[str]:
+    if _call_name(call.func) != "field":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "default_factory" and isinstance(kw.value, ast.Lambda):
+            b = kw.value.body
+            if isinstance(b, ast.Call):
+                return _make_lock_name(b)
+    return None
+
+
+class _ModuleIndex:
+    """Per-file: classes, functions, lock defs, guard rules."""
+
+    def __init__(self, path: str, rel: str, tree: ast.Module, source_lines: List[str]):
+        self.path = path
+        self.rel = rel
+        self.tree = tree
+        self.lines = source_lines
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}  # module-level only
+        self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        self.locks: Dict[str, LockDef] = {}
+        self.guards: List[GuardRule] = []
+        self._index()
+
+    def _index(self) -> None:
+        modname = os.path.splitext(os.path.basename(self.path))[0]
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+
+        # Lock defs: module-level assigns, class-body AnnAssigns (dataclass
+        # fields), and self.X = Lock() inside any method.
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                self._maybe_register(node.targets, node.value, cls=None,
+                                     modname=modname, line=node.lineno)
+        for cname, cnode in self.classes.items():
+            for sub in cnode.body:
+                if isinstance(sub, ast.AnnAssign) and isinstance(sub.value, ast.Call):
+                    if isinstance(sub.target, ast.Name):
+                        call = sub.value
+                        name = (_make_lock_name(call)
+                                or _dataclass_field_make_lock_name(call))
+                        if name is None and (_is_lock_factory(call)
+                                             or _dataclass_field_lock(call)):
+                            name = f"{cname}.{sub.target.id}"
+                        if name is not None:
+                            self._register(name, cname, sub.target.id, sub.lineno)
+                elif isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                    self._maybe_register(sub.targets, sub.value, cls=cname,
+                                         modname=modname, line=sub.lineno)
+            for (mc, _mn), m in list(self.methods.items()):
+                if mc != cname:
+                    continue
+                for stmt in ast.walk(m):
+                    if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                        for t in stmt.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                call = stmt.value
+                                name = _make_lock_name(call)
+                                if name is None and _is_lock_factory(call):
+                                    name = f"{cname}.{t.attr}"
+                                if name is not None:
+                                    self._register(name, cname, t.attr, stmt.lineno)
+
+        # guarded-by annotations: comment on the same line as a self.X assign.
+        class_ranges = [(c.lineno, c.end_lineno or c.lineno, c.name)
+                        for c in self.classes.values()]
+        for i, text in enumerate(self.lines, start=1):
+            gm = GUARDED_BY_RE.search(text)
+            if not gm:
+                continue
+            am = ATTR_ASSIGN_RE.search(text)
+            if not am:
+                continue
+            cls = None
+            for lo, hi, cname in class_ranges:
+                if lo <= i <= hi:
+                    cls = cname
+                    break
+            if cls is None:
+                continue
+            self.guards.append(GuardRule(cls=cls, attr=am.group(1), guard=gm.group(1),
+                                         file=self.rel, line=i))
+
+    def _maybe_register(self, targets, call: ast.Call, cls: Optional[str],
+                        modname: str, line: int) -> None:
+        name = _make_lock_name(call)
+        is_lock = name is not None or _is_lock_factory(call) or _dataclass_field_lock(call)
+        if not is_lock:
+            return
+        for t in targets:
+            if isinstance(t, ast.Name):
+                lock_id = name or (f"{cls}.{t.id}" if cls else f"{modname}.{t.id}")
+                self._register(lock_id, cls, t.id, line)
+            elif (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                  and t.value.id == "self" and cls):
+                self._register(name or f"{cls}.{t.attr}", cls, t.attr, line)
+
+    def _register(self, lock_id: str, cls: Optional[str], attr: str, line: int) -> None:
+        self.locks[lock_id] = LockDef(lock_id=lock_id, file=self.rel, line=line,
+                                      cls=cls, attr=attr)
+
+    def holds_lock_annotation(self, fn: ast.FunctionDef) -> List[str]:
+        if 1 <= fn.lineno <= len(self.lines):
+            m = HOLDS_LOCK_RE.search(self.lines[fn.lineno - 1])
+            if m:
+                return [m.group(1)]
+        return []
+
+
+class _FunctionWalker(ast.NodeVisitor):
+    """Walks one function body tracking held locks; fills a FuncSummary
+    and emits direct findings (blocking-under-lock, unguarded mutation)."""
+
+    def __init__(self, analyzer: "_Analyzer", mod: _ModuleIndex,
+                 cls: Optional[str], fn: ast.FunctionDef, summary: FuncSummary,
+                 initial_holds: List[str]):
+        self.a = analyzer
+        self.mod = mod
+        self.cls = cls
+        self.fn = fn
+        self.s = summary
+        self.held: List[str] = list(initial_holds)
+        self.findings: List[Finding] = []
+
+    # --- lock resolution -------------------------------------------------
+    def _resolve_lock(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            for lid, d in self.mod.locks.items():
+                if d.cls is None and d.attr == expr.id:
+                    return lid
+            return self.a.unique_lock_by_attr(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" and self.cls:
+                for lid, d in self.a.locks.items():
+                    if d.cls == self.cls and d.attr == expr.attr:
+                        return lid
+            return self.a.unique_lock_by_attr(expr.attr)
+        return None
+
+    def _acquire(self, lock_id: str, line: int) -> None:
+        for held in self.held:
+            if held != lock_id:
+                self.a.add_edge(held, lock_id, self.mod.rel, self.s.symbol, line)
+        self.s.acquires.add(lock_id)
+        self.held.append(lock_id)
+
+    # --- visitors --------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            # `with self._lock:` or `with lock:`; `with self._cv:` too.
+            lid = self._resolve_lock(expr)
+            if lid is None and isinstance(expr, ast.Call):
+                # with self._lock.acquire_timeout(...) style — resolve receiver
+                if isinstance(expr.func, ast.Attribute):
+                    lid = self._resolve_lock(expr.func.value)
+            if lid is not None:
+                self._acquire(lid, node.lineno)
+                acquired.append(lid)
+            else:
+                self.visit(expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # Nested defs (incl. closures handed to threads) are walked with a
+        # fresh held-set: they run later, not under the current locks.
+        self.a.walk_function(self.mod, self.cls, node,
+                             symbol=f"{self.s.symbol}.<{node.name}>", nested=True)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: None  # noqa: E731 — skip lambda bodies
+
+    def visit_Call(self, node: ast.Call) -> None:
+        desc = self._blocking_desc(node)
+        if desc is not None:
+            self.s.blocking.append((desc, node.lineno))
+            if self.held:
+                self.findings.append(Finding(
+                    rule="blocking-under-lock",
+                    file=self.mod.rel, line=node.lineno, symbol=self.s.symbol,
+                    message=f"blocking call {desc} while holding {', '.join(self.held)}",
+                    detail=f"{desc} under {'+'.join(sorted(set(self.held)))}",
+                ))
+        callee = self._resolve_callee(node)
+        if callee is not None:
+            self.s.calls.append((callee, node.lineno, tuple(self.held)))
+        # guarded-by: mutating method calls like self.X.append(...)
+        self._check_mutator_call(node)
+        self.generic_visit(node)
+
+    def _blocking_desc(self, node: ast.Call) -> Optional[str]:
+        kwnames = {kw.arg for kw in node.keywords if kw.arg}
+        fname = _call_name(node.func)
+        if fname is None:
+            return None
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if fname == "sleep" and isinstance(recv, ast.Name) and recv.id == "time":
+                return "time.sleep"
+            if fname in ALWAYS_BLOCKING_ATTRS and "timeout" not in kwnames:
+                return f".{fname}()"
+            if fname in NETWORK_VERBS and "timeout" not in kwnames:
+                return f".{fname}()"
+            if (fname in TIMEOUT_GATED_ATTRS and not node.args
+                    and not kwnames & {"timeout", "block"}):
+                return f".{fname}() without timeout"
+        elif isinstance(node.func, ast.Name):
+            if fname == "sleep":
+                return "sleep"
+            if fname in {"urlopen", "probe_and_seed"}:
+                return fname
+        return None
+
+    def _resolve_callee(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in self.mod.functions:
+                return f"{self.mod.rel}::{f.id}"
+            if f.id in self.mod.classes and (f.id, "__init__") in self.mod.methods:
+                return f"{self.mod.rel}::{f.id}.__init__"
+            return None
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and self.cls):
+            if (self.cls, f.attr) in self.mod.methods:
+                return f"{self.mod.rel}::{self.cls}.{f.attr}"
+        return None
+
+    # --- guarded-by ------------------------------------------------------
+    def _guard_for(self, attr: str) -> Optional[GuardRule]:
+        if not self.cls:
+            return None
+        for g in self.a.guards:
+            if g.cls == self.cls and g.attr == attr:
+                return g
+        return None
+
+    def _guard_lock_id(self, g: GuardRule) -> Optional[str]:
+        if g.confined:
+            return None
+        if "." in g.guard:
+            return g.guard
+        for lid, d in self.a.locks.items():
+            if d.cls == g.cls and d.attr == g.guard:
+                return lid
+        return g.guard  # unresolved name — compare literally
+
+    def _flag_mutation(self, attr: str, line: int, how: str) -> None:
+        if self.fn.name == "__init__":
+            return
+        g = self._guard_for(attr)
+        if g is None:
+            return
+        if g.confined:
+            return  # in-class mutations trusted under confinement
+        lid = self._guard_lock_id(g)
+        if lid is not None and lid not in self.held:
+            held = ", ".join(self.held) if self.held else "no lock"
+            self.findings.append(Finding(
+                rule="unguarded-mutation",
+                file=self.mod.rel, line=line, symbol=self.s.symbol,
+                message=f"{how} of self.{attr} (guarded-by {lid}) while holding {held}",
+                detail=f"self.{attr} guarded-by {lid}",
+            ))
+
+    def _self_attr(self, expr: ast.expr) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return expr.attr
+        return None
+
+    def _check_store_target(self, t: ast.expr, line: int) -> None:
+        attr = self._self_attr(t)
+        if attr is not None:
+            self._flag_mutation(attr, line, "assignment")
+            return
+        if isinstance(t, ast.Subscript):
+            attr = self._self_attr(t.value)
+            if attr is not None:
+                self._flag_mutation(attr, line, "item store")
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._check_store_target(e, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_store_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_store_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_store_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._check_store_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def _check_mutator_call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATOR_METHODS:
+            attr = self._self_attr(f.value)
+            if attr is not None:
+                self._flag_mutation(attr, node.lineno, f".{f.attr}()")
+
+
+class _Analyzer:
+    def __init__(self, root: Optional[str]):
+        self.root = root
+        self.modules: List[_ModuleIndex] = []
+        self.locks: Dict[str, LockDef] = {}
+        self.guards: List[GuardRule] = []
+        self.summaries: Dict[str, FuncSummary] = {}
+        self.findings: List[Finding] = []
+        # edge -> (file, symbol, line) of first sighting
+        self.edges: Dict[Tuple[str, str], Tuple[str, str, int]] = {}
+        self._attr_index: Dict[str, List[str]] = {}
+
+    # -- setup ------------------------------------------------------------
+    def load(self, paths: Sequence[str]) -> None:
+        for path in _iter_py_files(paths):
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError as e:
+                self.findings.append(Finding(
+                    rule="parse-error", file=_rel(path, self.root),
+                    line=e.lineno or 0, symbol="<module>",
+                    message=f"syntax error: {e.msg}", detail=str(e.msg)))
+                continue
+            mod = _ModuleIndex(path, _rel(path, self.root), tree, src.splitlines())
+            self.modules.append(mod)
+        for mod in self.modules:
+            self.locks.update(mod.locks)
+            self.guards.extend(mod.guards)
+        self._attr_index.clear()
+        for lid, d in self.locks.items():
+            self._attr_index.setdefault(d.attr, []).append(lid)
+
+    def unique_lock_by_attr(self, attr: str) -> Optional[str]:
+        cands = self._attr_index.get(attr, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def add_edge(self, a: str, b: str, file: str, symbol: str, line: int) -> None:
+        self.edges.setdefault((a, b), (file, symbol, line))
+
+    # -- function walking -------------------------------------------------
+    def walk_function(self, mod: _ModuleIndex, cls: Optional[str],
+                      fn: ast.FunctionDef, symbol: Optional[str] = None,
+                      nested: bool = False) -> FuncSummary:
+        symbol = symbol or (f"{cls}.{fn.name}" if cls else fn.name)
+        key = f"{mod.rel}::{symbol}"
+        if key in self.summaries:
+            return self.summaries[key]
+        s = FuncSummary(key=key, file=mod.rel, symbol=symbol, line=fn.lineno)
+        self.summaries[key] = s
+        holds = []
+        for name in mod.holds_lock_annotation(fn):
+            lid = name if "." in name else None
+            if lid is None and cls:
+                for cand, d in self.locks.items():
+                    if d.cls == cls and d.attr == name:
+                        lid = cand
+                        break
+            holds.append(lid or name)
+        w = _FunctionWalker(self, mod, cls, fn, s, holds)
+        for stmt in fn.body:
+            w.visit(stmt)
+        self.findings.extend(w.findings)
+        if s.blocking:
+            s.blocks = True
+            s.blocks_via = s.blocking[0][0]
+        return s
+
+    def run(self) -> None:
+        for mod in self.modules:
+            for fname, fn in mod.functions.items():
+                self.walk_function(mod, None, fn)
+            for (cls, _m), fn in mod.methods.items():
+                self.walk_function(mod, cls, fn)
+        self._fixpoint()
+        self._find_cycles()
+
+    # -- interprocedural closure -----------------------------------------
+    def _fixpoint(self) -> None:
+        # Propagate (a) blocking-ness and (b) acquired locks up the call
+        # graph, adding edges/findings at call sites that hold locks.
+        changed = True
+        reported: Set[Tuple[str, str, int]] = set()
+        while changed:
+            changed = False
+            for s in self.summaries.values():
+                for callee_key, line, held in s.calls:
+                    callee = self.summaries.get(callee_key)
+                    if callee is None:
+                        continue
+                    # transitive lock acquisition → order edges from held locks
+                    for lid in callee.acquires:
+                        if lid not in s.acquires:
+                            s.acquires.add(lid)
+                            changed = True
+                        for h in held:
+                            if h != lid and (h, lid) not in self.edges:
+                                self.add_edge(h, lid, s.file, s.symbol, line)
+                                changed = True
+                    # transitive blocking under a held lock
+                    if callee.blocks:
+                        if not s.blocks:
+                            s.blocks = True
+                            s.blocks_via = f"{callee.symbol} → {callee.blocks_via}"
+                            changed = True
+                        if held:
+                            sig = (s.key, callee_key, line)
+                            if sig not in reported:
+                                reported.add(sig)
+                                self.findings.append(Finding(
+                                    rule="blocking-under-lock",
+                                    file=s.file, line=line, symbol=s.symbol,
+                                    message=(f"call to {callee.symbol} (blocks via "
+                                             f"{callee.blocks_via}) while holding "
+                                             f"{', '.join(held)}"),
+                                    detail=(f"{callee.symbol} under "
+                                            f"{'+'.join(sorted(set(held)))}"),
+                                ))
+
+    # -- cycle detection --------------------------------------------------
+    def _find_cycles(self) -> None:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        # Tarjan SCC
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        sccs: List[List[str]] = []
+
+        def strong(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w_ in sorted(graph[v]):
+                if w_ not in index:
+                    strong(w_)
+                    low[v] = min(low[v], low[w_])
+                elif w_ in on:
+                    low[v] = min(low[v], index[w_])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w_ = stack.pop()
+                    on.discard(w_)
+                    comp.append(w_)
+                    if w_ == v:
+                        break
+                sccs.append(comp)
+
+        for v in sorted(graph):
+            if v not in index:
+                strong(v)
+        for comp in sccs:
+            cyclic = len(comp) > 1 or (comp[0] in graph[comp[0]])
+            if not cyclic:
+                continue
+            comp = sorted(comp)
+            sites = []
+            for (a, b), (file, sym, line) in sorted(self.edges.items()):
+                if a in comp and b in comp:
+                    sites.append((file, sym, line, a, b))
+            file, sym, line = (sites[0][:3] if sites else ("<graph>", "<graph>", 0))
+            edge_desc = "; ".join(f"{a}→{b} at {f}:{ln} ({s})" for f, s, ln, a, b in sites)
+            self.findings.append(Finding(
+                rule="lock-order-cycle", file=file, line=line, symbol=sym,
+                message=f"lock-order cycle between {', '.join(comp)}: {edge_desc}",
+                detail="cycle:" + "|".join(comp),
+            ))
+
+
+def analyze_paths(paths: Sequence[str], root: Optional[str] = None) -> Analysis:
+    a = _Analyzer(root=root)
+    a.load(list(paths))
+    a.run()
+    return Analysis(findings=sort_findings(a.findings),
+                    edges=sorted(a.edges),
+                    locks=a.locks,
+                    guards=a.guards)

@@ -1,0 +1,46 @@
+"""Finding model shared by every analysis pass.
+
+A finding's *fingerprint* deliberately excludes line numbers: it hashes
+(rule, file, symbol, detail) so a baseline suppression survives unrelated
+edits that shift lines, but goes stale the moment the offending code (or
+its enclosing symbol) actually changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str       # e.g. "blocking-under-lock", "lock-order-cycle"
+    file: str       # repo-relative posix path
+    line: int       # 1-based; informational only (not fingerprinted)
+    symbol: str     # enclosing "Class.method" / "function" / "<module>"
+    message: str    # human-readable one-liner
+    detail: str = ""  # stable discriminator (lock ids, callee, edge list)
+
+    @property
+    def fingerprint(self) -> str:
+        blob = "|".join((self.rule, self.file, self.symbol, self.detail or self.message))
+        return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "detail": self.detail,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.symbol}: {self.message}"
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.file, f.line, f.rule, f.detail))

@@ -1,0 +1,427 @@
+"""Runtime deadlock witness (``HOROVOD_DEBUG_LOCKS=1``).
+
+``make_lock(name)`` is a drop-in replacement for ``threading.Lock()`` /
+``threading.RLock()`` used by the runtime's own locks. With the knob off
+it returns a plain stdlib lock — zero overhead, identical semantics.
+With it on it returns a :class:`DebugLock` that:
+
+* records per-thread acquisition stacks and the pairwise acquisition
+  order actually observed, flagging ``lock-order-inversion`` the moment
+  two locks are ever taken in both orders (with both stacks);
+* flags ``self-deadlock`` (re-acquiring a non-reentrant lock on the same
+  thread) by raising immediately instead of hanging forever;
+* detects live waits-for cycles while blocked (``deadlock`` violation,
+  recorded with every participant's stack — the witness keeps waiting so
+  the hang is observable, it does not break the deadlock);
+* warns on holds longer than ``HOROVOD_LOCK_HOLD_WARN_SECONDS``
+  (default 5.0) via a watchdog thread (``lock-hold`` violation);
+* emits ``lock_acquire`` / ``lock_hold`` events into the flight recorder
+  and registers a ``locks`` state provider so crash dumps show who held
+  what.
+
+Lock names are chosen to match the static analyzer's ids
+(``Class.attr``), so :func:`check_static_consistency` can assert the
+static lock-order graph's claimed order against the runtime-observed
+edges in tier-1 tests.
+
+This module imports only the stdlib at top level; the flight recorder is
+imported lazily inside emit paths to avoid import cycles.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from horovod_tpu.utils.env import (DEFAULT_LOCK_HOLD_WARN_SECONDS,
+                                   HOROVOD_DEBUG_LOCKS,
+                                   HOROVOD_LOCK_HOLD_WARN_SECONDS,
+                                   _get_bool, _get_float)
+
+_DEADLOCK_POLL_SECONDS = 0.25
+
+
+def enabled() -> bool:
+    # Read at lock-creation time (not from Config): runtime locks can be
+    # constructed before hvd.init() parses the Config.
+    return _get_bool(HOROVOD_DEBUG_LOCKS)
+
+
+def hold_warn_seconds() -> float:
+    return _get_float(HOROVOD_LOCK_HOLD_WARN_SECONDS,
+                      DEFAULT_LOCK_HOLD_WARN_SECONDS)
+
+
+def _stack(skip: int = 2) -> str:
+    return "".join(traceback.format_stack()[:-skip])
+
+
+def _emit(kind: str, **fields) -> None:
+    try:
+        from horovod_tpu import flight_recorder
+        flight_recorder.emit(kind, **fields)
+    except Exception:
+        pass
+
+
+class _HeldRec:
+    __slots__ = ("lock", "t_acquired", "stack", "warned")
+
+    def __init__(self, lock: "DebugLock", t_acquired: float, stack: str):
+        self.lock = lock
+        self.t_acquired = t_acquired
+        self.stack = stack
+        self.warned = False
+
+
+class _Witness:
+    """Process-wide singleton. Its own plain mutex (never a DebugLock)
+    guards all bookkeeping; emit/IO happens outside it."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # (a, b) -> (thread_name, stack) of the first time b was acquired
+        # while a was held.
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._violations: List[Dict[str, object]] = []
+        self._held: Dict[int, List[_HeldRec]] = {}
+        # tid -> lock currently blocked on
+        self._waiting: Dict[int, "DebugLock"] = {}
+        self._reported_cycles: Set[Tuple[str, ...]] = set()
+        self._watchdog: Optional[threading.Thread] = None
+        self._provider_registered = False
+
+    # -- lifecycle --------------------------------------------------------
+    def ensure_started(self) -> None:
+        with self._mu:
+            if self._watchdog is None or not self._watchdog.is_alive():
+                t = threading.Thread(target=self._watch, name="hvd-lock-witness",
+                                     daemon=True)
+                self._watchdog = t
+                t.start()
+        self._register_provider()
+
+    def _register_provider(self) -> None:
+        if self._provider_registered:
+            return
+        try:
+            from horovod_tpu import flight_recorder
+            flight_recorder.set_state_provider("locks", self.debug_state)
+            self._provider_registered = True
+        except Exception:
+            pass
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._violations.clear()
+            self._reported_cycles.clear()
+            # held/waiting reflect live lock state; don't clear them.
+
+    # -- accessors --------------------------------------------------------
+    def violations(self) -> List[Dict[str, object]]:
+        with self._mu:
+            return [dict(v) for v in self._violations]
+
+    def order_edges(self) -> List[Tuple[str, str]]:
+        with self._mu:
+            return sorted(self._edges)
+
+    def debug_state(self) -> Dict[str, object]:
+        with self._mu:
+            held = {
+                str(tid): [{"lock": r.lock.name,
+                            "held_s": round(time.monotonic() - r.t_acquired, 3)}
+                           for r in recs]
+                for tid, recs in self._held.items() if recs
+            }
+            return {
+                "enabled": True,
+                "held": held,
+                "waiting": {str(t): l.name for t, l in self._waiting.items()},
+                "edges": ["%s->%s" % e for e in sorted(self._edges)],
+                "violations": len(self._violations),
+            }
+
+    def _add_violation(self, kind: str, message: str, **fields) -> None:
+        v = {"kind": kind, "message": message}
+        v.update(fields)
+        self._violations.append(v)
+
+    # -- acquisition tracking ---------------------------------------------
+    def note_acquired(self, lock: "DebugLock", wait_s: float) -> None:
+        tid = threading.get_ident()
+        tname = threading.current_thread().name
+        stack = _stack(skip=3)
+        inversion = None
+        with self._mu:
+            recs = self._held.setdefault(tid, [])
+            for rec in recs:
+                a, b = rec.lock.name, lock.name
+                if a == b:
+                    continue
+                if (a, b) not in self._edges:
+                    self._edges[(a, b)] = (tname, stack)
+                    rev = self._edges.get((b, a))
+                    if rev is not None:
+                        inversion = (a, b, rev)
+            recs.append(_HeldRec(lock, time.monotonic(), stack))
+            if inversion is not None:
+                a, b, (rev_thread, rev_stack) = inversion
+                self._add_violation(
+                    "lock-order-inversion",
+                    f"{a} -> {b} acquired on thread {tname} but {b} -> {a} "
+                    f"was previously observed on thread {rev_thread}",
+                    locks=[a, b], thread=tname,
+                    stack=stack, prior_stack=rev_stack,
+                )
+        _emit("lock_acquire", lock=lock.name, thread=tname,
+              wait_s=round(wait_s, 6))
+        if inversion is not None:
+            a, b, _ = inversion
+            _emit("lock_order_inversion", first=a, second=b, thread=tname)
+
+    def note_released(self, lock: "DebugLock") -> None:
+        tid = threading.get_ident()
+        hold_s = None
+        with self._mu:
+            recs = self._held.get(tid, [])
+            for i in range(len(recs) - 1, -1, -1):
+                if recs[i].lock is lock:
+                    rec = recs.pop(i)
+                    hold_s = time.monotonic() - rec.t_acquired
+                    break
+        if hold_s is not None and hold_s > hold_warn_seconds():
+            tname = threading.current_thread().name
+            with self._mu:
+                self._add_violation(
+                    "lock-hold",
+                    f"{lock.name} held {hold_s:.2f}s on thread {tname} "
+                    f"(warn threshold {hold_warn_seconds():.2f}s)",
+                    lock=lock.name, thread=tname, hold_s=round(hold_s, 3),
+                )
+            _emit("lock_hold", lock=lock.name, thread=tname,
+                  hold_s=round(hold_s, 3))
+
+    # -- waits-for deadlock detection -------------------------------------
+    def note_waiting(self, lock: "DebugLock") -> None:
+        with self._mu:
+            self._waiting[threading.get_ident()] = lock
+
+    def note_wait_done(self) -> None:
+        with self._mu:
+            self._waiting.pop(threading.get_ident(), None)
+
+    def check_deadlock(self) -> Optional[List[str]]:
+        """Follow the waits-for chain from this thread; record a
+        ``deadlock`` violation if it cycles back."""
+        me = threading.get_ident()
+        with self._mu:
+            chain: List[int] = [me]
+            locks: List[str] = []
+            tid = me
+            while True:
+                lock = self._waiting.get(tid)
+                if lock is None:
+                    return None
+                locks.append(lock.name)
+                owner = lock.owner
+                if owner is None:
+                    return None
+                if owner == me:
+                    sig = tuple(sorted(set(locks)))
+                    if sig in self._reported_cycles:
+                        return locks
+                    self._reported_cycles.add(sig)
+                    stacks = {
+                        str(t): [r.stack for r in self._held.get(t, [])][-1:]
+                        for t in chain
+                    }
+                    self._add_violation(
+                        "deadlock",
+                        "waits-for cycle: " + " -> ".join(locks + [locks[0]]),
+                        locks=sorted(set(locks)),
+                        threads=[str(t) for t in chain],
+                        stacks=stacks,
+                    )
+                    break
+                if owner in chain:
+                    return None  # cycle not through us; its members report it
+                chain.append(owner)
+                tid = owner
+        _emit("lock_deadlock", locks=sorted(set(locks)))
+        return locks
+
+    # -- hold-time watchdog -----------------------------------------------
+    def _watch(self) -> None:
+        while True:
+            time.sleep(max(0.2, min(1.0, hold_warn_seconds() / 2.0)))
+            warn = hold_warn_seconds()
+            now = time.monotonic()
+            events = []
+            with self._mu:
+                for tid, recs in self._held.items():
+                    for rec in recs:
+                        held_s = now - rec.t_acquired
+                        if held_s > warn and not rec.warned:
+                            rec.warned = True
+                            self._add_violation(
+                                "lock-hold",
+                                f"{rec.lock.name} held {held_s:.2f}s (still "
+                                f"held) on thread {tid} (warn threshold "
+                                f"{warn:.2f}s)",
+                                lock=rec.lock.name, thread=str(tid),
+                                hold_s=round(held_s, 3), stack=rec.stack,
+                            )
+                            events.append((rec.lock.name, tid, held_s))
+            for name, tid, held_s in events:
+                _emit("lock_hold", lock=name, thread=str(tid),
+                      hold_s=round(held_s, 3), still_held=True)
+
+
+_witness = _Witness()
+
+
+class DebugLock:
+    """Witness-instrumented lock. Context-manager compatible with
+    ``threading.Lock`` / ``threading.RLock``."""
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self.reentrant = reentrant
+        self._inner = threading.Lock()
+        self.owner: Optional[int] = None
+        self._depth = 0
+        _witness.ensure_started()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self.owner == me:
+            if self.reentrant:
+                self._depth += 1
+                return True
+            raise RuntimeError(
+                self._record_self_deadlock())
+        if self._inner.acquire(blocking=False):
+            self._on_acquired(me, 0.0)
+            return True
+        if not blocking:
+            return False
+        t0 = time.monotonic()
+        deadline = None if timeout is None or timeout < 0 else t0 + timeout
+        _witness.note_waiting(self)
+        try:
+            while True:
+                step = _DEADLOCK_POLL_SECONDS
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    step = min(step, remaining)
+                if self._inner.acquire(timeout=step):
+                    self._on_acquired(me, time.monotonic() - t0)
+                    return True
+                _witness.check_deadlock()
+        finally:
+            _witness.note_wait_done()
+
+    def _record_self_deadlock(self) -> str:
+        msg = (f"self-deadlock: thread {threading.current_thread().name} "
+               f"re-acquired non-reentrant lock {self.name}")
+        with _witness._mu:
+            _witness._add_violation("self-deadlock", msg, lock=self.name,
+                                    thread=threading.current_thread().name,
+                                    stack=_stack(skip=3))
+        _emit("lock_self_deadlock", lock=self.name)
+        return msg
+
+    def _on_acquired(self, me: int, wait_s: float) -> None:
+        self.owner = me
+        self._depth = 1
+        _witness.note_acquired(self, wait_s)
+
+    def release(self) -> None:
+        me = threading.get_ident()
+        if self.owner != me:
+            raise RuntimeError(f"release of {self.name} by non-owner thread")
+        self._depth -= 1
+        if self._depth > 0:
+            return
+        _witness.note_released(self)
+        self.owner = None
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<DebugLock {self.name} owner={self.owner}>"
+
+
+def make_lock(name: str, reentrant: bool = False):
+    """Runtime lock factory: plain stdlib lock normally, DebugLock under
+    ``HOROVOD_DEBUG_LOCKS=1``. ``name`` must match the static analyzer's
+    id for the lock (``Class.attr``) — that is what lets tier-1 tests
+    assert the static order graph against runtime observations."""
+    if enabled():
+        return DebugLock(name, reentrant=reentrant)
+    return threading.RLock() if reentrant else threading.Lock()
+
+
+def violations() -> List[Dict[str, object]]:
+    return _witness.violations()
+
+
+def order_edges() -> List[Tuple[str, str]]:
+    return _witness.order_edges()
+
+
+def reset() -> None:
+    _witness.reset()
+
+
+def check_static_consistency(
+        static_edges: Sequence[Tuple[str, str]]) -> List[str]:
+    """Compare runtime-observed lock-order edges against the static
+    graph: an observed edge b→a whose reverse a→b is reachable in the
+    static graph is a conflict (the static analysis claimed one order,
+    the runtime exhibited the other)."""
+    # transitive closure of the static graph
+    adj: Dict[str, Set[str]] = {}
+    for a, b in static_edges:
+        adj.setdefault(a, set()).add(b)
+    closure: Dict[str, Set[str]] = {}
+
+    def reach(v: str) -> Set[str]:
+        if v in closure:
+            return closure[v]
+        closure[v] = set()
+        out: Set[str] = set()
+        stack = [v]
+        seen = {v}
+        while stack:
+            n = stack.pop()
+            for m in adj.get(n, ()):
+                if m not in seen:
+                    seen.add(m)
+                    out.add(m)
+                    stack.append(m)
+        closure[v] = out
+        return out
+
+    conflicts = []
+    for b, a in order_edges():
+        if b in reach(a):
+            conflicts.append(
+                f"runtime edge {b}->{a} contradicts static order {a}=>…=>{b}")
+    return conflicts

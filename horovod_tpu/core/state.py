@@ -10,9 +10,9 @@ pointer soup — components attach lazily and are torn down in ``shutdown()``.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import TYPE_CHECKING, Any, Optional
 
+from horovod_tpu.analysis.witness import make_lock
 from horovod_tpu.utils.env import Config
 
 if TYPE_CHECKING:
@@ -41,7 +41,11 @@ class GlobalState:
     parameter_manager: Any = None
     controller: Any = None
 
-    lock: threading.RLock = dataclasses.field(default_factory=threading.RLock)
+    # Reentrant: init/shutdown paths re-enter through basics helpers.
+    # make_lock gives the deadlock witness visibility under
+    # HOROVOD_DEBUG_LOCKS=1 and is a plain RLock otherwise.
+    lock: Any = dataclasses.field(
+        default_factory=lambda: make_lock("GlobalState.lock", reentrant=True))
 
 
 _global_state = GlobalState()

@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from horovod_tpu import flight_recorder
+from horovod_tpu.analysis import witness
 from horovod_tpu.core import basics
 from horovod_tpu.elastic import fault_inject
 from horovod_tpu.metrics import COMMIT_BUCKETS, registry as _metrics
@@ -91,9 +92,9 @@ class State:
         self._spill_dir = spill_dir or os.environ.get(
             HOROVOD_ELASTIC_SPILL_DIR, "")
         self._spill_sync = _get_bool(HOROVOD_ELASTIC_SPILL_SYNC)
-        self._spill_lock = threading.Lock()
-        self._spill_next: Optional[tuple] = None
-        self._spill_thread: Optional[threading.Thread] = None
+        self._spill_lock = witness.make_lock("State._spill_lock")
+        self._spill_next: Optional[tuple] = None  # guarded-by: _spill_lock
+        self._spill_thread: Optional[threading.Thread] = None  # guarded-by: _spill_lock
         self._reset_callbacks: list = []
 
     # -- subclass surface --------------------------------------------------
@@ -191,7 +192,7 @@ class ObjectState(State):
 
     def __init__(self, spill_dir: Optional[str] = None, **kwargs):
         super().__init__(spill_dir=spill_dir)
-        self._saved: Dict[str, bytes] = {}
+        self._saved: Dict[str, bytes] = {}  # guarded-by: <owner-thread>
         for key, value in kwargs.items():
             setattr(self, key, value)
         self.save()
@@ -233,7 +234,7 @@ class ArrayState(State):
         self._tree_names = ["params", "optimizer"] + sorted(trees)
         for name, tree in trees.items():
             setattr(self, name, tree)
-        self._saved: Dict[str, Any] = {}
+        self._saved: Dict[str, Any] = {}  # guarded-by: <owner-thread>
         self.save()
 
     def save(self) -> None:

@@ -45,6 +45,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from horovod_tpu.analysis import witness
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.utils import logging as log
 from horovod_tpu.utils.env import (DEFAULT_FLIGHT_RECORDER_CAPACITY,
@@ -123,10 +124,10 @@ class FlightRecorder:
         self.rank = self.launch_rank
         self.dir = os.environ.get(HOROVOD_FLIGHT_RECORDER_DIR, "")
         self._providers: Dict[str, Callable[[], Any]] = {}
-        self._dump_history: List[dict] = []
+        self._dump_history: List[dict] = []  # guarded-by: _dump_lock
         self._clock_offset: Optional[float] = None
         self._offset_checked = False
-        self._dump_lock = threading.Lock()
+        self._dump_lock = witness.make_lock("FlightRecorder._dump_lock")
         self._last_failure_dump = 0.0
 
     # -- hot path -----------------------------------------------------------
@@ -216,13 +217,20 @@ class FlightRecorder:
         wins; earlier reasons survive in ``dump_history``) and ship the
         JSON to the launcher's rendezvous store when one is configured.
         Never raises — this runs on paths that are already failing."""
+        # Build the snapshot before taking the lock: the first snapshot
+        # estimates the clock offset over HTTP, and concurrent dumpers
+        # (signal handler, stall shutdown, dying cycle thread) must not
+        # queue behind that round-trip.
+        snap = self.snapshot(reason)
+        payload = None
+        target = path or self.dir
         with self._dump_lock:
-            snap = self.snapshot(reason)
             self._dump_history.append(
                 {"reason": reason, "t": snap["wall_time"]})
-            payload = None
-            target = path or self.dir
+            snap["dump_history"] = list(self._dump_history)
             if target:
+                # File write stays serialized so concurrent dumps are
+                # last-wins whole files, never interleaved.
                 try:
                     out = self._dump_path(target)
                     parent = os.path.dirname(out)
@@ -235,13 +243,14 @@ class FlightRecorder:
                 except (OSError, TypeError, ValueError) as exc:
                     log.warning("flight recorder: dump to %r failed: %s",
                                 target, exc)
-            if ship:
-                try:
-                    self._ship(payload if payload is not None
-                               else json.dumps(snap))
-                except Exception as exc:
-                    log.debug("flight recorder: ship failed: %s", exc)
-            return snap
+        # Shipping is a rendezvous HTTP round-trip — never under the lock.
+        if ship:
+            try:
+                self._ship(payload if payload is not None
+                           else json.dumps(snap))
+            except Exception as exc:
+                log.debug("flight recorder: ship failed: %s", exc)
+        return snap
 
     def _ship(self, payload: str) -> None:
         dest = _rendezvous_addr()

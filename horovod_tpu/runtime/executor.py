@@ -41,6 +41,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import flight_recorder
 from horovod_tpu import timeline as timeline_mod
+from horovod_tpu.analysis import witness
 from horovod_tpu.core import mesh as mesh_mod
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.ops import collectives
@@ -279,8 +280,8 @@ class Executor:
     def __init__(self, mesh, net=None):
         self.mesh = mesh
         self.net = net
-        self._programs: Dict[tuple, Any] = {}
-        self._lock = threading.Lock()
+        self._programs: Dict[tuple, Any] = {}  # guarded-by: _lock
+        self._lock = witness.make_lock("Executor._lock")
         # typed workers-down verdict from a data-plane failure (see
         # _PendingOp.fail_exc); lifted by the runtime's cycle body
         self.failure = None

@@ -27,6 +27,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from horovod_tpu.analysis import witness
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.runtime import types
 from horovod_tpu.utils import env as env_mod
@@ -110,9 +111,9 @@ class FusionBufferManager:
     def __init__(self,
                  quantum_bytes: int = DEFAULT_BUCKET_QUANTUM_BYTES) -> None:
         self.quantum_bytes = int(quantum_bytes)
-        self._free: Dict[Tuple[int, int, str], List[np.ndarray]] = {}
-        self._lock = threading.Lock()
-        self._total_bytes = 0
+        self._free: Dict[Tuple[int, int, str], List[np.ndarray]] = {}  # guarded-by: _lock
+        self._lock = witness.make_lock("FusionBufferManager._lock")
+        self._total_bytes = 0  # guarded-by: _lock
 
     def bucket_elems(self, nelems: int, itemsize: int) -> int:
         return bucket_elems(nelems, itemsize, self.quantum_bytes)

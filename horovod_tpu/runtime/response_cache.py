@@ -57,14 +57,17 @@ class CacheState(enum.Enum):
 class ResponseCache:
     def __init__(self, capacity: int = 1024):
         self.capacity = capacity
+        # The cache is deliberately lock-free: every mutation happens on
+        # the background cycle thread (hvd-analyze checks the confinement
+        # annotations below — external writes are flagged).
         # bit -> (response, params_key); OrderedDict gives LRU order
-        self._entries: "OrderedDict[int, Tuple[msg.Response, tuple]]" = OrderedDict()
-        self._name_to_bit: Dict[str, int] = {}
-        self._next_bit = 0
+        self._entries: "OrderedDict[int, Tuple[msg.Response, tuple]]" = OrderedDict()  # guarded-by: <cycle-thread>
+        self._name_to_bit: Dict[str, int] = {}  # guarded-by: <cycle-thread>
+        self._next_bit = 0  # guarded-by: <cycle-thread>
         # bits freed by eviction/invalidation, reused lowest-first so the
         # bitvector stays bounded by capacity (the reference keeps bits
         # < capacity and redistributes, response_cache.cc:232+)
-        self._free_bits: list[int] = []
+        self._free_bits: list[int] = []  # guarded-by: <cycle-thread>
 
     def _alloc_bit(self) -> int:
         if self._free_bits:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List
 
+from horovod_tpu.analysis import witness
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.runtime import message as msg
 from horovod_tpu.runtime import types
@@ -31,10 +32,10 @@ class DuplicateNameError(ValueError):
 
 class TensorQueue:
     def __init__(self):
-        self._lock = threading.Lock()
-        self._table: Dict[str, types.TensorTableEntry] = {}
-        self._pending: List[tuple] = []  # (-priority, seq, request)
-        self._seq = 0
+        self._lock = witness.make_lock("TensorQueue._lock")
+        self._table: Dict[str, types.TensorTableEntry] = {}  # guarded-by: _lock
+        self._pending: List[tuple] = []  # (-priority, seq, request); guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
 
     def add(self, entry: types.TensorTableEntry, request: msg.Request) -> None:
         """reference: TensorQueue::AddToTensorQueue (tensor_queue.cc:18-36)."""

@@ -55,6 +55,45 @@ HOROVOD_PROFILE = "HOROVOD_PROFILE"
 HOROVOD_PROFILE_DIR = "HOROVOD_PROFILE_DIR"
 HOROVOD_PROFILE_HISTORY = "HOROVOD_PROFILE_HISTORY"
 HOROVOD_PROFILE_JAX = "HOROVOD_PROFILE_JAX"
+# deadlock witness (analysis/witness.py): instrument runtime locks,
+# record acquisition order, flag inversions / live deadlocks / long holds
+HOROVOD_DEBUG_LOCKS = "HOROVOD_DEBUG_LOCKS"
+HOROVOD_LOCK_HOLD_WARN_SECONDS = "HOROVOD_LOCK_HOLD_WARN_SECONDS"
+
+# Knobs read at their point of use rather than parsed into Config —
+# launcher/rendezvous wiring that exists before hvd.init() runs, elastic
+# re-form parameters rewritten between generations, and test/debug
+# switches. Registered here so tools/check_env_knobs.py can verify the
+# complete catalog lives in this module: a knob missing from both Config
+# and this tuple fails CI as UNREGISTERED.
+ENV_DIRECT_KNOBS = (
+    # identity / wiring injected by the launcher before init
+    "HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+    "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
+    "HOROVOD_CONTROLLER", "HOROVOD_COORDINATOR_ADDR", "HOROVOD_HOSTNAME",
+    "HOROVOD_PROCESS_ID", "HOROVOD_SECRET_KEY", "HOROVOD_TASK_KEY",
+    "HOROVOD_NP", "HOROVOD_NUM_PROCESSES",
+    # rendezvous / gloo-compatible store
+    "HOROVOD_GLOO_RENDEZVOUS_ADDR", "HOROVOD_GLOO_RENDEZVOUS_PORT",
+    "HOROVOD_GLOO_TIMEOUT_SECONDS", "HOROVOD_RENDEZVOUS_HTTP_ADDR",
+    "HOROVOD_RENDEZVOUS_HTTP_PORT", "HOROVOD_RENDEZVOUS_HEARTBEAT_TTL",
+    "HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS", "HOROVOD_PROBE_TIMEOUT",
+    # launcher backends / host discovery
+    "HOROVOD_LAUNCH_BACKEND", "HOROVOD_NIC_DISCOVERY",
+    "HOROVOD_GCLOUD_PROJECT", "HOROVOD_GCLOUD_ZONE",
+    # elastic re-form parameters (rewritten per generation)
+    "HOROVOD_ELASTIC_MIN_WORKERS", "HOROVOD_ELASTIC_MAX_RETRIES",
+    "HOROVOD_ELASTIC_BACKOFF_BASE_SECONDS",
+    "HOROVOD_ELASTIC_BACKOFF_MAX_SECONDS",
+    "HOROVOD_ELASTIC_DISCOVERY_INTERVAL_SECONDS",
+    "HOROVOD_ELASTIC_HEARTBEAT_SECONDS",
+    "HOROVOD_ELASTIC_REJOIN_TIMEOUT_SECONDS",
+    "HOROVOD_ELASTIC_SETTLE_SECONDS",
+    "HOROVOD_ELASTIC_SPILL_DIR", "HOROVOD_ELASTIC_SPILL_SYNC",
+    # native/build/test switches
+    "HOROVOD_NATIVE_CYCLE", "HOROVOD_TPU_WITHOUT_NATIVE",
+    "HOROVOD_PALLAS_INTERPRET", "HOROVOD_FAULT_INJECT",
+)
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379
 DEFAULT_CYCLE_TIME_MS = 5.0  # reference: operations.cc:386
@@ -64,6 +103,7 @@ DEFAULT_FUSION_BUCKET_QUANTUM_BYTES = 64 * 1024
 DEFAULT_FLIGHT_RECORDER_CAPACITY = 2048
 DEFAULT_STRAGGLER_REPORT_SECONDS = 60.0
 DEFAULT_PROFILE_HISTORY = 64
+DEFAULT_LOCK_HOLD_WARN_SECONDS = 5.0
 
 
 def _get_int(name: str, default: int) -> int:
@@ -159,6 +199,11 @@ class Config:
     profile_history: int = DEFAULT_PROFILE_HISTORY
     # additionally capture a jax.profiler device trace into the profile dir
     profile_jax: bool = False
+    # deadlock witness: runtime locks become order/hold-tracking DebugLocks
+    # (analysis/witness.py; lock creation also reads the env directly, as
+    # locks can be constructed before init parses this Config)
+    debug_locks: bool = False
+    lock_hold_warn_seconds: float = DEFAULT_LOCK_HOLD_WARN_SECONDS
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -216,6 +261,10 @@ class Config:
             profile_history=_get_int(HOROVOD_PROFILE_HISTORY,
                                      DEFAULT_PROFILE_HISTORY),
             profile_jax=_get_bool(HOROVOD_PROFILE_JAX),
+            debug_locks=_get_bool(HOROVOD_DEBUG_LOCKS),
+            lock_hold_warn_seconds=_get_float(
+                HOROVOD_LOCK_HOLD_WARN_SECONDS,
+                DEFAULT_LOCK_HOLD_WARN_SECONDS),
         )
 
 

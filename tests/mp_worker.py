@@ -1383,6 +1383,41 @@ def main():
         m = hvd.metrics().get("horovod_sharded_state_bytes")
         assert m and m["values"][0]["value"] > 0
 
+    elif scenario == "debug_locks":
+        # short training loop under the deadlock witness
+        # (HOROVOD_DEBUG_LOCKS=1 set by the launcher): the runtime's own
+        # locks are DebugLocks; assert the run is violation-free, the
+        # observed acquisition order is consistent with the static
+        # lock-order graph, and lock events reached the flight recorder.
+        assert os.environ.get("HOROVOD_DEBUG_LOCKS") == "1"
+        from horovod_tpu import flight_recorder
+        from horovod_tpu.analysis import lockgraph, witness
+
+        for step in range(4):
+            hs = [hvd.allreduce_async(
+                      np.full((64,), float(rank + step), np.float32),
+                      name=f"grad/w{i}") for i in range(3)]
+            hs.append(hvd.allgather_async(
+                np.full((rank + 1, 2), rank, np.float32), name="ag/x"))
+            for h in hs:
+                hvd.synchronize(h)
+        state = hvd.dump_debug_state()
+        viols = witness.violations()
+        assert not viols, f"witness violations on rank {rank}: {viols}"
+        edges = witness.order_edges()
+        assert edges, "expected at least one observed lock-order edge"
+        pkg = os.path.dirname(os.path.dirname(
+            os.path.abspath(hvd.__file__)))
+        static = lockgraph.analyze_paths(
+            [os.path.join(pkg, "horovod_tpu")], root=pkg)
+        conflicts = witness.check_static_consistency(static.edges)
+        assert not conflicts, f"static/runtime order conflict: {conflicts}"
+        lock_events = [e for e in flight_recorder.recorder().events()
+                       if str(e.get("kind", "")).startswith("lock_")]
+        assert lock_events, "no lock_* events in the flight recorder"
+        # the dump's state providers include the witness's view
+        assert state["state"].get("locks", {}).get("enabled") is True
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

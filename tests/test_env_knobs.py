@@ -1,6 +1,7 @@
 """Environment-knob contract: every HOROVOD_* var referenced in code is
-documented, and every documented var still exists (tools/check_env_knobs.py
-keeps the two trees from drifting)."""
+documented, still exists when documented, and is registered in
+horovod_tpu/utils/env.py (tools/check_env_knobs.py keeps the three
+views from drifting)."""
 
 import importlib.util
 import os
@@ -19,20 +20,25 @@ def _load_checker():
 
 
 def test_env_knob_contract_holds():
-    """The repo's actual contract: no undocumented and no stale knobs."""
+    """The repo's actual contract: no undocumented, stale or
+    unregistered knobs."""
     mod = _load_checker()
-    undocumented, stale = mod.check()
+    undocumented, stale, unregistered = mod.check()
     assert not undocumented, (
         f"HOROVOD_* vars referenced in code but absent from docs/ and "
         f"README.md: {sorted(undocumented)}")
     assert not stale, (
         f"HOROVOD_* vars documented but no longer referenced in code: "
         f"{sorted(stale)}")
+    assert not unregistered, (
+        f"HOROVOD_* vars referenced in code but not registered in "
+        f"horovod_tpu/utils/env.py (Config or ENV_DIRECT_KNOBS): "
+        f"{sorted(unregistered)}")
 
 
 def test_checker_cli_exit_codes(tmp_path):
     assert subprocess.run([sys.executable, CHECKER]).returncode == 0
-    # a tree with drift in both directions exits nonzero and names it
+    # a tree with drift in all three directions exits nonzero and names it
     (tmp_path / "horovod_tpu").mkdir()
     (tmp_path / "docs").mkdir()
     (tmp_path / "horovod_tpu" / "a.py").write_text(
@@ -43,11 +49,32 @@ def test_checker_cli_exit_codes(tmp_path):
     assert out.returncode == 1
     assert "HOROVOD_SECRET_KNOB" in out.stderr
     assert "HOROVOD_REMOVED_KNOB" in out.stderr
+    # the secret knob is also unregistered (no utils/env.py in the tree)
+    assert "UNREGISTERED: HOROVOD_SECRET_KNOB" in out.stderr
+
+
+def test_registration_check(tmp_path):
+    """A documented knob still fails when utils/env.py doesn't list it;
+    listing it in ENV_DIRECT_KNOBS (or as a constant) passes."""
+    mod = _load_checker()
+    (tmp_path / "horovod_tpu" / "utils").mkdir(parents=True)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "horovod_tpu" / "a.py").write_text(
+        'os.environ["HOROVOD_POINT_OF_USE_KNOB"]\n')
+    (tmp_path / "docs" / "a.md").write_text("`HOROVOD_POINT_OF_USE_KNOB`\n")
+    (tmp_path / "horovod_tpu" / "utils" / "env.py").write_text(
+        "ENV_DIRECT_KNOBS = ()\n")
+    undocumented, stale, unregistered = mod.check(tmp_path)
+    assert undocumented == set() and stale == set()
+    assert unregistered == {"HOROVOD_POINT_OF_USE_KNOB"}
+    (tmp_path / "horovod_tpu" / "utils" / "env.py").write_text(
+        'ENV_DIRECT_KNOBS = ("HOROVOD_POINT_OF_USE_KNOB",)\n')
+    assert mod.check(tmp_path) == (set(), set(), set())
 
 
 def test_wildcards_and_fragments(tmp_path):
     mod = _load_checker()
-    (tmp_path / "horovod_tpu").mkdir()
+    (tmp_path / "horovod_tpu" / "utils").mkdir(parents=True)
     (tmp_path / "docs").mkdir()
     # a wrapped string literal leaves a trailing-underscore fragment that
     # must not count as its own knob
@@ -59,6 +86,11 @@ def test_wildcards_and_fragments(tmp_path):
     (tmp_path / "docs" / "a.md").write_text(
         "`HOROVOD_LONG_KNOB_NAME` and the `HOROVOD_FAMILY_*` knobs, "
         "HOROVOD_WITH[OUT]_* style.\n")
-    undocumented, stale = mod.check(tmp_path)
+    (tmp_path / "horovod_tpu" / "utils" / "env.py").write_text(
+        'ENV_DIRECT_KNOBS = ("HOROVOD_LONG_KNOB_NAME",\n'
+        '                    "HOROVOD_FAMILY_MEMBER_A",\n'
+        '                    "HOROVOD_FAMILY_MEMBER_B")\n')
+    undocumented, stale, unregistered = mod.check(tmp_path)
     assert undocumented == set()
     assert stale == set()
+    assert unregistered == set()

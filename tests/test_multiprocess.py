@@ -265,6 +265,21 @@ def test_zero_sharded_optimizer_parity(world):
         assert "OK rank=" in out
 
 
+@pytest.mark.parametrize("world", [2])
+def test_debug_locks_witness_clean_run(world):
+    """A short training loop under HOROVOD_DEBUG_LOCKS=1: the runtime's
+    witness-wrapped locks must record zero violations, the observed
+    acquisition order must be consistent with the static lock-order
+    graph (hvd-analyze's claim holds at runtime), and lock_* events must
+    reach the flight recorder (asserted in-worker, tests/mp_worker.py
+    scenario debug_locks)."""
+    procs, outs = _launch("debug_locks", world, timeout=180,
+                          extra_env={"HOROVOD_DEBUG_LOCKS": "1"})
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK rank=" in out
+
+
 @pytest.mark.parametrize("world", [2, 3])
 def test_unnamed_eager_collectives_communicate(world):
     """Plain hvd.allreduce/allgather/broadcast (no name) in a

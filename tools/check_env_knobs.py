@@ -2,10 +2,14 @@
 """Cross-check the ``HOROVOD_*`` environment-variable contract.
 
 Every ``HOROVOD_*`` knob referenced by the package must be documented in
-the docs tree (``docs/*.md`` + ``README.md``), and every knob the docs
+the docs tree (``docs/*.md`` + ``README.md``), every knob the docs
 promise must still exist somewhere in the code — docs and code drift in
 opposite directions and both drifts strand users (an undocumented knob is
-undiscoverable; a documented-but-removed knob silently does nothing).
+undiscoverable; a documented-but-removed knob silently does nothing) —
+and every knob must be *registered* in ``horovod_tpu/utils/env.py``,
+either as a named constant parsed into ``Config`` or in the
+``ENV_DIRECT_KNOBS`` catalog of point-of-use reads, so there is exactly
+one place to see the full contract.
 
 Run directly (exits nonzero on drift, listing the offenders)::
 
@@ -76,29 +80,45 @@ def collect_doc_vars(root: Path = REPO_ROOT) -> Tuple[Set[str], Set[str]]:
     return tokens - prefixes, prefixes
 
 
-def check(root: Path = REPO_ROOT) -> Tuple[Set[str], Set[str]]:
-    """Returns (undocumented code vars, stale docs vars)."""
+# the single registration point: every knob must appear here — as a name
+# constant feeding Config.from_env, or in the ENV_DIRECT_KNOBS catalog
+REGISTRY_FILE = ("horovod_tpu", "utils/env.py")
+
+
+def collect_registered_vars(root: Path = REPO_ROOT) -> Set[str]:
+    return _drop_fragments(_scan(root, (REGISTRY_FILE,)))
+
+
+def check(root: Path = REPO_ROOT) -> Tuple[Set[str], Set[str], Set[str]]:
+    """Returns (undocumented code vars, stale docs vars, unregistered
+    code vars — referenced somewhere but absent from utils/env.py)."""
     code = collect_code_vars(root)
     exact, prefixes = collect_doc_vars(root)
+    registered = collect_registered_vars(root)
     undocumented = {
         v for v in code
         if v not in exact and not any(v.startswith(p) for p in prefixes)}
     stale = {
         v for v in exact
         if v not in code and not any(c.startswith(v) for c in code)}
-    return undocumented, stale
+    unregistered = code - registered
+    return undocumented, stale, unregistered
 
 
 def main(argv: list = ()) -> int:
     root = Path(argv[0]) if argv else REPO_ROOT
-    undocumented, stale = check(root)
+    undocumented, stale, unregistered = check(root)
     for v in sorted(undocumented):
         print(f"UNDOCUMENTED: {v} is referenced in code but appears "
               f"nowhere under docs/ or README.md", file=sys.stderr)
     for v in sorted(stale):
         print(f"STALE: {v} is documented but no longer referenced "
               f"anywhere in code", file=sys.stderr)
-    if undocumented or stale:
+    for v in sorted(unregistered):
+        print(f"UNREGISTERED: {v} is referenced in code but not "
+              f"registered in horovod_tpu/utils/env.py (add a Config "
+              f"field or an ENV_DIRECT_KNOBS entry)", file=sys.stderr)
+    if undocumented or stale or unregistered:
         return 1
     print(f"env knob contract ok "
           f"({len(collect_code_vars(root))} vars cross-checked)")

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""hvd-analyze: concurrency & collective-safety analysis over horovod_tpu.
+
+Runs the static passes (lock-order graph + blocking-under-lock +
+guarded-by checking, SPMD collective-divergence lint) against the
+checked-in baseline. New findings fail the run (exit 1); baseline
+suppressions are enumerated with their review reasons; stale
+suppressions (code fixed, entry remains) are reported so the baseline
+shrinks over time.
+
+Usage:
+  python tools/hvd_analyze.py                      # analyze horovod_tpu/
+  python tools/hvd_analyze.py path1 path2 ...      # analyze specific paths
+  python tools/hvd_analyze.py --json               # machine-readable report
+  python tools/hvd_analyze.py --update-baseline    # accept current findings
+  python tools/hvd_analyze.py --no-baseline        # raw findings, exit 1 if any
+
+Exit codes: 0 clean, 1 new findings (or stale suppressions), 2 usage error.
+
+The static passes are jax-free; this script stubs the heavy package
+__init__ so it runs in CI without importing jax.
+"""
+
+import argparse
+import json
+import os
+import sys
+import types
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "analysis_baseline.json")
+
+
+def _import_analysis():
+    """Import horovod_tpu.analysis without executing horovod_tpu/__init__
+    (which pulls in jax). If the package is already imported — e.g. when
+    called from the test suite — use it as-is."""
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    if "horovod_tpu" not in sys.modules:
+        pkg = types.ModuleType("horovod_tpu")
+        pkg.__path__ = [os.path.join(REPO_ROOT, "horovod_tpu")]
+        sys.modules["horovod_tpu"] = pkg
+    import horovod_tpu.analysis as analysis
+    return analysis
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="hvd_analyze", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*",
+                   default=None, help="files/dirs to analyze (default: horovod_tpu/)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline json path (default: tools/analysis_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding, exit 1 if any")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline accepting every current finding "
+                        "(existing reasons are preserved; new entries get a "
+                        "TODO reason that review must replace)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a json report on stdout")
+    args = p.parse_args(argv)
+
+    analysis = _import_analysis()
+    paths = args.paths or [os.path.join(REPO_ROOT, "horovod_tpu")]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"hvd_analyze: no such path: {path}", file=sys.stderr)
+            return 2
+
+    findings, edges = analysis.run_static_passes(paths, root=REPO_ROOT)
+
+    if args.update_baseline:
+        old = {}
+        try:
+            old = analysis.baseline.load(args.baseline)
+        except (ValueError, OSError):
+            pass
+        reasons = {fp: e.get("reason", "") for fp, e in old.items() if e.get("reason")}
+        analysis.baseline.write(args.baseline, findings, reasons=reasons)
+        print(f"hvd_analyze: wrote {len(findings)} suppressions to {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        base = {}
+    else:
+        try:
+            base = analysis.baseline.load(args.baseline)
+        except ValueError as e:
+            print(f"hvd_analyze: {e}", file=sys.stderr)
+            return 2
+    new, suppressed, stale = analysis.baseline.compare(findings, base)
+
+    if args.as_json:
+        json.dump({
+            "new": [f.to_dict() for f in new],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "stale_suppressions": stale,
+            "lock_order_edges": ["%s->%s" % e for e in edges],
+        }, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in new:
+            print(f"NEW: {f.render()}  [fingerprint {f.fingerprint}]",
+                  file=sys.stderr)
+        for f in suppressed:
+            reason = base[f.fingerprint].get("reason", "")
+            print(f"suppressed: {f.render()}  — {reason}")
+        for e in stale:
+            print(f"STALE suppression {e['fingerprint']} ({e.get('rule')} in "
+                  f"{e.get('file')}): code no longer trips the analyzer — "
+                  f"remove it from the baseline", file=sys.stderr)
+        print(f"hvd_analyze: {len(new)} new, {len(suppressed)} suppressed, "
+              f"{len(stale)} stale, {len(edges)} lock-order edges")
+
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
