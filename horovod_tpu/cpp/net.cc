@@ -756,7 +756,7 @@ extern "C" {
 // refuses to drive a stale prebuilt .so whose symbols still resolve but
 // whose ABI differs — e.g. the op argument added to the ring kernels).
 // v3: full data mesh + true reduce-scatter / pairwise alltoall kernels.
-int hvdnet_abi_version() { return 3; }
+int hvdnet_abi_version() { return 4; }
 
 void* hvdnet_init(int rank, int world, const char* coord_host, int coord_port,
                   int timeout_ms) {
@@ -774,6 +774,26 @@ void hvdnet_finalize(void* h) {
   if (!c) return;
   comm_close(c);
   delete c;
+}
+
+// Wake every verb blocked on this communicator — from ANY thread —
+// without freeing fds. The steady-state verb reads are unbounded
+// (recv_all / duplex_exchange poll with no deadline: a healthy round
+// always completes, and a per-read deadline would misfire under fusion
+// backpressure), so a partitioned-but-alive peer blocks them forever.
+// ::shutdown(SHUT_RDWR) makes a concurrently blocked recv return 0
+// ("peer closed") immediately, failing the verb with the normal
+// transport-lost path; unlike ::close it does not release the fd, so
+// the blocked thread never touches a recycled descriptor. The watchdog
+// in runtime/socket_controller.py calls this when a control round
+// exceeds HOROVOD_COLLECTIVE_TIMEOUT.
+void hvdnet_abort(void* h) {
+  Comm* c = static_cast<Comm*>(h);
+  if (!c) return;
+  for (int fd : c->star)
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  for (int fd : c->mesh)
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
 }
 
 int hvdnet_rank(void* h) { return static_cast<Comm*>(h)->rank; }

@@ -47,13 +47,21 @@ class HostDiscoveryScript:
     (reference: horovod/run/elastic/discovery.py HostDiscoveryScript)."""
 
     def __init__(self, script: str, default_slots: int = 1):
+        from horovod_tpu.utils import resilience
+
         self.script = script
         self.default_slots = default_slots
+        # a flaky discovery script (NFS blip, transient fork failure) must
+        # not make the driver report an empty host set and trigger a
+        # spurious re-form — retry briefly before surfacing the error
+        self._retry = resilience.RetryPolicy.from_env(
+            "driver", max_retries=2, deadline=30.0)
 
     def find_available_hosts(self) -> Dict[str, int]:
-        out = subprocess.run(
-            shlex.split(self.script), capture_output=True, text=True,
-            timeout=60, check=True).stdout
+        out = self._retry.call(
+            self._run_script, phase="discovery",
+            classify=lambda e: isinstance(
+                e, (subprocess.SubprocessError, OSError)))
         hosts: Dict[str, int] = {}
         for line in out.splitlines():
             line = line.strip()
@@ -65,6 +73,11 @@ class HostDiscoveryScript:
             else:
                 hosts[line] = self.default_slots
         return hosts
+
+    def _run_script(self) -> str:
+        return subprocess.run(
+            shlex.split(self.script), capture_output=True, text=True,
+            timeout=60, check=True).stdout
 
 
 class ElasticDriver:

@@ -2,7 +2,13 @@
 
 ``HOROVOD_FAULT_INJECT=<spec>`` arms a one-shot fault on a chosen rank at
 a chosen step, letting tests and ``tpurun --elastic`` smoke runs exercise
-the recovery path without real hardware failures. Spec grammar::
+the recovery path without real hardware failures. The env var holds one
+or more ``;``-separated clauses; this module owns the *process* faults
+(kill/hang/slow) while the *network* faults (``partition``,
+``kv_outage``, ``flaky``, ``netdelay``) are parsed and fired by
+``horovod_tpu.utils.resilience`` inside the transports — both kinds
+compose in one spec, e.g.
+``kill:rank=1:step=3;kv_outage:5:on=reform``. Process-fault grammar::
 
     <action>:rank=<r>:step=<s>[:code=<c>][:seconds=<t>][:gen=<g>]
 
@@ -91,8 +97,17 @@ def parse_spec(text: str) -> FaultSpec:
 
 
 def spec_from_env() -> Optional[FaultSpec]:
-    text = os.environ.get(HOROVOD_FAULT_INJECT, "")
-    return parse_spec(text) if text else None
+    """First process-fault clause of the (possibly composite) env spec.
+    Network-fault clauses (partition/kv_outage/flaky/netdelay) belong to
+    ``utils.resilience`` and are skipped here, not rejected."""
+    from horovod_tpu.utils import resilience
+
+    for clause in os.environ.get(HOROVOD_FAULT_INJECT, "").split(";"):
+        clause = clause.strip()
+        if not clause or resilience.is_net_clause(clause):
+            continue
+        return parse_spec(clause)
+    return None
 
 
 def initial_rank() -> int:

@@ -37,6 +37,7 @@ from horovod_tpu.core import basics
 from horovod_tpu.elastic import fault_inject
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.utils import logging as log
+from horovod_tpu.utils import resilience
 from horovod_tpu.utils.env import _get_float, _get_int
 
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
@@ -201,8 +202,17 @@ def _scan_members(client, scope: str, settle: float,
     last_change = time.monotonic()
     while True:
         now = time.monotonic()
-        seen = sorted(int(k.split(".", 1)[1]) for k in client.keys(scope)
-                      if k.startswith("member."))
+        try:
+            seen = sorted(int(k.split(".", 1)[1])
+                          for k in client.keys(scope)
+                          if k.startswith("member."))
+        except OSError:
+            # transient rendezvous outage (restart, kv_outage chaos):
+            # keep polling until the rejoin deadline, don't lose quorum
+            if now >= deadline:
+                return members
+            time.sleep(0.2)
+            continue
         if seen != members:
             members, last_change = seen, now
         elif members and now - last_change >= settle:
@@ -232,9 +242,21 @@ def _reform(min_workers: int, backoff: Backoff) -> None:
     basics.shutdown()
     _shutdown_jax_distributed()
 
-    client.set(f"member.{old_rank}", _worker_uid().encode(), scope=scope)
-
     deadline = time.monotonic() + rejoin_timeout
+    # registration must survive a rendezvous outage spanning the client
+    # retry budget — keep trying for the whole rejoin window
+    while True:
+        try:
+            client.set(f"member.{old_rank}", _worker_uid().encode(),
+                       scope=scope)
+            break
+        except OSError as exc:
+            if time.monotonic() >= deadline:
+                raise exceptions.WorkersDownError(
+                    f"elastic re-form failed: cannot register with the "
+                    f"rendezvous store within {rejoin_timeout:g}s "
+                    f"({exc})") from exc
+            time.sleep(0.5)
     members = _scan_members(client, scope, settle, deadline)
     # retry the scan with backoff while below quorum (survivors discover
     # the failure at very different times: commit boundary vs transport
@@ -297,14 +319,21 @@ def _reform(min_workers: int, backoff: Backoff) -> None:
         os.environ["HOROVOD_PROCESS_ID"] = str(new_rank)
 
     _generation = gen
+    # publish the new generation to the resilience fence: any late reply
+    # or error still in flight from the old epoch's communicator is now
+    # discarded instead of delivered into the re-formed job
+    resilience.set_generation(gen)
     _GENERATION_GAUGE.set(gen)
     if new_size < old_size:
         _WORKERS_REMOVED.inc(old_size - new_size)
     log.warning("elastic: re-formed generation %d — old rank %d -> "
                 "new rank %d of %d", gen, old_rank, new_rank, new_size)
+    # members/old_size let the postmortem name who did NOT make it into
+    # the new generation (a partitioned rank never ships its own dump)
     flight_recorder.emit("elastic_reform", generation=gen,
                          old_rank=old_rank, new_rank=new_rank,
-                         size=new_size)
+                         size=new_size, members=members,
+                         old_size=old_size)
     basics.reinit()
 
 

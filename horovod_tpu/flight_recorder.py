@@ -257,9 +257,14 @@ class FlightRecorder:
         if dest is None:
             return
         from horovod_tpu.run.rendezvous import KVStoreClient
+        from horovod_tpu.utils import resilience
 
-        client = KVStoreClient(dest[0], dest[1], scope=RENDEZVOUS_SCOPE,
-                               timeout=5.0)
+        # a dump usually ships while the job is already unhealthy — retry
+        # briefly (a hiccup must not lose the postmortem), but bound the
+        # whole attempt so shipping never delays process teardown long
+        client = KVStoreClient(
+            dest[0], dest[1], scope=RENDEZVOUS_SCOPE, timeout=5.0,
+            retry=resilience.RetryPolicy.from_env("flight", deadline=5.0))
         client.set("rank.%d" % self.launch_rank, payload)
 
 
@@ -411,13 +416,31 @@ def suspect_culprit(dumps: List[dict]) -> Optional[Tuple[Any, str]]:
     named: Dict[Any, int] = {}
     for d in dumps:
         for ev in d.get("events", ()):
-            if ev.get("kind") in ("workers_down", "stall_shutdown"):
-                for r in ev.get("ranks", ()) or ():
+            if ev.get("kind") in ("workers_down", "stall_shutdown",
+                                  "collective_timeout"):
+                for r in (ev.get("ranks") or ev.get("missing") or ()):
                     named[r] = named.get(r, 0) + 1
     if named:
         rank = max(named, key=lambda r: named[r])
         return rank, ("named missing/lost by %d workers_down/stall event(s)"
                       % named[rank])
+    # A partitioned rank never ships its own dump and a transport error
+    # names no peer — but the survivors' re-form does: whoever was in the
+    # old generation and absent from the new membership is the suspect.
+    for d in dumps:
+        for ev in d.get("events", ()):
+            if ev.get("kind") != "elastic_reform":
+                continue
+            members = ev.get("members")
+            old_size = ev.get("old_size")
+            if members is None or old_size is None:
+                continue
+            missing = sorted(set(range(int(old_size))) - set(members))
+            if missing:
+                return missing[0], (
+                    "absent from the generation-%s re-form (%d of %d old "
+                    "ranks rejoined)" % (ev.get("generation", "?"),
+                                         len(members), int(old_size)))
     best = None
     for d in dumps:
         lag = d.get("metrics", {}).get("horovod_straggler_lag_seconds")

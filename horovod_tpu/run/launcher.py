@@ -46,6 +46,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _announce_net_chaos() -> None:
+    """Log the armed network-fault clauses (if any) once at launch — a
+    chaos run whose faults silently fail to parse tests nothing."""
+    from horovod_tpu.utils import resilience
+
+    spec = os.environ.get("HOROVOD_FAULT_INJECT", "")
+    if not spec:
+        return
+    try:
+        faults = resilience.parse_net_faults(spec)
+    except ValueError as exc:
+        print(f"tpurun: ignoring malformed HOROVOD_FAULT_INJECT net "
+              f"clause: {exc}", file=sys.stderr)
+        return
+    if faults:
+        print("tpurun: network chaos armed: "
+              + "; ".join(str(f) for f in faults), file=sys.stderr)
+
+
 def get_driver_ip(slots: List[SlotInfo]) -> str:
     """Address remote workers use to reach the launcher host."""
     if all(is_local_host(s.hostname) for s in slots):
@@ -188,6 +207,7 @@ def launch_job(command: str, slots: List[SlotInfo],
 
     rendezvous = RendezvousServer()
     http_port = rendezvous.start()
+    _announce_net_chaos()
     socket_port = _free_port()
     coordinator_port = _free_port()
 

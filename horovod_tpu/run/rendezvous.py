@@ -21,10 +21,18 @@ Two scopes get special treatment for the elastic subsystem:
 * ``/_keys/<scope>`` — lists a scope's keys (newline-joined), which the
   elastic re-form protocol uses to discover who registered for the next
   generation.
+
+Both sides participate in the resilience layer (utils/resilience.py):
+the server honors injected ``kv_outage`` windows (answering 503 so chaos
+tests drive the real client retry path), and every client HTTP op runs
+under a :class:`~horovod_tpu.utils.resilience.RetryPolicy` with a
+default socket timeout — a hung or flapping rendezvous server delays a
+worker, it can no longer wedge one forever.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -33,6 +41,7 @@ from urllib.error import HTTPError
 from urllib.parse import parse_qs, urlsplit
 from urllib.request import Request, urlopen
 
+from horovod_tpu.utils import resilience
 from horovod_tpu.utils.env import _get_float
 
 HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS = "HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS"
@@ -61,6 +70,30 @@ class _Handler(BaseHTTPRequestHandler):
         values = parse_qs(urlsplit(self.path).query).get(name)
         return values[0] if values else None
 
+    def _chaos_outage(self, scope: Optional[str]) -> bool:
+        """Injected ``kv_outage`` window (HOROVOD_FAULT_INJECT): when
+        active, answer 503 and return True. An ``on=reform`` outage arms
+        on the first request touching a per-generation elastic scope —
+        deterministically covering the re-form window chaos tests target.
+        Any request body was already consumed by the caller (keep-alive
+        correctness)."""
+        srv = self.server
+        fault = getattr(srv, "chaos_outage", None)
+        if fault is None:
+            return False
+        now = time.monotonic()
+        with srv.lock:
+            start = srv.chaos_outage_start
+            if (start is None and fault.on == "reform"
+                    and scope and scope.startswith("elastic.g")):
+                srv.chaos_outage_start = start = now
+        if start is None or not (start <= now <= start + fault.seconds):
+            return False
+        self.send_response(503)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return True
+
     def do_PUT(self):
         sk = self._split()
         if sk is None:
@@ -68,6 +101,8 @@ class _Handler(BaseHTTPRequestHandler):
         scope, key = sk
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        if self._chaos_outage(scope):
+            return
         with self.server.lock:
             self.server.store.setdefault(scope, {})[key] = value
             self.server.put_times.setdefault(scope, {})[key] = \
@@ -99,11 +134,16 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         if path.startswith("/_keys/"):
-            return self._do_keys(path[len("/_keys/"):].strip("/"))
+            scope = path[len("/_keys/"):].strip("/")
+            if self._chaos_outage(scope):
+                return
+            return self._do_keys(scope)
         sk = self._split()
         if sk is None:
             return
         scope, key = sk
+        if self._chaos_outage(scope):
+            return
         try:
             wait = min(float(self._query("wait") or 0.0), _MAX_WAIT_SECONDS)
         except ValueError:
@@ -150,6 +190,8 @@ class _Handler(BaseHTTPRequestHandler):
         if sk is None:
             return
         scope, key = sk
+        if self._chaos_outage(scope):
+            return
         with self.server.lock:
             self.server.store.get(scope, {}).pop(key, None)
             self.server.put_times.get(scope, {}).pop(key, None)
@@ -205,6 +247,19 @@ class RendezvousServer:
         self._httpd.heartbeat_ttl = (  # type: ignore[attr-defined]
             heartbeat_ttl if heartbeat_ttl is not None
             else _get_float(HOROVOD_RENDEZVOUS_HEARTBEAT_TTL, 30.0))
+        # injected kv_outage (chaos): the window during which every KV
+        # request answers 503. Timer-armed outages start counting now;
+        # on=reform outages arm on first elastic.g* traffic.
+        try:
+            faults = resilience.parse_net_faults(
+                os.environ.get("HOROVOD_FAULT_INJECT"))
+        except ValueError:
+            faults = []
+        outage = next((f for f in faults if f.kind == "kv_outage"), None)
+        self._httpd.chaos_outage = outage  # type: ignore[attr-defined]
+        self._httpd.chaos_outage_start = (  # type: ignore[attr-defined]
+            None if outage is None or outage.on == "reform"
+            else time.monotonic() + outage.after)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -257,27 +312,44 @@ class KVStoreClient:
     ``get(wait=True)`` long-polls: each request asks the server to park up
     to ``long_poll`` seconds (``HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS``)
     before 404ing, and the short client-side sleep only paces retries
-    against pre-long-poll servers."""
+    against pre-long-poll servers.
+
+    Every HTTP op carries the retry policy's per-attempt socket timeout
+    (a hung server can never block a worker forever) and retries
+    transient failures — connection resets, 5xx/503 outage windows,
+    socket timeouts — with full-jitter backoff. ``get``'s retries are
+    bounded by the op's OWN deadline (``timeout``) rather than the
+    policy's attempt cap, so a multi-second server outage shorter than
+    the deadline is survived no matter how many attempts it takes."""
 
     def __init__(self, addr: str, port: int, scope: str = "global",
-                 timeout: float = 60.0, long_poll: Optional[float] = None):
+                 timeout: float = 60.0, long_poll: Optional[float] = None,
+                 retry: Optional[resilience.RetryPolicy] = None):
         self._base = f"http://{addr}:{port}"
         self._scope = scope
         self._timeout = timeout
         self._long_poll = (long_poll if long_poll is not None
                            else _get_float(
                                HOROVOD_RENDEZVOUS_LONG_POLL_SECONDS, 5.0))
+        self._retry = retry or resilience.RetryPolicy.from_env("kv")
 
     def _url(self, key: str, scope: Optional[str] = None) -> str:
         return f"{self._base}/{scope or self._scope}/{key}"
 
+    def _open(self, url_or_req, timeout: float, phase: str) -> bytes:
+        resilience.inject("kv", phase)
+        with urlopen(url_or_req, timeout=timeout) as resp:
+            return resp.read()
+
     def set(self, key: str, value: bytes, scope: Optional[str] = None) -> None:
         req = Request(self._url(key, scope), data=value, method="PUT")
-        urlopen(req, timeout=10).read()
+        self._retry.call(self._open, req, self._retry.attempt_timeout,
+                         "set", phase="kv.set")
 
     def get(self, key: str, scope: Optional[str] = None,
             wait: bool = True) -> bytes:
         deadline = time.monotonic() + self._timeout
+        attempt = 0
         while True:
             url = self._url(key, scope)
             poll = 0.0
@@ -287,15 +359,42 @@ class KVStoreClient:
                 if poll > 0:
                     url += f"?wait={poll:g}"
             try:
-                return urlopen(url, timeout=poll + 10).read()
+                return self._open(url, poll + self._retry.attempt_timeout,
+                                  "get")
             except HTTPError as e:
-                if e.code != 404 or not wait:
+                if e.code == 404:
+                    if not wait:
+                        raise KeyError(key) from e
+                    # long-poll miss — the normal not-yet-published signal
+                elif self._retry.retryable(e):
+                    attempt += 1
+                    self._backoff_or_raise(e, "kv.get", attempt, deadline)
+                    continue
+                else:
                     raise KeyError(key) from e
+            except Exception as e:
+                if not self._retry.retryable(e):
+                    raise
+                attempt += 1
+                self._backoff_or_raise(e, "kv.get", attempt, deadline)
+                continue
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"rendezvous key {key!r} not published within "
                     f"{self._timeout}s")
             time.sleep(0.05)
+
+    def _backoff_or_raise(self, exc: Exception, phase: str, attempt: int,
+                          deadline: float) -> None:
+        """One full-jitter backoff inside ``get``'s loop; re-raises once
+        the op deadline cannot accommodate another attempt."""
+        delay = self._retry.delay_for(attempt)
+        if time.monotonic() + delay >= deadline:
+            resilience.give_up(self._retry.transport, phase, attempt, exc)
+            raise exc
+        resilience.note_retry(self._retry.transport, phase, attempt, delay,
+                              exc)
+        time.sleep(delay)
 
     def keys(self, scope: Optional[str] = None,
              ttl: Optional[float] = None) -> List[str]:
@@ -303,9 +402,12 @@ class KVStoreClient:
         url = f"{self._base}/_keys/{scope or self._scope}"
         if ttl is not None:
             url += f"?ttl={ttl:g}"
-        body = urlopen(url, timeout=10).read().decode()
+        body = self._retry.call(
+            self._open, url, self._retry.attempt_timeout, "keys",
+            phase="kv.keys").decode()
         return [k for k in body.split("\n") if k]
 
     def finish(self, key: str, scope: Optional[str] = None) -> None:
         req = Request(self._url(key, scope), method="DELETE")
-        urlopen(req, timeout=10).read()
+        self._retry.call(self._open, req, self._retry.attempt_timeout,
+                         "finish", phase="kv.finish")

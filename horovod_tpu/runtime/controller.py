@@ -32,6 +32,7 @@ from horovod_tpu.runtime import types
 from horovod_tpu.runtime.response_cache import (CacheCoordinator, CacheState,
                                                 make_response_cache)
 from horovod_tpu.utils import logging as log
+from horovod_tpu.utils import resilience
 
 
 class MessageTable:
@@ -220,6 +221,11 @@ class Controller:
         # coordinator-side straggler attribution, attached by the runtime
         # (stall.StragglerTracker); None on workers / when unwired
         self.straggler = None
+        # hard deadline on in-flight negotiate rounds (0 = disabled):
+        # unlike the stall inspector's slow warn/shutdown scan, this is
+        # the partition-tolerance bound — a rank whose announcements
+        # stop arriving trips it within HOROVOD_COLLECTIVE_TIMEOUT
+        self.collective_timeout = resilience.collective_timeout()
 
     # -- transport verbs (reference: controller.h:98-124) ------------------
     def sync_bitvectors(self, bits: int) -> Tuple[int, int]:
@@ -326,6 +332,14 @@ class Controller:
                     self.failure = stall_exc
                 self.request_shutdown()
 
+        # Generation-fenced collective timeout: negotiate rounds older
+        # than HOROVOD_COLLECTIVE_TIMEOUT abort the job with a catchable
+        # WorkerStallError naming the ranks that never announced —
+        # feeding the elastic reform instead of hanging on a partition.
+        if (self.is_coordinator and self.collective_timeout > 0
+                and self.failure is None and len(self.message_table)):
+            self._check_collective_deadline(now)
+
         common_bits = sorted(CacheCoordinator.common_hits(anded))
         cached_responses: List[msg.Response] = []
         for bit in common_bits:
@@ -428,6 +442,42 @@ class Controller:
                 self._awaiting.discard(name)
                 self._deferred_first_seen.pop(name, None)
         return fused, shut_down
+
+    def _check_collective_deadline(self, now: float) -> None:
+        """Coordinator-side deadline on in-flight negotiate rounds: any
+        tensor whose first announcement is older than
+        ``HOROVOD_COLLECTIVE_TIMEOUT`` ends the cycle. The verdict is a
+        generation-stamped :class:`WorkerStallError` naming the ranks
+        that never announced (the partitioned/stalled suspects), stored
+        on ``self.failure`` — the runtime lifts it for elastic callers —
+        while the shutdown bit still propagates so every peer leaves its
+        loop in lockstep rather than waiting out its transport timeout."""
+        overdue: List[str] = []
+        missing: set = set()
+        for name, reqs in self.message_table.pending().items():
+            first = self.message_table.first_request_time(name)
+            if first is None or now - first < self.collective_timeout:
+                continue
+            overdue.append(name)
+            missing.update(set(range(self.world)) - {r.rank for r in reqs})
+        if not overdue:
+            return
+        from horovod_tpu.exceptions import WorkerStallError
+
+        gen = resilience.current_generation()
+        ranks = sorted(missing)
+        exc = WorkerStallError(
+            f"collective timeout: {len(overdue)} negotiate round(s) "
+            f"(first: {overdue[0]!r}) exceeded "
+            f"HOROVOD_COLLECTIVE_TIMEOUT={self.collective_timeout:g}s in "
+            f"generation {gen}; ranks never announced: {ranks}",
+            ranks=ranks)
+        log.error("%s", exc)
+        flight_recorder.emit("collective_timeout", tensors=len(overdue),
+                             missing=ranks, generation=gen)
+        flight_recorder.dump_on_failure("collective_timeout")
+        self.failure = exc
+        self.request_shutdown()
 
     def take_deferred(self) -> List[msg.Request]:
         """Requests still unresolved on this worker that must be

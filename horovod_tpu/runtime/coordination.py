@@ -11,9 +11,12 @@ from __future__ import annotations
 
 import collections
 import pickle
+import time
 from typing import Optional
 
 import jax
+
+from horovod_tpu.exceptions import WorkerStallError
 
 _counter = [0]
 # per-name sequence numbers: the KV store forbids overwriting a key, so a
@@ -21,6 +24,11 @@ _counter = [0]
 # restore) gets a fresh key each call — all processes increment in the
 # same call order, so the sequenced keys agree job-wide
 _name_seq: collections.defaultdict = collections.defaultdict(int)
+# GC watermark per name: sequenced keys at or below this are deleted from
+# the coordinator's store (long elastic jobs would otherwise grow it
+# unboundedly, one dead key per broadcast)
+_gc_floor: collections.defaultdict = collections.defaultdict(int)
+_GC_INTERVAL = 32
 
 
 def _kv_client():
@@ -53,5 +61,51 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
     root_process = root_rank // max(st.local_size, 1)
     if jax.process_index() == root_process:
         client.key_value_set(key, pickle.dumps(obj).hex())
-    payload = client.blocking_key_value_get(key, timeout_ms)
-    return pickle.loads(bytes.fromhex(payload))
+    budget = timeout_ms / 1000.0
+    t0 = time.monotonic()
+    try:
+        payload = client.blocking_key_value_get(key, timeout_ms)
+    except Exception as exc:
+        elapsed = time.monotonic() - t0
+        text = str(exc).lower()
+        if elapsed >= budget - 0.25 or "deadline" in text \
+                or "timeout" in text or "timed out" in text:
+            raise WorkerStallError(
+                f"broadcast_object({name!r}): no value for key {key!r} "
+                f"from root process {root_process} within {budget:g}s — "
+                f"the publisher is stalled, partitioned, or dead") from exc
+        raise
+    obj = pickle.loads(bytes.fromhex(payload))
+    _maybe_gc(client, name, _name_seq[name], root_process, timeout_ms)
+    return obj
+
+
+def _maybe_gc(client, name: str, seq: int, root_process: int,
+              timeout_ms: int) -> None:
+    """Delete consumed ``_hvd_bcast_*`` keys. Multi-process: every
+    ``_GC_INTERVAL`` broadcasts of a name all processes rendezvous at a
+    sequenced barrier (so every reader has observed every key at or below
+    ``seq``) and the root deletes the batch; a barrier miss just defers
+    GC to the next interval. Single-process: delete immediately. Always
+    best-effort — a GC failure never fails the broadcast."""
+    try:
+        if jax.process_count() == 1:
+            client.key_value_delete(f"horovod_tpu/{name}.{seq}")
+            _gc_floor[name] = seq
+            return
+        if seq - _gc_floor[name] < _GC_INTERVAL:
+            return
+        if not (hasattr(client, "wait_at_barrier")
+                and hasattr(client, "key_value_delete")):
+            return
+        # barrier ids must be fresh per GC round — seq provides that
+        client.wait_at_barrier(f"_hvd_bcast_gc.{name}.{seq}", timeout_ms)
+        if jax.process_index() == root_process:
+            for s in range(_gc_floor[name] + 1, seq + 1):
+                try:
+                    client.key_value_delete(f"horovod_tpu/{name}.{s}")
+                except Exception:
+                    pass
+        _gc_floor[name] = seq
+    except Exception:
+        pass

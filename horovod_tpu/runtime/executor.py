@@ -42,6 +42,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from horovod_tpu import flight_recorder
 from horovod_tpu import timeline as timeline_mod
 from horovod_tpu.analysis import witness
+from horovod_tpu.exceptions import WorkerLostError, WorkerStallError
+from horovod_tpu.utils import resilience
 from horovod_tpu.core import mesh as mesh_mod
 from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.ops import collectives
@@ -399,6 +401,7 @@ class Executor:
         pend = _PendingOp(self, response.response_type, entries, timeline)
         flight_recorder.emit("op_dispatch", op=pend.op, name=pend.name0,
                              tensors=len(entries), bytes=pend.nbytes)
+        t0 = time.monotonic()
         try:
             if timeline is not None:
                 timeline.start(pend.name0, response.response_type)
@@ -472,10 +475,31 @@ class Executor:
                 raise ValueError(
                     f"unknown response type {response.response_type}")
         except Exception as exc:
-            pend.fail_exc(exc)
+            pend.fail_exc(self._maybe_stall(exc, time.monotonic() - t0))
         if pend.t_disp_end is None:
             pend.t_disp_end = time.perf_counter()
         return pend
+
+    def _maybe_stall(self, exc: Exception, elapsed: float) -> Exception:
+        """Classify a data-plane transport loss that consumed the whole
+        HOROVOD_COLLECTIVE_TIMEOUT budget as a generation-stamped
+        ``WorkerStallError``: a peer that sat silent for the entire
+        deadline is partitioned/stalled, not cleanly dead, and the
+        elastic reform should treat the cycle abort as a stall (the
+        error still flows through the same ``_PendingOp.fail`` path)."""
+        ct = resilience.collective_timeout()
+        if (ct > 0 and elapsed >= ct - 0.05
+                and isinstance(exc, WorkerLostError)
+                and not isinstance(exc, WorkerStallError)):
+            gen = resilience.current_generation()
+            flight_recorder.emit("collective_timeout", phase="dispatch",
+                                 generation=gen, elapsed=round(elapsed, 3))
+            return WorkerStallError(
+                f"data-plane dispatch blocked {elapsed:.1f}s — "
+                f"HOROVOD_COLLECTIVE_TIMEOUT={ct:g}s exceeded in "
+                f"generation {gen}; aborting the cycle for elastic "
+                f"recovery ({exc})", ranks=exc.ranks)
+        return exc
 
     # -- fused pack/pad helpers --------------------------------------------
     def _pack_fused(self, arrays, rows: int, dtype, reduce_op: str):

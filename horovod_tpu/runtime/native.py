@@ -101,7 +101,7 @@ def _load_locked(build_if_missing: bool):
     return lib
 
 
-_ABI_VERSION = 3  # must match hvdnet_abi_version() in cpp/net.cc
+_ABI_VERSION = 4  # must match hvdnet_abi_version() in cpp/net.cc
 
 
 def _bind_symbols(lib) -> None:
@@ -119,6 +119,7 @@ def _bind_symbols(lib) -> None:
                                 ctypes.c_char_p, ctypes.c_int,
                                 ctypes.c_int]
     lib.hvdnet_finalize.argtypes = [ctypes.c_void_p]
+    lib.hvdnet_abort.argtypes = [ctypes.c_void_p]
     lib.hvdnet_rank.argtypes = [ctypes.c_void_p]
     lib.hvdnet_world.argtypes = [ctypes.c_void_p]
     lib.hvdnet_barrier.argtypes = [ctypes.c_void_p]
@@ -252,6 +253,16 @@ class NetComm:
             if self._h:
                 self._lib.hvdnet_finalize(self._h)
                 self._h = None
+
+    def abort(self) -> None:
+        """Wake any verb blocked on this communicator (collective-timeout
+        watchdog). Deliberately does NOT take ``self._lock`` — the blocked
+        verb is holding it, and that is exactly the thread being woken.
+        Safe against ``close()``: the handle can only be finalized under
+        the lock, which the blocked verb owns until abort() unblocks it."""
+        h = self._h
+        if h:
+            self._lib.hvdnet_abort(h)
 
     def barrier(self) -> None:
         with self._lock:

@@ -482,8 +482,11 @@ class Runtime:
             # race and callers see a generic abort instead of
             # WorkersDownError.
             self._record_failure(exc)
+            from horovod_tpu.utils import resilience
+
             flight_recorder.emit(
                 "cycle_abort",
+                generation=resilience.current_generation(),
                 error="%s: %s" % (type(exc).__name__, str(exc)[:200]))
             flight_recorder.dump_on_failure("cycle_abort")
             # The popped requests' entries would otherwise be stranded in

@@ -7,29 +7,102 @@ barrier — run over ``NetComm`` (horovod_tpu/cpp/net.cc), with rank 0 as
 coordinator. Process membership comes from the launcher's environment
 contract (reference: gloo_context.cc:128-133 reads HOROVOD_RANK/SIZE/...;
 rendezvous address knobs gloo_context.cc:37-40).
+
+Resilience (utils/resilience.py): every verb is a sequence-numbered
+control round executed through ``_verb``. Injected connection resets
+(chaos ``flaky``) are raised BEFORE any byte moves, so the same round is
+simply replayed after backoff — the byte stream stays aligned. A real
+transport loss triggers reconnect-and-resume: the communicator is fully
+rebuilt (the C layer keeps per-connection state, so reconnection is
+cooperative — closing our side makes every peer's blocked verb fail
+promptly and funnel into the same rebuild), then an alignment handshake
+allgathers each rank's (generation, round); only when EVERY rank is
+replaying the same round does the verb re-run — otherwise the typed
+``WorkerLostError`` surfaces and the elastic reform takes over. A verb
+that stays blocked past ``HOROVOD_COLLECTIVE_TIMEOUT`` is classified as
+a generation-stamped ``WorkerStallError`` instead (a stalled/partitioned
+peer, not a dead one), feeding the same elastic recovery. Rounds from a
+superseded membership generation are fenced off: their results and
+errors are discarded rather than delivered into the new epoch.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import threading
+import time
 from typing import List, Optional, Tuple
 
-from horovod_tpu.exceptions import WorkerLostError
+from horovod_tpu import flight_recorder
+from horovod_tpu.exceptions import WorkerLostError, WorkerStallError
 from horovod_tpu.runtime import message as msg
 from horovod_tpu.runtime.controller import Controller
 from horovod_tpu.runtime.native import NetComm
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils import resilience
 
 
 class SocketController(Controller):
     def __init__(self, rank: int, world: int, coord_host: str,
                  coord_port: int, cache_capacity: int = 1024,
-                 timeout_ms: int = 30_000):
+                 timeout_ms: int = 30_000,
+                 retry: Optional[resilience.RetryPolicy] = None):
         super().__init__(rank, world, cache_capacity)
         # bitvector width: capacity cache bits + 3 status bits, fixed for
         # the life of the communicator (single round trip per cycle)
-        bit_words = (cache_capacity + 3 + 63) // 64
-        self.net = NetComm(rank, world, coord_host, coord_port, timeout_ms,
-                           bit_words=bit_words)
+        self._bit_words = (cache_capacity + 3 + 63) // 64
+        self._coord_host = coord_host
+        self._coord_port = coord_port
+        self._timeout_ms = timeout_ms
+        self._retry = retry or resilience.RetryPolicy.from_env("ctrl")
+        # the membership generation this communicator belongs to: verbs
+        # of a superseded generation are fenced (their late replies and
+        # errors must not leak into the re-formed epoch)
+        self._generation = resilience.current_generation()
+        # sequence number of control rounds; _acked_round is the last
+        # round known completed on this rank (reconnects resume from it)
+        self._round = 0
+        self._acked_round = 0
+        self.net = self._retry.call(
+            self._connect, phase="connect",
+            classify=lambda e: isinstance(e, (RuntimeError, OSError)))
+        # collective-timeout watchdog: the steady-state verb reads in the
+        # C layer are unbounded (a partitioned-but-alive peer keeps its
+        # socket open, so nothing ever fails), so when a deadline is
+        # armed a sidecar thread shutdown(2)s the communicator's sockets
+        # once a round overruns it — the blocked verb fails promptly and
+        # _verb classifies the loss as a WorkerStallError
+        self._wd_deadline: Optional[float] = None
+        self._wd_lock = threading.Lock()
+        self._wd_stop = threading.Event()
+        if resilience.collective_timeout() > 0:
+            wd = threading.Thread(target=self._watchdog,
+                                  name="hvd-collective-watchdog",
+                                  daemon=True)
+            wd.start()
+
+    def _watchdog(self) -> None:
+        while not self._wd_stop.wait(0.1):
+            with self._wd_lock:
+                deadline = self._wd_deadline
+                if deadline is None or time.monotonic() < deadline:
+                    continue
+                self._wd_deadline = None  # one abort per overrun round
+            log.warning(
+                "rank %d: control round exceeded "
+                "HOROVOD_COLLECTIVE_TIMEOUT=%gs — aborting the blocked "
+                "transport verb", self.rank, resilience.collective_timeout())
+            try:
+                self.net.abort()
+            except Exception:
+                pass
+
+    def _connect(self, timeout_ms: Optional[int] = None) -> NetComm:
+        return NetComm(self.rank, self.world, self._coord_host,
+                       self._coord_port,
+                       self._timeout_ms if timeout_ms is None else timeout_ms,
+                       bit_words=self._bit_words)
 
     @classmethod
     def from_env(cls, cache_capacity: int = 1024) -> "SocketController":
@@ -40,6 +113,12 @@ class SocketController(Controller):
         host = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
         port = int(os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT", "29500"))
         timeout_s = float(os.environ.get("HOROVOD_GLOO_TIMEOUT_SECONDS", "30"))
+        # an armed collective timeout bounds every verb: a partitioned
+        # peer must fail the round within the deadline, not the (often
+        # much longer) transport timeout
+        ct = resilience.collective_timeout()
+        if ct > 0:
+            timeout_s = min(timeout_s, ct)
         return cls(rank, world, host, port, cache_capacity,
                    timeout_ms=int(timeout_s * 1000))
 
@@ -52,43 +131,182 @@ class SocketController(Controller):
             f"died or closed its transport ({exc})", ranks=exc.ranks
             if isinstance(exc, WorkerLostError) else ())
 
+    def _check_fence(self, phase: str) -> None:
+        """Generation fence: once the elastic runner has moved on to a
+        newer membership generation, anything this communicator produces
+        is a late reply from a dead epoch — discard it."""
+        current = resilience.current_generation()
+        if current != self._generation:
+            raise WorkerLostError(
+                f"rank {self.rank}: discarding {phase} from stale "
+                f"generation {self._generation} (current generation "
+                f"{current})")
+
+    # -- resilient verb execution -----------------------------------------
+    def _verb(self, phase: str, fn):
+        """Run one sequence-numbered control round with retry,
+        reconnect-and-resume, collective-timeout classification, and
+        generation fencing."""
+        self._round += 1
+        seq = self._round
+        ct = resilience.collective_timeout()
+        attempt = 0
+        while True:
+            self._check_fence(phase)
+            t0 = time.monotonic()
+            try:
+                resilience.inject("ctrl", phase)
+                if ct > 0:
+                    with self._wd_lock:
+                        self._wd_deadline = time.monotonic() + ct
+                try:
+                    out = fn()
+                finally:
+                    if ct > 0:
+                        with self._wd_lock:
+                            self._wd_deadline = None
+                self._acked_round = seq
+                return out
+            except resilience.ChaosError as exc:
+                # injected before any byte moved: the stream is intact,
+                # the round replays in place after backoff
+                attempt += 1
+                delay = self._retry.delay_for(attempt)
+                if attempt > self._retry.max_retries:
+                    resilience.give_up(self._retry.transport, phase,
+                                       attempt, exc)
+                    raise self._lost(phase, exc) from exc
+                resilience.note_retry(self._retry.transport, phase,
+                                      attempt, delay, exc)
+                time.sleep(delay)
+            except WorkerLostError as exc:
+                elapsed = time.monotonic() - t0
+                self._check_fence(phase)
+                if ct > 0 and elapsed >= ct - 0.05:
+                    # the verb sat blocked for the whole deadline: a
+                    # stalled/partitioned peer, not a clean death —
+                    # surface the catchable stall for elastic recovery
+                    raise self._stalled(phase, seq, ct, elapsed,
+                                        exc) from exc
+                attempt += 1
+                if attempt <= self._retry.max_retries \
+                        and self._reconnect(seq, phase):
+                    continue  # aligned on (generation, round) — replay
+                raise self._lost(phase, exc) from exc
+
+    def _stalled(self, phase: str, seq: int, ct: float, elapsed: float,
+                 exc: WorkerLostError) -> WorkerStallError:
+        flight_recorder.emit("collective_timeout", phase=phase, round=seq,
+                             generation=self._generation,
+                             elapsed=round(elapsed, 3))
+        return WorkerStallError(
+            f"rank {self.rank}/{self.world}: {phase} (control round {seq}, "
+            f"generation {self._generation}) blocked {elapsed:.1f}s — "
+            f"HOROVOD_COLLECTIVE_TIMEOUT={ct:g}s exceeded; aborting the "
+            f"cycle for elastic recovery ({exc})", ranks=exc.ranks)
+
+    def _reconnect(self, seq: int, phase: str) -> bool:
+        """Reconnect-and-resume: rebuild the communicator, then allgather
+        every rank's (generation, round). True — replay round ``seq`` —
+        only when ALL ranks report the identical round of the identical
+        generation, so the replayed verb is stream-aligned everywhere
+        (allgather gives every rank the same view, so the go/no-go
+        decision is itself consistent). Any mismatch or rebuild failure
+        returns False and the caller raises the typed loss for the
+        elastic reform to handle."""
+        try:
+            self.net.close()  # cascades: peers' blocked verbs fail fast
+        except Exception:
+            pass
+        mine = json.dumps({"gen": self._generation, "round": seq})
+        # A cooperative rebuild succeeds fast or not at all: every peer's
+        # blocked verb failed when we closed our side, so live peers are
+        # already re-dialing. The far more common cause of a lost verb is
+        # a DEAD peer, where each dial burns its whole window — so probe
+        # with a short budget instead of the full transport timeout
+        # (which defaults to 30s and would turn every clean peer-death
+        # shutdown into a multi-minute reconnect storm).
+        probe_ms = min(self._timeout_ms, 2_000)
+        for attempt in range(1, 3):
+            self._check_fence(phase)
+            try:
+                net = self._connect(timeout_ms=probe_ms)
+            except Exception as exc:
+                delay = self._retry.delay_for(attempt)
+                resilience.note_retry(self._retry.transport,
+                                      phase + ".reconnect", attempt, delay,
+                                      exc)
+                time.sleep(delay)
+                continue
+            try:
+                peers = [json.loads(b.decode())
+                         for b in net.allgatherv(mine.encode())]
+            except Exception:
+                try:
+                    net.close()
+                except Exception:
+                    pass
+                return False
+            if all(p == {"gen": self._generation, "round": seq}
+                   for p in peers):
+                self.net = net
+                log.warning(
+                    "rank %d: transport re-established; resuming control "
+                    "round %d (generation %d)", self.rank, seq,
+                    self._generation)
+                flight_recorder.emit("net_resume", round=seq,
+                                     generation=self._generation,
+                                     phase=phase)
+                return True
+            # some rank already completed this round (or sits in another
+            # generation): a verb replay would desynchronize the stream
+            try:
+                net.close()
+            except Exception:
+                pass
+            log.warning(
+                "rank %d: reconnect alignment failed for round %d "
+                "(peers report %s) — falling back to elastic re-form",
+                self.rank, seq, peers)
+            return False
+        return False
+
     # -- verbs -------------------------------------------------------------
     def sync_bitvectors(self, bits: int) -> Tuple[int, int]:
-        try:
-            return self.net.bit_and_or(bits)
-        except WorkerLostError as exc:
-            raise self._lost("bitvector sync", exc) from exc
+        return self._verb("bitvector sync",
+                          lambda: self.net.bit_and_or(bits))
 
     def send_ready_tensors(self, requests: List[msg.Request]
                            ) -> Optional[List[List[msg.Request]]]:
-        try:
-            blobs = self.net.gatherv(msg.pack_request_list(requests))
-        except WorkerLostError as exc:
-            raise self._lost("ready-tensor gather", exc) from exc
+        blobs = self._verb(
+            "ready-tensor gather",
+            lambda: self.net.gatherv(msg.pack_request_list(requests)))
         if blobs is None:
             return None
         return [msg.unpack_request_list(b) for b in blobs]
 
     def bcast_responses(self, responses: Optional[List[msg.Response]]
                         ) -> List[msg.Response]:
-        try:
-            if self.rank == 0:
-                assert responses is not None
-                blob = self.net.bcast(msg.pack_response_list(responses))
-            else:
-                blob = self.net.bcast(None)
-        except WorkerLostError as exc:
-            raise self._lost("response broadcast", exc) from exc
+        if self.rank == 0:
+            assert responses is not None
+            packed = msg.pack_response_list(responses)
+            blob = self._verb("response broadcast",
+                              lambda: self.net.bcast(packed))
+        else:
+            blob = self._verb("response broadcast",
+                              lambda: self.net.bcast(None))
         return msg.unpack_response_list(blob)
 
     def bcast_blob(self, blob: Optional[bytes]) -> bytes:
         if self.rank == 0:
             assert blob is not None
-            return self.net.bcast(blob)
-        return self.net.bcast(None)
+            return self._verb("blob broadcast",
+                              lambda: self.net.bcast(blob))
+        return self._verb("blob broadcast", lambda: self.net.bcast(None))
 
     def barrier(self) -> None:
-        self.net.barrier()
+        self._verb("barrier", lambda: self.net.barrier())
 
     def close(self) -> None:
+        self._wd_stop.set()
         self.net.close()

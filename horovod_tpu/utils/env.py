@@ -90,6 +90,10 @@ ENV_DIRECT_KNOBS = (
     "HOROVOD_ELASTIC_REJOIN_TIMEOUT_SECONDS",
     "HOROVOD_ELASTIC_SETTLE_SECONDS",
     "HOROVOD_ELASTIC_SPILL_DIR", "HOROVOD_ELASTIC_SPILL_SYNC",
+    # control-plane resilience (utils/resilience.py; docs/robustness.md)
+    "HOROVOD_COLLECTIVE_TIMEOUT", "HOROVOD_NET_MAX_RETRIES",
+    "HOROVOD_NET_BACKOFF_BASE_SECONDS", "HOROVOD_NET_BACKOFF_MAX_SECONDS",
+    "HOROVOD_NET_DEADLINE_SECONDS", "HOROVOD_NET_ATTEMPT_TIMEOUT_SECONDS",
     # native/build/test switches
     "HOROVOD_NATIVE_CYCLE", "HOROVOD_TPU_WITHOUT_NATIVE",
     "HOROVOD_PALLAS_INTERPRET", "HOROVOD_FAULT_INJECT",

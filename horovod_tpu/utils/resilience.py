@@ -1,0 +1,396 @@
+"""Control-plane resilience: unified retry/backoff + network chaos.
+
+Every control-plane byte in this package — rendezvous KV HTTP ops,
+flight-recorder dump shipping, elastic long-polls/heartbeats, and the
+socket controller's negotiation verbs — crosses a real network that
+drops packets, resets connections and stalls. The reference tolerates
+this by construction (the gloo HTTPStore retries, stall detection bounds
+a lost peer's damage); this module is the TPU-native port of that
+posture, shared by all transports:
+
+* :class:`RetryPolicy` — exponential backoff with FULL jitter
+  (delay ~ U(0, min(max, base*2^k)), the AWS-architecture-blog variant
+  that decorrelates synchronized retry storms), a per-attempt timeout
+  hint for socket ops, an overall deadline, and retryable-error
+  classification. Every retry increments
+  ``horovod_net_retries_total{transport=...}`` and emits a
+  flight-recorder ``net_retry`` event; exhaustion emits ``net_gave_up``.
+* **Network chaos injection** — ``HOROVOD_FAULT_INJECT`` gains
+  net-fault clauses (``;``-separated, composable with the process
+  faults owned by ``elastic/fault_inject.py``)::
+
+      partition:<rank>[:<secs>][:after=<secs>]   drop that rank's control
+                                                 traffic (ops block for the
+                                                 window; secs omitted = forever)
+      kv_outage:<secs>[:after=<secs>|:on=reform] rendezvous server answers 503
+      flaky:<prob>[:rank=<r>][:seconds=<t>]      probabilistic connection resets
+      netdelay:<ms>[:rank=<r>]                   fixed per-op latency
+
+  The injection seam (:func:`inject`) sits INSIDE the real transports,
+  before each wire op, so chaos tests exercise the production
+  retry/timeout/fencing code rather than a mock. An injected reset
+  (:class:`ChaosError`) is raised before any byte moves, which is what
+  makes transparent replay safe for the stream-oriented socket verbs.
+* **Generation fencing** — the elastic runner publishes its membership
+  generation here (:func:`set_generation`); transports stamp the
+  generation they were built in and discard late replies/errors from a
+  superseded epoch (:func:`current_generation`), and
+  ``HOROVOD_COLLECTIVE_TIMEOUT`` (read via :func:`collective_timeout`)
+  bounds how long any negotiate/dispatch round may block before the
+  cycle aborts with a catchable ``WorkerStallError``.
+
+This module lives in ``utils`` (the bottom layer): it must not import
+runtime/elastic/run modules at module scope. Flight-recorder emission is
+deferred to call time for the same reason.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import os
+import random
+import socket
+import time
+from typing import Callable, List, Optional
+from urllib.error import HTTPError, URLError
+
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import _get_float, _get_int
+
+HOROVOD_NET_MAX_RETRIES = "HOROVOD_NET_MAX_RETRIES"
+HOROVOD_NET_BACKOFF_BASE_SECONDS = "HOROVOD_NET_BACKOFF_BASE_SECONDS"
+HOROVOD_NET_BACKOFF_MAX_SECONDS = "HOROVOD_NET_BACKOFF_MAX_SECONDS"
+HOROVOD_NET_DEADLINE_SECONDS = "HOROVOD_NET_DEADLINE_SECONDS"
+HOROVOD_NET_ATTEMPT_TIMEOUT_SECONDS = "HOROVOD_NET_ATTEMPT_TIMEOUT_SECONDS"
+HOROVOD_COLLECTIVE_TIMEOUT = "HOROVOD_COLLECTIVE_TIMEOUT"
+
+_NET_RETRIES = _metrics().counter(
+    "horovod_net_retries_total",
+    "Control-plane transport ops retried after a transient failure.",
+    labelnames=("transport",))
+_NET_BACKOFF = _metrics().counter(
+    "horovod_net_backoff_seconds_total",
+    "Seconds spent sleeping in retry backoff, per transport.",
+    labelnames=("transport",))
+_NET_GAVE_UP = _metrics().counter(
+    "horovod_net_gave_up_total",
+    "Transport ops that exhausted their retry budget and re-raised.",
+    labelnames=("transport",))
+_CHAOS_INJECTED = _metrics().counter(
+    "horovod_net_chaos_injected_total",
+    "Network faults fired by the HOROVOD_FAULT_INJECT chaos harness.",
+    labelnames=("kind",))
+
+# HTTP statuses worth retrying: timeouts, throttles, and server-side
+# failures (503 is the rendezvous kv_outage signal). 404 is NOT here —
+# it is the rendezvous key-absent signal the long-poll protocol rides on.
+RETRYABLE_HTTP_CODES = (408, 429, 500, 502, 503, 504)
+
+
+class ChaosError(ConnectionResetError):
+    """A connection reset injected by the chaos harness. Subclasses
+    ``ConnectionResetError`` so production except-clauses and the
+    retryable classification treat it exactly like the real thing."""
+
+
+def _emit(kind: str, **fields) -> None:
+    # deferred import: utils must not pull upper layers at module scope
+    from horovod_tpu import flight_recorder
+
+    flight_recorder.emit(kind, **fields)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Default transient-vs-fatal classification for transport errors.
+
+    Retryable: injected/real connection resets, refused/aborted
+    connections, socket timeouts, HTTP-layer protocol errors, URL errors,
+    and HTTP responses in :data:`RETRYABLE_HTTP_CODES`. Not retryable:
+    HTTP 404 (the key-absent signal), other 4xx, and anything that is not
+    a transport error (``KeyError``, ``ValueError``, ...)."""
+    if isinstance(exc, HTTPError):
+        return exc.code in RETRYABLE_HTTP_CODES
+    return isinstance(exc, (ConnectionError, TimeoutError, socket.timeout,
+                            http.client.HTTPException, URLError, OSError))
+
+
+def note_retry(transport: str, phase: str, attempt: int, delay: float,
+               exc: BaseException) -> None:
+    """Account one retry: metrics + flight-recorder ``net_retry``."""
+    _NET_RETRIES.labels(transport=transport).inc()
+    _NET_BACKOFF.labels(transport=transport).inc(delay)
+    _emit("net_retry", transport=transport, phase=phase, attempt=attempt,
+          delay=round(delay, 4), error=str(exc)[:120])
+    log.debug("net retry: %s/%s attempt %d in %.3fs (%s)",
+              transport, phase, attempt, delay, exc)
+
+
+def give_up(transport: str, phase: str, attempt: int,
+            exc: BaseException) -> None:
+    """Account retry-budget exhaustion: metrics + ``net_gave_up``."""
+    _NET_GAVE_UP.labels(transport=transport).inc()
+    _emit("net_gave_up", transport=transport, phase=phase, attempts=attempt,
+          error=str(exc)[:200])
+    log.warning("net retries exhausted: %s/%s after %d attempt(s): %s",
+                transport, phase, attempt, exc)
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff with full jitter, bounded by attempts AND an
+    overall deadline.
+
+    ``attempt_timeout`` is a cooperative per-attempt bound: callers pass
+    it into their socket/urlopen timeouts (a blocking syscall cannot be
+    preempted from here). ``sleep``/``rng`` are injectable so tests can
+    assert the schedule without real waiting."""
+
+    transport: str = "net"
+    max_retries: int = 4
+    base_delay: float = 0.1
+    max_delay: float = 2.0
+    deadline: float = 30.0
+    attempt_timeout: float = 10.0
+    sleep: Callable[[float], None] = time.sleep
+    rng: Optional[random.Random] = None
+
+    @classmethod
+    def from_env(cls, transport: str = "net", **overrides) -> "RetryPolicy":
+        kw = dict(
+            max_retries=_get_int(HOROVOD_NET_MAX_RETRIES, 4),
+            base_delay=_get_float(HOROVOD_NET_BACKOFF_BASE_SECONDS, 0.1),
+            max_delay=_get_float(HOROVOD_NET_BACKOFF_MAX_SECONDS, 2.0),
+            deadline=_get_float(HOROVOD_NET_DEADLINE_SECONDS, 30.0),
+            attempt_timeout=_get_float(
+                HOROVOD_NET_ATTEMPT_TIMEOUT_SECONDS, 10.0),
+        )
+        kw.update(overrides)
+        return cls(transport=transport, **kw)
+
+    def delay_for(self, attempt: int) -> float:
+        """Full-jitter delay for retry ``attempt`` (1-based):
+        ``U(0, min(max_delay, base_delay * 2**(attempt-1)))``."""
+        cap = min(self.max_delay,
+                  self.base_delay * (2.0 ** max(attempt - 1, 0)))
+        r = (self.rng or random).random()
+        return cap * r
+
+    def retryable(self, exc: BaseException) -> bool:
+        return is_retryable(exc)
+
+    def call(self, fn: Callable, *args, phase: str = "",
+             deadline: Optional[float] = None,
+             classify: Optional[Callable[[BaseException], bool]] = None,
+             **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying transient failures until
+        ``max_retries`` or the overall deadline is exhausted, then
+        re-raise the last error. Non-retryable errors pass through
+        untouched on the first occurrence."""
+        start = time.monotonic()
+        budget = self.deadline if deadline is None else deadline
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except Exception as exc:
+                if not (classify or is_retryable)(exc):
+                    raise
+                attempt += 1
+                delay = self.delay_for(attempt)
+                elapsed = time.monotonic() - start
+                if attempt > self.max_retries or elapsed + delay > budget:
+                    give_up(self.transport, phase, attempt, exc)
+                    raise
+                note_retry(self.transport, phase, attempt, delay, exc)
+                self.sleep(delay)
+
+
+# -- collective timeout / generation fence ---------------------------------
+
+def collective_timeout() -> float:
+    """``HOROVOD_COLLECTIVE_TIMEOUT`` in seconds; 0 disables the deadline
+    on in-flight negotiate/dispatch rounds."""
+    return _get_float(HOROVOD_COLLECTIVE_TIMEOUT, 0.0)
+
+
+# process-local membership generation mirror. The elastic runner is the
+# writer (on every successful re-form); transports snapshot it at
+# construction and refuse to deliver results/errors once superseded, so
+# late replies from the old epoch are discarded instead of corrupting
+# the new one.
+_generation = 0
+
+
+def set_generation(gen: int) -> None:
+    global _generation
+    _generation = int(gen)
+
+
+def current_generation() -> int:
+    return _generation
+
+
+# -- network chaos ---------------------------------------------------------
+
+NET_FAULT_KINDS = ("partition", "kv_outage", "flaky", "netdelay")
+
+
+@dataclasses.dataclass(frozen=True)
+class NetFault:
+    kind: str
+    rank: Optional[int] = None  # None = every rank
+    seconds: float = float("inf")  # fault window length
+    prob: float = 0.0  # flaky: per-op reset probability
+    delay_ms: float = 0.0  # netdelay: per-op latency
+    after: float = 0.0  # window start, seconds after arming
+    on: str = ""  # kv_outage trigger: "" (timer) | "reform"
+
+
+def is_net_clause(clause: str) -> bool:
+    """True when a ``HOROVOD_FAULT_INJECT`` clause names a network fault
+    (owned here) rather than a process fault (owned by
+    ``elastic/fault_inject.py``)."""
+    return clause.strip().split(":", 1)[0].strip().lower() in NET_FAULT_KINDS
+
+
+def parse_net_faults(text: Optional[str]) -> List[NetFault]:
+    """Parse the net-fault clauses out of ``HOROVOD_FAULT_INJECT``
+    (``;``-separated; process-fault clauses are skipped). Raises
+    ``ValueError`` on a malformed net clause."""
+    faults: List[NetFault] = []
+    for clause in (text or "").split(";"):
+        clause = clause.strip()
+        if not clause or not is_net_clause(clause):
+            continue
+        parts = [p.strip() for p in clause.split(":")]
+        kind = parts[0].lower()
+        positional: List[str] = []
+        named = {}
+        for p in parts[1:]:
+            if "=" in p:
+                k, v = p.split("=", 1)
+                named[k.strip().lower()] = v.strip()
+            else:
+                positional.append(p)
+        try:
+            after = float(named.pop("after", 0.0))
+            if kind == "partition":
+                faults.append(NetFault(
+                    kind, rank=int(positional[0]),
+                    seconds=(float(positional[1]) if len(positional) > 1
+                             else float("inf")),
+                    after=after))
+            elif kind == "kv_outage":
+                faults.append(NetFault(
+                    kind, seconds=float(positional[0]), after=after,
+                    on=named.pop("on", "").lower()))
+            elif kind == "flaky":
+                prob = min(max(float(positional[0]), 0.0), 1.0)
+                faults.append(NetFault(
+                    kind, prob=prob,
+                    rank=(int(named.pop("rank")) if "rank" in named
+                          else None),
+                    seconds=float(named.pop("seconds", float("inf"))),
+                    after=after))
+            elif kind == "netdelay":
+                faults.append(NetFault(
+                    kind, delay_ms=float(positional[0]),
+                    rank=(int(named.pop("rank")) if "rank" in named
+                          else None),
+                    after=after))
+        except (IndexError, ValueError) as exc:
+            raise ValueError(
+                f"HOROVOD_FAULT_INJECT: malformed net-fault clause "
+                f"{clause!r}: {exc}") from exc
+        if named:
+            raise ValueError(
+                f"HOROVOD_FAULT_INJECT: unknown key(s) {sorted(named)} in "
+                f"net-fault clause {clause!r}")
+    return faults
+
+
+class _Chaos:
+    """Armed per-process chaos state: parsed faults, the frozen launch
+    rank (re-forms renumber HOROVOD_RANK; faults must not re-target), a
+    deterministic per-rank RNG, and the arming time the fault windows
+    are measured from."""
+
+    def __init__(self, faults: List[NetFault], rank: int):
+        self.faults = faults
+        self.rank = rank
+        self.t0 = time.monotonic()
+        self.rng = random.Random(0xC0FFEE + rank)
+        self._partition_announced = False
+
+
+_chaos_state: Optional[_Chaos] = None
+_chaos_loaded = False
+
+
+def _chaos() -> Optional[_Chaos]:
+    global _chaos_state, _chaos_loaded
+    if not _chaos_loaded:
+        _chaos_loaded = True
+        try:
+            faults = parse_net_faults(os.environ.get("HOROVOD_FAULT_INJECT"))
+        except ValueError as exc:
+            log.error("%s", exc)
+            faults = []
+        if faults:
+            rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+            _chaos_state = _Chaos(faults, rank)
+            log.warning("network chaos armed on rank %d: %s", rank,
+                        "; ".join(f.kind for f in faults))
+    return _chaos_state
+
+
+def reload_chaos() -> None:
+    """Re-arm chaos from the current environment (tests)."""
+    global _chaos_state, _chaos_loaded
+    _chaos_state = None
+    _chaos_loaded = False
+
+
+def inject(transport: str, phase: str = "") -> None:
+    """The chaos seam: called inside the real transports before each
+    control-plane wire op. Applies netdelay/flaky/partition faults whose
+    window covers now; a no-op when no chaos is armed."""
+    ch = _chaos()
+    if ch is None:
+        return
+    now = time.monotonic() - ch.t0
+    for f in ch.faults:
+        in_window = f.after <= now <= f.after + f.seconds
+        targeted = f.rank is None or f.rank == ch.rank
+        if f.kind == "netdelay" and targeted and in_window:
+            _CHAOS_INJECTED.labels(kind="netdelay").inc()
+            time.sleep(f.delay_ms / 1000.0)
+        elif f.kind == "flaky" and targeted and in_window:
+            if ch.rng.random() < f.prob:
+                _CHAOS_INJECTED.labels(kind="flaky").inc()
+                _emit("chaos_inject", fault="flaky", transport=transport,
+                      phase=phase)
+                raise ChaosError(
+                    f"chaos: injected connection reset "
+                    f"({transport}/{phase})")
+        elif f.kind == "partition" and f.rank == ch.rank and now >= f.after:
+            end = f.after + f.seconds
+            if not ch._partition_announced:
+                ch._partition_announced = True
+                _CHAOS_INJECTED.labels(kind="partition").inc()
+                _emit("chaos_inject", fault="partition", rank=ch.rank,
+                      seconds=f.seconds)
+                log.error("chaos: partitioning rank %d control traffic "
+                          "for %s", ch.rank,
+                          "ever" if end == float("inf")
+                          else "%.0fs" % f.seconds)
+            # dropped traffic reads as a blocked op to this rank and as
+            # silence to its peers — sleep out the window (forever for a
+            # permanent partition; the harness reaps the process)
+            while True:
+                remaining = end - (time.monotonic() - ch.t0)
+                if remaining <= 0:
+                    break
+                time.sleep(min(remaining, 0.2))

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Network-chaos acceptance matrix (ISSUE 8).
+
+Runs the fault-mode × phase matrix as real multiprocess scenarios over
+the socket/native transport — the rendezvous HTTP store lives in this
+process, standing in for the tpurun launcher — and emits ONE JSON
+summary on stdout. Exit status 0 only when every scenario meets its
+expectations; any unexpected worker death (or a missed invariant) exits
+1.
+
+Scenarios (docs/robustness.md has the failure-model table):
+
+* ``flaky_negotiate``   — ``flaky:0.3`` during negotiate: training
+  completes with zero lost steps and nonzero retries.
+* ``netdelay_negotiate``— fixed per-op latency: completes, injections
+  counted.
+* ``kv_outage_reform``  — rank 1 killed at step 3 while the rendezvous
+  store answers 503 for 5s starting at the first re-form registration:
+  survivors bridge the outage and finish.
+* ``partition_collective_timeout`` — a permanent partition of rank 1
+  mid-run: survivors trip HOROVOD_COLLECTIVE_TIMEOUT, re-form within
+  the deadline, finish, and the merged flight-recorder postmortem names
+  the partitioned rank.
+
+Usage: python tools/chaos_matrix.py [--only NAME] [--json PATH]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from horovod_tpu import flight_recorder  # noqa: E402
+from horovod_tpu.run.rendezvous import RendezvousServer  # noqa: E402
+from horovod_tpu.runtime.native import native_built  # noqa: E402
+
+WORKER = os.path.join(REPO, "tools", "chaos_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+SCENARIOS = {
+    "flaky_negotiate": {
+        "world": 2,
+        "env": {
+            "HOROVOD_FAULT_INJECT": "flaky:0.3:seconds=8",
+            # 0.3^k exhaustion over thousands of control rounds needs a
+            # deeper per-op attempt budget than the default 4
+            "HOROVOD_NET_MAX_RETRIES": "12",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+            "CHAOS_STEP_SLEEP": "0.2",
+        },
+        "require_retries": True,
+        "timeout": 180,
+    },
+    "netdelay_negotiate": {
+        "world": 2,
+        "env": {
+            "HOROVOD_FAULT_INJECT": "netdelay:10",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        },
+        "require_injections": True,
+        "timeout": 180,
+    },
+    "kv_outage_reform": {
+        "world": 3,
+        "env": {
+            "HOROVOD_FAULT_INJECT":
+                "kill:rank=1:step=3:code=17;kv_outage:5:on=reform",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        },
+        "expected_exit": {1: 17},
+        "require_retries": True,
+        "require_reform": True,
+        "timeout": 240,
+    },
+    "partition_collective_timeout": {
+        "world": 3,
+        "env": {
+            "HOROVOD_FAULT_INJECT": "partition:1:600:after=4",
+            "HOROVOD_COLLECTIVE_TIMEOUT": "4",
+            "HOROVOD_GLOO_TIMEOUT_SECONDS": "8",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+            "CHAOS_STEP_SLEEP": "1.0",
+        },
+        "hung_ranks": [1],
+        "require_reform": True,
+        "require_culprit": 1,
+        "timeout": 240,
+    },
+}
+
+
+def _collect_dumps(flight_dir, server):
+    """Local flight-rank-*.json files + dumps shipped to the rendezvous
+    ``flight`` scope, deduplicated by launch rank (shipped wins — it is
+    at least as recent as the file)."""
+    by_rank = {}
+    for d in flight_recorder.load_dumps(flight_dir):
+        by_rank[d.get("launch_rank", d.get("rank"))] = d
+    for key in server.live_keys(flight_recorder.RENDEZVOUS_SCOPE):
+        raw = server.get(flight_recorder.RENDEZVOUS_SCOPE, key)
+        try:
+            d = json.loads(raw)
+        except (TypeError, ValueError):
+            continue
+        by_rank[d.get("launch_rank", d.get("rank"))] = d
+    return list(by_rank.values())
+
+
+def run_scenario(name, spec):
+    world = spec["world"]
+    timeout = spec.get("timeout", 240)
+    hung = set(spec.get("hung_ranks", ()))
+    expected_exit = dict(spec.get("expected_exit", {}))
+    flight_dir = tempfile.mkdtemp(prefix="chaos-flight-")
+    server = RendezvousServer(host="127.0.0.1")
+    http_port = server.start()
+    socket_port = _free_port()
+    procs = []
+    outs = [""] * world
+    failures = []
+    try:
+        for rank in range(world):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.update({
+                "HOROVOD_RANK": str(rank),
+                "HOROVOD_SIZE": str(world),
+                "HOROVOD_CONTROLLER": "socket",
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(socket_port),
+                "HOROVOD_RENDEZVOUS_HTTP_ADDR": "127.0.0.1",
+                "HOROVOD_RENDEZVOUS_HTTP_PORT": str(http_port),
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_GLOO_TIMEOUT_SECONDS": "5",
+                "HOROVOD_FLIGHT_RECORDER_DIR": flight_dir,
+                "JAX_PLATFORMS": "cpu",
+            })
+            env.update(spec.get("env", {}))
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        # wait for every rank that is expected to terminate on its own;
+        # a permanently-partitioned rank blocks forever by design and is
+        # reaped after the survivors finish
+        deadline = time.monotonic() + timeout
+        waiting = {i for i in range(world) if i not in hung}
+        while waiting and time.monotonic() < deadline:
+            for i in sorted(waiting):
+                if procs[i].poll() is not None:
+                    waiting.discard(i)
+            time.sleep(0.2)
+        for i in sorted(waiting):
+            failures.append(f"rank {i} did not finish within {timeout}s")
+        for i, p in enumerate(procs):
+            if p.poll() is None:
+                p.kill()
+                if i not in hung and i in waiting:
+                    pass  # already reported as a timeout above
+            out, _ = p.communicate(timeout=30)
+            outs[i] = out or ""
+
+        results = {}
+        for i, out in enumerate(outs):
+            for line in out.splitlines():
+                if line.startswith("CHAOS_RESULT "):
+                    results[i] = json.loads(line[len("CHAOS_RESULT "):])
+
+        for i in range(world):
+            if i in hung:
+                if i in results:
+                    failures.append(
+                        f"rank {i} was expected to hang (partition) but "
+                        f"completed: {results[i]}")
+                continue
+            want = expected_exit.get(i, 0)
+            got = procs[i].returncode
+            if got != want:
+                failures.append(
+                    f"rank {i}: unexpected exit {got} (wanted {want}); "
+                    f"tail: {outs[i][-800:]!r}")
+        survivors = [results[i] for i in sorted(results)
+                     if i not in hung and expected_exit.get(i, 0) == 0]
+        if not survivors:
+            failures.append("no surviving rank reported CHAOS_RESULT")
+        total = int(os.environ.get("CHAOS_TOTAL_STEPS", "8"))
+        for r in survivors:
+            if r["step"] != total or abs(r["w"] - total) > 1e-4:
+                failures.append(
+                    f"lost steps on rank {r['rank']}: step={r['step']} "
+                    f"w={r['w']} (want {total})")
+        retries = sum(r["net_retries_total"] for r in survivors)
+        injections = sum(r["chaos_injected_total"] for r in survivors)
+        if spec.get("require_retries") and retries <= 0:
+            failures.append("expected nonzero horovod_net_retries_total")
+        if spec.get("require_injections") and injections <= 0:
+            failures.append(
+                "expected nonzero horovod_net_chaos_injected_total")
+        if spec.get("require_reform") and not any(
+                r["generation"] >= 1 for r in survivors):
+            failures.append("expected an elastic re-form (generation >= 1)")
+
+        postmortem = ""
+        culprit = spec.get("require_culprit")
+        if culprit is not None:
+            dumps = _collect_dumps(flight_dir, server)
+            postmortem = flight_recorder.format_postmortem(dumps)
+            if f"suspected culprit: rank {culprit}" not in postmortem:
+                failures.append(
+                    f"postmortem does not name rank {culprit}:\n"
+                    + postmortem)
+        return {
+            "scenario": name,
+            "ok": not failures,
+            "failures": failures,
+            "results": [results.get(i) for i in range(world)],
+            "exit_codes": [p.returncode for p in procs],
+            "net_retries_total": retries,
+            "chaos_injected_total": injections,
+            "postmortem_tail": postmortem.splitlines()[-12:]
+            if postmortem else [],
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+        shutil.rmtree(flight_dir, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--only", help="run a single scenario by name")
+    parser.add_argument("--json", help="also write the summary to a file")
+    args = parser.parse_args()
+
+    if not native_built():
+        print(json.dumps({"ok": False,
+                          "error": "native transport not built"}))
+        return 1
+
+    names = [args.only] if args.only else list(SCENARIOS)
+    for name in names:
+        if name not in SCENARIOS:
+            print(json.dumps({"ok": False,
+                              "error": f"unknown scenario {name!r}"}))
+            return 1
+
+    summary = {"ok": True, "scenarios": []}
+    for name in names:
+        print(f"chaos_matrix: running {name} ...", file=sys.stderr,
+              flush=True)
+        result = run_scenario(name, SCENARIOS[name])
+        summary["scenarios"].append(result)
+        if not result["ok"]:
+            summary["ok"] = False
+        print(f"chaos_matrix: {name}: "
+              f"{'ok' if result['ok'] else 'FAILED'}",
+              file=sys.stderr, flush=True)
+
+    text = json.dumps(summary, indent=2)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

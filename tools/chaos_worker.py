@@ -1,0 +1,84 @@
+"""Worker script for the network-chaos matrix (tools/chaos_matrix.py)
+and the multiprocess chaos tests.
+
+Same elastic training loop as tests/elastic_worker.py — one
+Average-allreduce of ones per step, so ``w == step`` at every commit
+(the zero-lost-steps invariant) — plus:
+
+* ``CHAOS_STEP_SLEEP`` seconds of per-step sleep, so timer-armed faults
+  (``partition:...:after=N``) land *inside* the training window instead
+  of after an instant CPU run has already finished;
+* a machine-readable ``CHAOS_RESULT {json}`` line with the step/weight
+  invariants and the resilience counters the matrix asserts on;
+* a final flight-recorder dump, so the merged postmortem sees the
+  re-form membership events (a failure-time dump predates the re-form).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic, flight_recorder
+
+TOTAL_STEPS = int(os.environ.get("CHAOS_TOTAL_STEPS", "8"))
+STEP_SLEEP = float(os.environ.get("CHAOS_STEP_SLEEP", "0"))
+
+
+@elastic.run
+def train(state):
+    while state.step < TOTAL_STEPS:
+        grad = hvd.allreduce(np.ones(4, np.float32), average=True,
+                             name="chaos_grad")
+        state.params["w"] = state.params["w"] + np.asarray(grad)
+        state.step += 1
+        state.commit()
+        if STEP_SLEEP:
+            time.sleep(STEP_SLEEP)
+    return state
+
+
+def _metric_total(snap, name):
+    fam = snap.get(name, {})
+    return float(sum(row.get("value", 0.0)
+                     for row in fam.get("values", ())))
+
+
+def main() -> int:
+    hvd.init()
+    state = elastic.ArrayState(
+        params={"w": np.zeros(4, np.float32)}, optimizer=None, step=0)
+    train(state)
+
+    w = float(state.params["w"][0])
+    snap = hvd.metrics()
+    result = {
+        "rank": hvd.rank(),
+        "size": hvd.size(),
+        "step": state.step,
+        "w": w,
+        "generation": elastic.restarts(),
+        "net_retries_total": _metric_total(
+            snap, "horovod_net_retries_total"),
+        "net_gave_up_total": _metric_total(
+            snap, "horovod_net_gave_up_total"),
+        "chaos_injected_total": _metric_total(
+            snap, "horovod_net_chaos_injected_total"),
+    }
+    try:  # the postmortem needs post-reform events (elastic_reform)
+        flight_recorder.dump_debug_state(reason="chaos_run_complete")
+    except Exception:
+        pass
+    print("CHAOS_RESULT " + json.dumps(result), flush=True)
+    ok = state.step == TOTAL_STEPS and abs(w - TOTAL_STEPS) <= 1e-4
+    hvd.shutdown()
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
