@@ -123,9 +123,11 @@ from horovod_tpu.ops.pallas import flash_attention
 from horovod_tpu.flight_recorder import dump_debug_state
 from horovod_tpu import profiler
 from horovod_tpu import checkpoint
+from horovod_tpu import ckpt
 from horovod_tpu import data
 from horovod_tpu import elastic
 from horovod_tpu.exceptions import (
+    CheckpointCorruptError,
     HorovodInternalError,
     HostsUpdatedInterrupt,
     WorkersDownError,
@@ -169,6 +171,8 @@ __all__ = [
     "switch_moe", "load_balance_loss", "default_capacity",
     # checkpoint / resume (rank-0 save + broadcast restore)
     "checkpoint",
+    # crash-consistent sharded checkpointing (two-phase commit + replicas)
+    "ckpt", "CheckpointCorruptError",
     "data",
     # elastic fault tolerance (reference: horovod.elastic)
     "elastic",

@@ -12,68 +12,76 @@ worker loads and rank 0's values are made authoritative via broadcast
     ...
     state, step = hvd.checkpoint.restore_latest(ckpt_dir, target=state)
 
-Serialization is flax msgpack (host-resident, framework-native); files are
-written atomically (tmp + rename) so a killed worker never leaves a torn
-checkpoint — the failure-handling analogue of the reference's launcher
-killing whole jobs on any rank failure (reference: gloo_run.py:256-262).
+This is the LEGACY single-writer path, kept as a thin shim over the
+PR-9 durability primitives in :mod:`horovod_tpu.ckpt.io`: atomic
+fsync'd publishes, pid-liveness tmp cleaning (an mtime-only window let
+two live writers with skewed clocks delete each other's fresh tmps),
+and a ``.crc`` sidecar — whole-file plus per-leaf digests — that
+``restore`` verifies, raising
+:class:`~horovod_tpu.exceptions.CheckpointCorruptError` naming the
+offending leaf. Sharded multi-writer checkpointing (every rank writes
+its ZeRO shard, two-phase commit, neighbor replicas) lives in
+:mod:`horovod_tpu.ckpt`.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
-import tempfile
-import time
 from typing import Any, Optional, Tuple
 
 import jax
 from flax import serialization
 
+from horovod_tpu.ckpt import io as ckpt_io
 from horovod_tpu.core import basics
+from horovod_tpu.exceptions import CheckpointCorruptError
 from horovod_tpu.parallel import dp
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
 
-# a .tmp this old belongs to a dead writer, not an in-flight save
-_STALE_TMP_SECONDS = 600.0
+# re-exported for callers that tuned the legacy knob; the pid-liveness
+# cleaner only uses it for foreign-host / legacy tmp names
+_STALE_TMP_SECONDS = ckpt_io.STALE_TMP_SECONDS
 
 
 def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(directory, f"ckpt_{step}.msgpack")
 
 
+def _crc_path(path: str) -> str:
+    return path + ".crc"
+
+
 def _fsync_dir(directory: str) -> None:
-    """Durably record the rename in the directory entry — without this a
-    host crash after ``os.replace`` can resurface the old (or no) file."""
-    try:
-        fd = os.open(directory, os.O_RDONLY)
-    except OSError:
-        return  # platform without directory fds
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
+    ckpt_io.fsync_dir(directory)
 
 
 def _clean_stale_tmps(directory: str) -> None:
     """Remove orphaned ``*.tmp`` files left by writers that were killed
-    mid-save (the elastic failure mode this module exists for). Only files
-    older than ``_STALE_TMP_SECONDS`` go — a concurrent live save keeps
-    its fresh tmp."""
-    try:
-        names = os.listdir(directory)
-    except OSError:
-        return
-    now = time.time()
-    for name in names:
-        if not name.endswith(".tmp"):
-            continue
-        path = os.path.join(directory, name)
+    mid-save. Staleness is pid-liveness for this host's tmps (the name
+    embeds ``hostname.pid``) and an mtime window only for legacy/foreign
+    names — see :func:`horovod_tpu.ckpt.io.clean_stale_tmps`."""
+    ckpt_io.clean_stale_tmps(directory)
+
+
+def _leaf_crcs(state: Any) -> dict:
+    """Per-leaf digests keyed by the flattened key path — lets a restore
+    failure name the damaged leaf instead of just the file."""
+    import numpy as np
+
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path) or "<root>"
         try:
-            if now - os.path.getmtime(path) > _STALE_TMP_SECONDS:
-                os.unlink(path)
-        except OSError:
-            pass  # raced with another cleaner, or already gone
+            data = np.ascontiguousarray(np.asarray(leaf)).tobytes()
+        except Exception:
+            continue  # non-array leaf: covered by the whole-file digest
+        out[key] = ckpt_io.checksum(data)
+    return out
 
 
 def save(directory: str, state: Any, step: int = 0,
@@ -82,6 +90,8 @@ def save(directory: str, state: Any, step: int = 0,
 
     Only rank 0 writes (the reference convention); other ranks return
     ``None`` immediately. ``keep`` retains only the newest N checkpoints.
+    Next to every checkpoint goes a ``.crc`` sidecar (whole-file and
+    per-leaf digests) that :func:`restore` verifies.
     """
     st = basics._ensure_init()
     if st.rank != 0:
@@ -91,21 +101,25 @@ def save(directory: str, state: Any, step: int = 0,
     state = jax.device_get(state)
     data = serialization.to_bytes(state)
     path = _ckpt_path(directory, step)
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())  # durable before it can be published
-        os.replace(tmp, path)  # atomic publish
-        _fsync_dir(directory)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    sidecar = json.dumps({
+        "algorithm": ckpt_io.CRC_ALGORITHM,
+        "file_crc": ckpt_io.checksum(data),
+        "bytes": len(data),
+        "leaves": _leaf_crcs(state),
+    }).encode()
+    # sidecar first: a crash between the two publishes leaves a
+    # checkpoint whose sidecar mismatches (detected and skipped), never
+    # a verified-but-wrong one
+    ckpt_io.atomic_write(_crc_path(path), sidecar, base="ckpt")
+    ckpt_io.atomic_write(path, data, base="ckpt")
     if keep is not None:
         for old_step in all_steps(directory)[:-keep]:
-            os.unlink(_ckpt_path(directory, old_step))
+            old = _ckpt_path(directory, old_step)
+            os.unlink(old)
+            try:
+                os.unlink(_crc_path(old))
+            except OSError:
+                pass
     return path
 
 
@@ -126,7 +140,61 @@ def latest_step(directory: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
-def restore(path: str, target: Any, broadcast: bool = True) -> Any:
+def _verify_sidecar(path: str, data: bytes, target: Any) -> None:
+    """Check ``data`` (and, when decodable, each leaf) against the
+    ``.crc`` sidecar. No sidecar (pre-PR-9 checkpoint) verifies
+    trivially; any mismatch raises :class:`CheckpointCorruptError`."""
+    try:
+        with open(_crc_path(path), "rb") as f:
+            sidecar = json.loads(f.read())
+    except OSError:
+        return  # legacy checkpoint without a sidecar
+    except ValueError as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint sidecar {_crc_path(path)} is unreadable: {exc}",
+            path=path) from exc
+    algorithm = sidecar.get("algorithm")
+    if "bytes" in sidecar and len(data) != int(sidecar["bytes"]):
+        raise CheckpointCorruptError(
+            f"checkpoint {path} has {len(data)} bytes; its sidecar "
+            f"recorded {sidecar['bytes']} (truncated or torn write)",
+            path=path)
+    if not ckpt_io.verify_checksum(data, sidecar.get("file_crc", 0),
+                                   algorithm):
+        # narrow it down to a leaf if the payload still decodes
+        leaf = _find_bad_leaf(target, data, sidecar, algorithm)
+        raise CheckpointCorruptError(
+            f"checkpoint {path} fails its whole-file CRC"
+            + (f" (first damaged leaf: {leaf!r})" if leaf else ""),
+            path=path, leaf=leaf)
+
+
+def _find_bad_leaf(target: Any, data: bytes, sidecar: dict,
+                   algorithm: Optional[str]) -> Optional[str]:
+    import numpy as np
+
+    try:
+        state = serialization.from_bytes(target, data)
+    except Exception:
+        return None
+    want = sidecar.get("leaves", {})
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path) or "<root>"
+        if key not in want:
+            continue
+        try:
+            blob = np.ascontiguousarray(np.asarray(leaf)).tobytes()
+        except Exception:
+            continue
+        if not ckpt_io.verify_checksum(blob, want[key], algorithm):
+            return key
+    return None
+
+
+def restore(path: str, target: Any, broadcast: bool = True,
+            verify: bool = True) -> Any:
     """Load a checkpoint file into the structure of ``target``.
 
     With ``broadcast`` (default), rank 0's loaded values are broadcast so
@@ -135,11 +203,27 @@ def restore(path: str, target: Any, broadcast: bool = True) -> Any:
     (reference: torch/__init__.py:255-403). A non-0 rank whose local
     filesystem lacks the file still participates: it feeds ``target``
     into the broadcast and receives rank 0's values.
+
+    With ``verify`` (default), the bytes are checked against the
+    ``.crc`` sidecar before deserialization; damage raises
+    :class:`CheckpointCorruptError` naming the leaf when it can be
+    narrowed down. Decode failures surface the same way — a truncated
+    msgpack can otherwise parse into garbage silently.
     """
     st = basics._ensure_init()
     if os.path.exists(path):
         with open(path, "rb") as f:
-            state = serialization.from_bytes(target, f.read())
+            data = f.read()
+        if verify:
+            _verify_sidecar(path, data, target)
+        try:
+            state = serialization.from_bytes(target, data)
+        except CheckpointCorruptError:
+            raise
+        except Exception as exc:
+            raise CheckpointCorruptError(
+                f"checkpoint {path} failed to deserialize: {exc}",
+                path=path) from exc
     elif broadcast and st.rank != 0:
         state = target  # overwritten by rank 0's broadcast below
     else:

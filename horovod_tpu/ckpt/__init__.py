@@ -1,0 +1,41 @@
+"""Crash-consistent distributed checkpointing.
+
+Sharded two-phase snapshots (every rank writes only its ZeRO shard plus
+its round-robin slice of the replicated state), a rendezvous-KV commit
+barrier with an atomically-published per-step manifest as the commit
+point, neighbor replication of shard bytes (in memory for elastic
+re-forms, on disk for single-file loss), and world-size-change restore.
+
+Modules:
+
+* :mod:`~horovod_tpu.ckpt.io` — CRCs, pid-named tmps, fsync'd renames.
+* :mod:`~horovod_tpu.ckpt.manifest` — the shard container + manifest.
+* :mod:`~horovod_tpu.ckpt.writer` — :class:`CheckpointManager`: the
+  stage/barrier/publish protocol on a background writer thread.
+* :mod:`~horovod_tpu.ckpt.restore` — ``restore_latest`` with replica
+  fallback and re-scatter into the current world size.
+* :mod:`~horovod_tpu.ckpt.replica` — the in-memory neighbor-replica
+  ring that fixes zero-moment loss on elastic recovery.
+* :mod:`~horovod_tpu.ckpt.stats` — ``horovod_ckpt_*`` metric families.
+"""
+
+from horovod_tpu.ckpt import io, manifest, replica, restore, stats, writer
+from horovod_tpu.ckpt.restore import (latest_step, restore_latest,
+                                      restore_step)
+from horovod_tpu.ckpt.writer import CheckpointManager, parse_fault
+from horovod_tpu.exceptions import CheckpointCorruptError
+
+__all__ = [
+    "CheckpointCorruptError",
+    "CheckpointManager",
+    "io",
+    "latest_step",
+    "manifest",
+    "parse_fault",
+    "replica",
+    "restore",
+    "restore_latest",
+    "restore_step",
+    "stats",
+    "writer",
+]

@@ -1,0 +1,198 @@
+"""Durable-file primitives shared by the sharded checkpoint writer and
+the legacy :mod:`horovod_tpu.checkpoint` shim.
+
+Three invariants every writer in this package leans on:
+
+* **torn writes are invisible** — payload goes to a ``*.tmp`` sibling,
+  is fsync'd, and only then ``os.replace``d over the published name
+  (followed by a directory fsync so the rename itself is durable);
+* **tmp staleness is keyed on writer liveness, not mtime** — the tmp
+  name embeds ``<hostname>.<pid>``, and the cleaner only removes a tmp
+  whose writer process is provably gone (``os.kill(pid, 0)``). An
+  mtime-only window (the pre-PR-9 rule) let two concurrent writers with
+  skewed clocks delete each other's *fresh* tmp files;
+* **integrity is checksummed** — CRC32C (Castagnoli) when a native
+  implementation is importable, else zlib's CRC-32; the algorithm tag is
+  recorded next to every digest so restore always verifies with the
+  algorithm that wrote it.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import tempfile
+import zlib
+from typing import Optional, Tuple
+
+# tmp names look like  <base>.<hostname>.<pid>.<random>.tmp ; the pid is
+# only meaningful on the host that wrote it
+_TMP_SUFFIX = ".tmp"
+
+# Castagnoli CRC when available (google-crc32c / crc32c wheels bundled
+# with some storage SDKs); the container is NOT allowed to grow a hard
+# dependency, so absence degrades to zlib's CRC-32 with a distinct tag.
+try:  # pragma: no cover - depends on the environment
+    import google_crc32c as _crc32c_mod
+
+    def _crc32c(data: bytes) -> int:
+        return int(_crc32c_mod.value(data))
+
+    CRC_ALGORITHM = "crc32c"
+except ImportError:  # pragma: no cover
+    try:
+        import crc32c as _crc32c_mod  # type: ignore
+
+        def _crc32c(data: bytes) -> int:
+            return int(_crc32c_mod.crc32c(data))
+
+        CRC_ALGORITHM = "crc32c"
+    except ImportError:
+        _crc32c_mod = None
+
+        def _crc32c(data: bytes) -> int:
+            return zlib.crc32(data) & 0xFFFFFFFF
+
+        CRC_ALGORITHM = "crc32"
+
+
+def checksum(data, running: int = 0) -> int:
+    """Digest of ``data`` (bytes or a buffer-protocol object), optionally
+    chained from a previous call's result."""
+    if CRC_ALGORITHM == "crc32":
+        return zlib.crc32(data, running) & 0xFFFFFFFF
+    if running:
+        # native crc32c modules don't expose chaining uniformly; chain by
+        # mixing, which stays deterministic for (algorithm, data) pairs
+        return _crc32c(running.to_bytes(4, "little") + bytes(data))
+    return _crc32c(bytes(data))
+
+
+def verify_checksum(data, want: int, algorithm: Optional[str]) -> bool:
+    """Check ``data`` against a recorded digest, honoring the algorithm
+    that wrote it (a crc32-tagged manifest verifies with zlib even when
+    a native crc32c is importable here, and vice versa)."""
+    if algorithm in (None, "crc32"):
+        return (zlib.crc32(bytes(data)) & 0xFFFFFFFF) == int(want)
+    if algorithm == "crc32c" and CRC_ALGORITHM == "crc32c":
+        return _crc32c(bytes(data)) == int(want)
+    # written with an algorithm this host cannot compute: unverifiable,
+    # not corrupt — the caller decides whether that is acceptable
+    return True
+
+
+def hostname() -> str:
+    try:
+        return socket.gethostname().split(".")[0] or "localhost"
+    except OSError:
+        return "localhost"
+
+
+def make_tmp(directory: str, base: str = "ckpt") -> Tuple[int, str]:
+    """``mkstemp`` with the writer's identity in the name:
+    ``<base>.<hostname>.<pid>.<random>.tmp``."""
+    prefix = f"{base}.{hostname()}.{os.getpid()}."
+    return tempfile.mkstemp(dir=directory, prefix=prefix,
+                            suffix=_TMP_SUFFIX)
+
+
+def parse_tmp_writer(name: str) -> Tuple[Optional[str], Optional[int]]:
+    """(hostname, pid) embedded in a tmp name, or (None, None) for a
+    legacy/foreign tmp."""
+    if not name.endswith(_TMP_SUFFIX):
+        return None, None
+    parts = name[:-len(_TMP_SUFFIX)].split(".")
+    # <base>.<hostname>.<pid>.<random>: pid is third-from-last
+    if len(parts) < 4:
+        return None, None
+    try:
+        return parts[-3], int(parts[-2])
+    except ValueError:
+        return None, None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return True  # indeterminate: keep the file
+    return True
+
+
+# mtime fallback for tmps that don't carry a writer identity (legacy
+# names, or names written by another host where a local pid probe would
+# alias an unrelated process)
+STALE_TMP_SECONDS = 600.0
+
+
+def clean_stale_tmps(directory: str, now: Optional[float] = None) -> int:
+    """Remove ``*.tmp`` files whose writer is dead. Returns the number
+    removed.
+
+    Staleness is decided by pid-liveness when the tmp was written by
+    THIS host (``os.kill(pid, 0)``): a live writer's tmp is never
+    touched no matter how old, and a dead writer's tmp goes immediately.
+    Foreign-host and legacy tmps fall back to the mtime window — the
+    only signal available for them."""
+    import time
+
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    if now is None:
+        now = time.time()
+    removed = 0
+    host = hostname()
+    for name in names:
+        if not name.endswith(_TMP_SUFFIX):
+            continue
+        path = os.path.join(directory, name)
+        tmp_host, tmp_pid = parse_tmp_writer(name)
+        try:
+            if tmp_pid is not None and tmp_host == host:
+                if _pid_alive(tmp_pid):
+                    continue  # fresh or slow writer — never its peer's call
+            elif now - os.path.getmtime(path) <= STALE_TMP_SECONDS:
+                continue
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass  # raced with another cleaner, or already gone
+    return removed
+
+
+def fsync_dir(directory: str) -> None:
+    """Durably record a rename in the directory entry — without this a
+    host crash after ``os.replace`` can resurface the old (or no) file."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes, base: str = "ckpt") -> None:
+    """Write ``data`` to ``path`` via the fsync'd tmp+rename protocol.
+    A crash at any instant leaves either the old ``path`` or the new one
+    — never a torn file."""
+    directory = os.path.dirname(path) or "."
+    fd, tmp = make_tmp(directory, base=base)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())  # durable before it can be published
+        os.replace(tmp, path)  # atomic publish
+        fsync_dir(directory)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

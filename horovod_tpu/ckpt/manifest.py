@@ -1,0 +1,269 @@
+"""Shard container format + the step manifest.
+
+One checkpoint = ``N`` shard files plus one ``MANIFEST-<step>.json``,
+all in one directory:
+
+* **shard file** (``shard-<step>-r<rank>-of-<world>.hvd``) — a
+  self-describing container: magic, a little-endian uint64 header
+  length, a JSON header, then the concatenated raw leaf bytes. The
+  header records, per entry, the leaf key, dtype/shape, byte extent and
+  CRC, plus a *role*: ``own`` (this rank's ZeRO shard), ``replica``
+  (the right neighbor's bytes, held for the elastic recovery path) or
+  ``replicated`` (this rank's round-robin slice of the replicated
+  state).
+* **manifest** — the commit point. It names every shard file with its
+  whole-file CRC and records the sharded-state layout (world size +
+  flat-group geometry), which is what lets restore re-flatten and
+  re-scatter into a *different* world size. ``restore_latest`` only
+  ever reads files a manifest names; everything else in the directory
+  is garbage-in-progress.
+
+Every parse error, short read, or digest mismatch surfaces as
+:class:`~horovod_tpu.exceptions.CheckpointCorruptError` carrying the
+file path and (for per-leaf damage) the leaf key.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.ckpt import io as ckpt_io
+from horovod_tpu.ckpt import stats
+from horovod_tpu.exceptions import CheckpointCorruptError
+
+MAGIC = b"HVDSHRD1"
+FORMAT = "hvdckpt-1"
+
+MANIFEST_RE = re.compile(r"^MANIFEST-(\d+)\.json$")
+
+ROLE_OWN = "own"
+ROLE_REPLICA = "replica"
+ROLE_REPLICATED = "replicated"
+
+
+def manifest_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"MANIFEST-{step}.json")
+
+
+def shard_name(step: int, rank: int, world: int) -> str:
+    return f"shard-{step}-r{rank}-of-{world}.hvd"
+
+
+# ---------------------------------------------------------------------------
+# Shard container
+# ---------------------------------------------------------------------------
+
+def array_entry(key: str, value, role: str = ROLE_OWN,
+                replica_of: Optional[int] = None) -> dict:
+    """Container entry for one numpy/JAX array leaf (0-d scalars
+    included). Non-array python objects go through :func:`object_entry`."""
+    arr = np.asarray(value)
+    data = np.ascontiguousarray(arr).tobytes()
+    return {"key": key, "kind": "array", "role": role,
+            "dtype": np.dtype(arr.dtype).name, "shape": list(arr.shape),
+            "replica_of": replica_of, "data": data,
+            "crc": ckpt_io.checksum(data)}
+
+
+def object_entry(key: str, value: Any, role: str = ROLE_OWN,
+                 replica_of: Optional[int] = None) -> dict:
+    data = pickle.dumps(value)
+    return {"key": key, "kind": "object", "role": role,
+            "dtype": None, "shape": None, "replica_of": replica_of,
+            "data": data, "crc": ckpt_io.checksum(data)}
+
+
+def pack_shard(entries: List[dict], meta: dict) -> bytes:
+    """Serialize entries into one container blob (header + payload)."""
+    records = []
+    offset = 0
+    for e in entries:
+        records.append({k: e[k] for k in
+                        ("key", "kind", "role", "dtype", "shape",
+                         "replica_of", "crc")}
+                       | {"offset": offset, "nbytes": len(e["data"])})
+        offset += len(e["data"])
+    header = json.dumps({
+        "meta": dict(meta, crc_algorithm=ckpt_io.CRC_ALGORITHM),
+        "entries": records,
+    }).encode()
+    parts = [MAGIC, struct.pack("<Q", len(header)), header]
+    parts.extend(e["data"] for e in entries)
+    return b"".join(parts)
+
+
+def read_shard(path: str, verify: bool = True) -> Tuple[dict, List[dict]]:
+    """Parse a shard container: ``(meta, entries)`` where each entry has
+    the header fields plus a decoded ``value``.
+
+    With ``verify`` every leaf's bytes are checked against the recorded
+    digest; a mismatch raises :class:`CheckpointCorruptError` naming the
+    leaf. Structural damage (bad magic, short file, unparseable header)
+    raises with ``leaf=None``."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint shard unreadable: {path}: {exc}",
+            path=path) from exc
+    if len(blob) < len(MAGIC) + 8 or not blob.startswith(MAGIC):
+        stats.INTEGRITY_FAILURES.inc()
+        raise CheckpointCorruptError(
+            f"checkpoint shard {path} is truncated or not a shard "
+            f"container (bad magic)", path=path)
+    (header_len,) = struct.unpack_from("<Q", blob, len(MAGIC))
+    body_off = len(MAGIC) + 8
+    try:
+        header = json.loads(blob[body_off:body_off + header_len])
+        meta = header["meta"]
+        records = header["entries"]
+    except (ValueError, KeyError, TypeError) as exc:
+        stats.INTEGRITY_FAILURES.inc()
+        raise CheckpointCorruptError(
+            f"checkpoint shard {path} has an unparseable header: {exc}",
+            path=path) from exc
+    payload_off = body_off + header_len
+    algorithm = meta.get("crc_algorithm")
+    entries = []
+    for rec in records:
+        start = payload_off + int(rec["offset"])
+        end = start + int(rec["nbytes"])
+        data = blob[start:end]
+        key = rec.get("key")
+        if len(data) != int(rec["nbytes"]):
+            stats.INTEGRITY_FAILURES.inc()
+            raise CheckpointCorruptError(
+                f"checkpoint shard {path} is truncated at leaf "
+                f"{key!r} (wanted {rec['nbytes']} bytes, file holds "
+                f"{len(data)})", path=path, leaf=key)
+        if verify and not ckpt_io.verify_checksum(
+                data, rec["crc"], algorithm):
+            stats.INTEGRITY_FAILURES.inc()
+            raise CheckpointCorruptError(
+                f"checkpoint shard {path}: CRC mismatch on leaf "
+                f"{key!r} — bytes on disk do not match what was "
+                f"written", path=path, leaf=key)
+        entry = dict(rec)
+        if rec["kind"] == "array":
+            try:
+                dt = np.dtype(rec["dtype"])
+            except TypeError:
+                import ml_dtypes  # noqa: F401  (registers bfloat16 etc.)
+                dt = np.dtype(rec["dtype"])
+            entry["value"] = np.frombuffer(data, dtype=dt).reshape(
+                rec["shape"]).copy()
+        else:
+            try:
+                entry["value"] = pickle.loads(data)
+            except Exception as exc:
+                stats.INTEGRITY_FAILURES.inc()
+                raise CheckpointCorruptError(
+                    f"checkpoint shard {path}: object leaf {key!r} "
+                    f"failed to decode: {exc}", path=path,
+                    leaf=key) from exc
+        entries.append(entry)
+    return meta, entries
+
+
+# ---------------------------------------------------------------------------
+# Manifest
+# ---------------------------------------------------------------------------
+
+def build_manifest(step: int, generation: int, world: int,
+                   shards: List[dict], sharded_layout: Dict[str, dict],
+                   extra: Optional[dict] = None) -> dict:
+    """``shards``: per-rank ``{"rank", "file", "bytes", "crc"}`` records
+    (whole-file digest of the published shard). ``sharded_layout``: per
+    sharded-state key, ``{"kind", "world", "groups": [[dtype, n,
+    shard_elems, padded], ...]}`` — enough to re-flatten under a new
+    world size."""
+    import time
+
+    manifest = {
+        "format": FORMAT,
+        "step": int(step),
+        "generation": int(generation),
+        "world": int(world),
+        "time": time.time(),
+        "crc_algorithm": ckpt_io.CRC_ALGORITHM,
+        "shards": sorted(shards, key=lambda s: int(s["rank"])),
+        "sharded": sharded_layout,
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def write_manifest(directory: str, manifest: dict) -> str:
+    path = manifest_path(directory, manifest["step"])
+    ckpt_io.atomic_write(
+        path, json.dumps(manifest, indent=1).encode(), base="manifest")
+    return path
+
+
+def load_manifest(directory: str, step: int) -> dict:
+    path = manifest_path(directory, step)
+    try:
+        with open(path, "rb") as f:
+            manifest = json.loads(f.read())
+    except OSError as exc:
+        raise CheckpointCorruptError(
+            f"manifest unreadable: {path}: {exc}", path=path) from exc
+    except ValueError as exc:
+        stats.INTEGRITY_FAILURES.inc()
+        raise CheckpointCorruptError(
+            f"manifest {path} is not valid JSON: {exc}",
+            path=path) from exc
+    if manifest.get("format") != FORMAT:
+        stats.INTEGRITY_FAILURES.inc()
+        raise CheckpointCorruptError(
+            f"manifest {path}: unknown format "
+            f"{manifest.get('format')!r}", path=path)
+    return manifest
+
+
+def all_steps(directory: str) -> List[int]:
+    """Steps with a published manifest, ascending."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = MANIFEST_RE.match(name)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def verify_manifest_files(directory: str, manifest: dict) -> None:
+    """Cheap consistency probe: every shard file the manifest names must
+    exist with the recorded size and whole-file digest. Raises
+    :class:`CheckpointCorruptError` naming the first damaged file."""
+    algorithm = manifest.get("crc_algorithm")
+    for rec in manifest["shards"]:
+        path = os.path.join(directory, rec["file"])
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            raise CheckpointCorruptError(
+                f"manifest names a missing shard file: {path}: {exc}",
+                path=path) from exc
+        if len(blob) != int(rec["bytes"]):
+            stats.INTEGRITY_FAILURES.inc()
+            raise CheckpointCorruptError(
+                f"shard file {path} has {len(blob)} bytes; manifest "
+                f"recorded {rec['bytes']} (torn or rewritten)",
+                path=path)
+        if not ckpt_io.verify_checksum(blob, rec["crc"], algorithm):
+            stats.INTEGRITY_FAILURES.inc()
+            raise CheckpointCorruptError(
+                f"shard file {path} fails its whole-file CRC",
+                path=path)

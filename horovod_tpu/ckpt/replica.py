@@ -1,0 +1,144 @@
+"""In-memory neighbor replication of ZeRO shards (Gemini-style).
+
+``zero.resync`` rebuilds a sharded optimizer state after an elastic
+re-form by allgathering the *surviving* shards — which leaves the dead
+rank's moment segments to a neutral fill (zeros). That silently perturbs
+training on every recovery. This module closes the gap: at every commit
+each rank ships its sharded-leaf bytes to its **left** neighbor (so rank
+``i`` holds rank ``(i+1) % N``'s shard) and keeps the received copy in
+host memory. When a re-form then loses one rank, the survivor holding
+its replica contributes the true bytes to the resync gathers and the
+restored moments are bit-identical to the last commit.
+
+Ordering contract (see ``elastic.State.commit``): the exchange runs
+*before* the in-memory snapshot. Either both complete — replica step ==
+snapshot step on every survivor — or the exchange raises (a peer died)
+and neither advances, so the pair can never disagree about which step a
+recovery rolls back to.
+
+The exchange is collective (two ragged allgathers over the data plane),
+so it must run on the training thread; the registry reads are local.
+Wire cost is one allgather of the shard payload per commit — bounded by
+the sharded-state bytes, i.e. ~1/N of the replicated optimizer bytes
+per rank. ``HOROVOD_CKPT_REPLICATION=0`` disables it.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu import flight_recorder
+from horovod_tpu.analysis import witness
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import _get_bool
+
+HOROVOD_CKPT_REPLICATION = "HOROVOD_CKPT_REPLICATION"
+
+_lock = witness.make_lock("ckpt.replica._lock")
+# {key: exported-shard-arrays} received from the right neighbor, plus
+# the tags needed to validate a later lookup
+_entries: Dict[str, Any] = {}  # guarded-by: _lock
+_src_rank: int = -1            # guarded-by: _lock
+_step: int = -1                # guarded-by: _lock
+
+
+def enabled() -> bool:
+    """Replication is on by default in any multi-process world of >= 2
+    ranks; it is meaningless single-process (every shard already lives
+    in this process)."""
+    if not _get_bool(HOROVOD_CKPT_REPLICATION, True):
+        return False
+    from horovod_tpu.core import state as state_mod
+    from horovod_tpu.ops import collectives
+
+    st = state_mod.global_state()
+    if not st.initialized:
+        # uninitialized use (e.g. single-process ArrayState.commit()
+        # before/without hvd.init()) — nothing to replicate to
+        return False
+    return st.size >= 2 and collectives._multiprocess_world(st)
+
+
+def exchange(entries: Dict[str, Any], step: int) -> None:
+    """Ring-shift the local sharded-leaf payloads one rank to the LEFT:
+    after this call, rank ``i`` holds rank ``(i+1) % N``'s ``entries``.
+
+    Collective — every rank must call it with the same key set in the
+    same commit. On success the registry atomically advances to
+    ``step``; on any failure (a dead peer, a transport timeout) it is
+    left at the previous commit, matching the snapshot the elastic
+    rollback will restore."""
+    from horovod_tpu.core import basics
+    from horovod_tpu.ops import collectives
+
+    st = basics._ensure_init()
+    blob = pickle.dumps({"rank": st.rank, "step": int(step),
+                         "entries": entries})
+    local = np.frombuffer(blob, np.uint8)
+    # ragged allgather: per-rank lengths first, then the payloads
+    lens = np.asarray(collectives.allgather(
+        np.array([local.shape[0]], np.int64),
+        name="ckpt_replica_len")).reshape(-1)
+    cat = np.asarray(collectives.allgather(
+        np.ascontiguousarray(local), name="ckpt_replica_payload"))
+    neighbor = (st.rank + 1) % st.size
+    off = int(lens[:neighbor].sum())
+    received = pickle.loads(
+        cat[off:off + int(lens[neighbor])].tobytes())
+    if received["rank"] != neighbor or received["step"] != int(step):
+        # peers disagree about membership/step: do not poison the store
+        log.warning(
+            "ckpt replica exchange: unexpected payload from neighbor "
+            "(rank %s step %s, wanted rank %s step %s) — keeping the "
+            "previous replica", received["rank"], received["step"],
+            neighbor, step)
+        return
+    global _entries, _src_rank, _step
+    with _lock:
+        _entries = received["entries"]
+        _src_rank = neighbor
+        _step = int(step)
+
+
+def lookup(key: str, step: Optional[int] = None
+           ) -> Optional[Tuple[int, Any]]:
+    """(source_rank, exported-arrays) for ``key`` if this rank holds a
+    replica from commit ``step`` (any step when ``step`` is None)."""
+    with _lock:
+        if key not in _entries:
+            return None
+        if step is not None and _step != int(step):
+            return None
+        return _src_rank, _entries[key]
+
+
+def holdings() -> Tuple[int, int, Tuple[str, ...]]:
+    """(source_rank, step, keys) — flight-recorder state provider."""
+    with _lock:
+        return _src_rank, _step, tuple(_entries)
+
+
+def export_store() -> Optional[Tuple[int, int, Dict[str, Any]]]:
+    """Atomic snapshot ``(source_rank, step, entries)`` for the
+    checkpoint writer, or None when empty. The entry values are never
+    mutated after the exchange, so handing the (shallow-copied) dict to
+    a background thread is race-free."""
+    with _lock:
+        if not _entries:
+            return None
+        return _src_rank, _step, dict(_entries)
+
+
+def clear(reason: str = "") -> None:
+    """Drop the store — called after a re-form's sync completes (the
+    old-rank tags are meaningless in the new membership) and by
+    shutdown."""
+    global _entries, _src_rank, _step
+    with _lock:
+        had = bool(_entries)
+        _entries, _src_rank, _step = {}, -1, -1
+    if had and reason:
+        flight_recorder.emit("ckpt_replica_clear", reason=reason)

@@ -1,0 +1,249 @@
+"""Restore the newest consistent checkpoint cut.
+
+``restore_latest`` walks the published manifests newest-first and, for
+the first one whose files check out, rebuilds the full state:
+
+* **replicated** leaves come straight from whichever rank's shard file
+  round-robin-owned them;
+* **sharded** leaves (ZeRO) are reassembled into FULL flat buffers from
+  every rank's ``own`` segments, then re-sliced for the *current* world
+  size via :func:`horovod_tpu.parallel.zero.from_full_buffers` — the
+  manifest records the writing layout, so restoring into a different
+  world size is a re-flatten/re-scatter, not an error;
+* a missing or corrupt shard file falls back to the ``replica`` section
+  of its left neighbor's file (each rank also writes rank
+  ``(r+1) % N``'s bytes), so any single-file loss per checkpoint is
+  recoverable;
+* an unrecoverable manifest (two adjacent files gone, CRC damage in
+  both copies) is skipped with a warning and the next-older cut is
+  tried — a torn commit can never shadow an intact one.
+
+All integrity damage surfaces as
+:class:`~horovod_tpu.exceptions.CheckpointCorruptError` carrying the
+file path and offending leaf key.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu import flight_recorder
+from horovod_tpu.ckpt import manifest as mf
+from horovod_tpu.ckpt import stats
+from horovod_tpu.exceptions import CheckpointCorruptError
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import _get_bool
+
+HOROVOD_CKPT_VERIFY = "HOROVOD_CKPT_VERIFY"
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = mf.all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def _read_rank_entries(directory: str, manifest: dict, verify: bool
+                       ) -> Dict[int, List[dict]]:
+    """Per old-rank entry lists, substituting the left neighbor's
+    ``replica`` section for any unreadable file."""
+    world = int(manifest["world"])
+    by_rank: Dict[int, List[dict]] = {}
+    failures: Dict[int, Exception] = {}
+    raw: Dict[int, Tuple[dict, List[dict]]] = {}
+    for rec in manifest["shards"]:
+        r = int(rec["rank"])
+        path = os.path.join(directory, rec["file"])
+        try:
+            if verify:
+                blob_ok = (os.path.isfile(path)
+                           and os.path.getsize(path) == int(rec["bytes"]))
+                if not blob_ok:
+                    raise CheckpointCorruptError(
+                        f"shard file {path} missing or wrong size",
+                        path=path)
+            raw[r] = mf.read_shard(path, verify=verify)
+        except (CheckpointCorruptError, OSError) as exc:
+            failures[r] = exc
+    for r, (_meta, entries) in raw.items():
+        by_rank[r] = [e for e in entries
+                      if e["role"] in (mf.ROLE_OWN, mf.ROLE_REPLICATED)]
+    for r, exc in failures.items():
+        left = (r - 1) % world
+        rep = [dict(e, role=(mf.ROLE_OWN if "#" in e["key"]
+                             else mf.ROLE_REPLICATED))
+               for _m, entries in ([raw[left]] if left in raw else [])
+               for e in entries
+               if e["role"] == mf.ROLE_REPLICA
+               and e.get("replica_of") == r]
+        if not rep:
+            raise CheckpointCorruptError(
+                f"shard file for rank {r} is damaged ({exc}) and its "
+                f"left neighbor (rank {left}) holds no usable replica",
+                path=getattr(exc, "path", None),
+                leaf=getattr(exc, "leaf", None))
+        log.warning("checkpoint restore: rank %d's shard file is "
+                    "damaged (%s); recovered from rank %d's replica "
+                    "section", r, exc, left)
+        stats.REPLICA_RESTORES.inc()
+        flight_recorder.emit("ckpt_restore_replica", rank=r,
+                             source=left, step=int(manifest["step"]))
+        by_rank[r] = rep
+    return by_rank
+
+
+def _assemble(manifest: dict, by_rank: Dict[int, List[dict]]
+              ) -> Tuple[Dict[str, Any], Dict[str, Dict[str, Any]]]:
+    """(replicated-leaf values by key, per-sharded-key FULL buffers)."""
+    world = int(manifest["world"])
+    replicated: Dict[str, Any] = {}
+    sub: Dict[str, Dict[int, Any]] = {}  # subkey -> {rank: array}
+    for r, entries in by_rank.items():
+        for e in entries:
+            if e["role"] == mf.ROLE_REPLICATED:
+                replicated.setdefault(e["key"], e["value"])
+            else:
+                sub.setdefault(e["key"], {})[r] = e["value"]
+    full: Dict[str, Dict[str, Any]] = {}
+    for key, layout in manifest.get("sharded", {}).items():
+        groups = layout["groups"]
+
+        def _full_buffer(subkey: str, gi: int) -> np.ndarray:
+            _dt, _n, shard_elems, padded = groups[gi]
+            per_rank = sub.get(subkey, {})
+            if len(per_rank) < world:
+                missing = sorted(set(range(world)) - set(per_rank))
+                raise CheckpointCorruptError(
+                    f"sharded leaf {subkey!r}: missing segments from "
+                    f"ranks {missing}", leaf=subkey)
+            sample = next(iter(per_rank.values()))
+            buf = np.zeros((int(padded),), np.asarray(sample).dtype)
+            for r in range(world):
+                seg = np.asarray(per_rank[r]).reshape(-1)
+                if seg.shape[0] != int(shard_elems):
+                    raise CheckpointCorruptError(
+                        f"sharded leaf {subkey!r}: rank {r} segment "
+                        f"has {seg.shape[0]} elements, layout says "
+                        f"{shard_elems}", leaf=subkey)
+                buf[r * int(shard_elems):(r + 1) * int(shard_elems)] = seg
+            return buf
+
+        if layout["kind"] == "flat_adamw":
+            counts = sub.get(f"{key}#count", {})
+            if not counts:
+                raise CheckpointCorruptError(
+                    f"sharded leaf {key!r}: no count entry",
+                    leaf=f"{key}#count")
+            full[key] = {
+                "kind": "flat_adamw",
+                "count": np.asarray(next(iter(counts.values()))),
+                "master": [_full_buffer(f"{key}#master/{gi}", gi)
+                           for gi in range(len(groups))],
+                "mu": [_full_buffer(f"{key}#mu/{gi}", gi)
+                       for gi in range(len(groups))],
+                "nu": [_full_buffer(f"{key}#nu/{gi}", gi)
+                       for gi in range(len(groups))],
+            }
+        else:
+            leaves: List[Any] = []
+            li = 0
+            while f"{key}#leaf/{li}" in sub:
+                per_rank = sub[f"{key}#leaf/{li}"]
+                sample = np.asarray(next(iter(per_rank.values())))
+                if sample.ndim == 0:
+                    leaves.append(sample)
+                else:
+                    gi = _group_for(groups, per_rank)
+                    leaves.append(_full_buffer(f"{key}#leaf/{li}", gi))
+                li += 1
+            full[key] = {"kind": "generic", "leaves": leaves}
+    return replicated, full
+
+
+def _group_for(groups, per_rank) -> int:
+    n = int(np.asarray(next(iter(per_rank.values()))).reshape(-1).shape[0])
+    for gi, (_dt, _gn, shard_elems, _p) in enumerate(groups):
+        if int(shard_elems) == n:
+            return gi
+    raise CheckpointCorruptError(
+        f"generic sharded leaf with {n} elements matches no layout "
+        f"group {groups!r}")
+
+
+def restore_step(directory: str, step: int, target_trees: Dict[str, Any],
+                 verify: Optional[bool] = None
+                 ) -> Tuple[Dict[str, Any], int]:
+    """Rebuild ``target_trees``-shaped state from the manifest at
+    ``step``. Raises :class:`CheckpointCorruptError` when the cut is
+    unrecoverable."""
+    import jax
+
+    from horovod_tpu.parallel import zero
+
+    if verify is None:
+        verify = _get_bool(HOROVOD_CKPT_VERIFY, True)
+    manifest = mf.load_manifest(directory, step)
+    by_rank = _read_rank_entries(directory, manifest, verify)
+    replicated, full = _assemble(manifest, by_rank)
+    out: Dict[str, Any] = {}
+    index = 0
+    for name in sorted(target_trees):
+        tree = target_trees[name]
+        if tree is None:
+            out[name] = None
+            continue
+        flat, treedef = jax.tree_util.tree_flatten(
+            tree, is_leaf=zero.is_sharded_state)
+        new_flat = []
+        for leaf in flat:
+            key = f"{name}/{index}"
+            index += 1
+            if zero.is_sharded_state(leaf):
+                if key not in full:
+                    raise CheckpointCorruptError(
+                        f"checkpoint has no sharded record for {key!r} "
+                        f"(state structure changed?)", leaf=key)
+                new_flat.append(zero.from_full_buffers(
+                    leaf, full[key],
+                    manifest["sharded"][key]["groups"]))
+            else:
+                if key not in replicated:
+                    raise CheckpointCorruptError(
+                        f"checkpoint has no record for leaf {key!r} "
+                        f"(state structure changed?)", leaf=key)
+                new_flat.append(replicated[key])
+        out[name] = jax.tree_util.tree_unflatten(treedef, new_flat)
+    return out, int(manifest["step"])
+
+
+def restore_latest(directory: str, target_trees: Dict[str, Any],
+                   verify: Optional[bool] = None
+                   ) -> Tuple[Optional[Dict[str, Any]], Optional[int]]:
+    """Newest consistent cut, or ``(None, None)`` when the directory
+    holds no checkpoint at all. Corrupt/torn newer cuts are skipped
+    (with a warning); if every published cut is damaged the LAST error
+    propagates — silently training from scratch over recoverable data
+    loss is worse than failing loudly."""
+    steps = mf.all_steps(directory)
+    if not steps:
+        return None, None
+    t0 = time.monotonic()
+    last_error: Optional[Exception] = None
+    for step in reversed(steps):
+        try:
+            trees, got = restore_step(directory, step, target_trees,
+                                      verify=verify)
+        except CheckpointCorruptError as exc:
+            last_error = exc
+            log.warning("checkpoint at step %d is not restorable (%s); "
+                        "falling back to the previous cut", step, exc)
+            continue
+        stats.RESTORE_SECONDS.observe(time.monotonic() - t0)
+        flight_recorder.emit("ckpt_restore", step=got,
+                             directory=directory,
+                             seconds=round(time.monotonic() - t0, 6))
+        return trees, got
+    raise last_error  # type: ignore[misc]

@@ -1,0 +1,621 @@
+"""Sharded two-phase checkpoint commits.
+
+Every rank serializes ONLY what it owns — its ZeRO shard, its
+round-robin slice of the replicated state, and (when replication is on)
+its right neighbor's bytes — into one self-describing container
+(:mod:`horovod_tpu.ckpt.manifest`). Commits are crash-consistent by
+construction:
+
+1. **stage** — write the container to a pid-named ``*.tmp`` in the
+   checkpoint directory, fsync it, and announce ``staged.<rank>`` on the
+   rendezvous KV (scope ``ckpt.g<generation>.s<step>``).
+2. **barrier** — wait until all ``world`` ranks have staged. A timeout,
+   a dead peer, or a generation change abandons the commit: the tmp is
+   unlinked and the previous manifest stays authoritative.
+3. **publish** — fsync'd rename tmp -> final shard name, announce
+   ``published.<rank>`` (with the whole-file digest), and the leader
+   (rank 0), once all ranks have published, atomically writes
+   ``MANIFEST-<step>.json`` — the commit point. ``restore_latest`` only
+   ever reads files a manifest names, so a rank killed at ANY instant
+   of this protocol leaves the newest *published* checkpoint intact.
+
+The KV barrier runs over HTTP on the background writer thread — it must
+NOT use collectives (those belong to the training thread and would
+interleave with training traffic). Without a rendezvous KV in a
+multi-process world the barrier is skipped with a warning and the
+restore-side manifest verification is the net.
+
+Asynchrony: ``commit()`` only pays the device->host-slab copy inline
+(the slab reuses the PR-3 fusion-buffer allocator, and holding the
+lease until the write completes is the copy-on-commit guard); callers
+that already hand over an immutable host snapshot (``ArrayState._saved``
+— replaced, never mutated, on each ``save()``) pass ``copy=False`` and
+skip even that. Serialization, staging and the barrier run on
+``hvd-ckpt-writer``. The
+handoff is a blocking one-slot queue — back-pressure, NOT latest-wins:
+every rank must attempt the same set of steps or the barrier could
+never form.
+
+``HOROVOD_CKPT_FAULT=kill:rank=<r>:phase=<stage|barrier|publish>``
+kills the matching rank at that exact protocol point (chaos matrix /
+crash-consistency tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu import flight_recorder
+from horovod_tpu.ckpt import io as ckpt_io
+from horovod_tpu.ckpt import manifest as mf
+from horovod_tpu.ckpt import replica as replica_mod
+from horovod_tpu.ckpt import stats
+from horovod_tpu.elastic import fault_inject
+from horovod_tpu.runtime.fusion_buffer import FusionBufferManager
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import _get_bool, _get_float, _get_int
+
+HOROVOD_CKPT_DIR = "HOROVOD_CKPT_DIR"
+HOROVOD_CKPT_ASYNC = "HOROVOD_CKPT_ASYNC"
+HOROVOD_CKPT_KEEP = "HOROVOD_CKPT_KEEP"
+HOROVOD_CKPT_BARRIER_TIMEOUT_SECONDS = \
+    "HOROVOD_CKPT_BARRIER_TIMEOUT_SECONDS"
+HOROVOD_CKPT_FAULT = "HOROVOD_CKPT_FAULT"
+
+DEFAULT_KEEP = 2
+DEFAULT_BARRIER_TIMEOUT = 30.0
+
+_PHASES = ("stage", "barrier", "publish")
+
+
+class FaultSpec(NamedTuple):
+    rank: int
+    phase: str
+    step: Optional[int]
+    code: int
+
+
+def parse_fault(text: str) -> Optional[FaultSpec]:
+    """``kill:rank=<r>:phase=<stage|barrier|publish>[:step=<s>][:code=<c>]``
+    — the checkpoint-protocol sibling of ``fault_inject.parse_spec``
+    (which targets training steps, not commit phases)."""
+    text = (text or "").strip()
+    if not text:
+        return None
+    parts = text.split(":")
+    if parts[0] != "kill":
+        raise ValueError(
+            f"HOROVOD_CKPT_FAULT action must be 'kill', got {parts[0]!r}")
+    fields: Dict[str, str] = {}
+    for part in parts[1:]:
+        k, _, v = part.partition("=")
+        fields[k] = v
+    if "rank" not in fields or "phase" not in fields:
+        raise ValueError(
+            "HOROVOD_CKPT_FAULT needs rank= and phase= "
+            f"(got {text!r})")
+    phase = fields["phase"]
+    if phase not in _PHASES:
+        raise ValueError(
+            f"HOROVOD_CKPT_FAULT phase must be one of {_PHASES}, "
+            f"got {phase!r}")
+    return FaultSpec(rank=int(fields["rank"]), phase=phase,
+                     step=(int(fields["step"]) if "step" in fields
+                           else None),
+                     code=int(fields.get("code", 1)))
+
+
+def _kv_from_env(scope: str, timeout: float):
+    """Rendezvous KV client for the commit barrier, or None outside a
+    launcher-managed job (same env contract as elastic.runner)."""
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_HTTP_ADDR")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_HTTP_PORT")
+    if not addr or not port:
+        return None
+    from horovod_tpu.run.rendezvous import KVStoreClient
+
+    return KVStoreClient(addr, int(port), scope=scope, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot plan: split the state trees into this rank's items
+# ---------------------------------------------------------------------------
+
+class _Item(NamedTuple):
+    key: str
+    kind: str                 # "array" | "object"
+    role: str                 # manifest.ROLE_*
+    value: Any
+    replica_of: Optional[int]
+
+
+def _expand_sharded(key: str, export: Dict[str, Any], role: str,
+                    replica_of: Optional[int]) -> List[_Item]:
+    """One exported sharded state -> flat subkey items
+    (``{key}#master/<gi>`` ...), the unit a shard file stores."""
+    items: List[_Item] = []
+    if export.get("kind") == "flat_adamw":
+        items.append(_Item(f"{key}#count", "array", role,
+                           np.asarray(export["count"]), replica_of))
+        for comp in ("master", "mu", "nu"):
+            for gi, arr in enumerate(export[comp]):
+                items.append(_Item(f"{key}#{comp}/{gi}", "array", role,
+                                   np.asarray(arr), replica_of))
+    else:
+        for li, arr in enumerate(export["leaves"]):
+            items.append(_Item(f"{key}#leaf/{li}", "array", role,
+                               np.asarray(arr), replica_of))
+    return items
+
+
+def build_rank_payload(trees: Dict[str, Any], rank: int, world: int
+                       ) -> Tuple[List[_Item], Dict[str, dict],
+                                  Dict[str, Any]]:
+    """Split host-resident state trees into this rank's shard items.
+
+    Returns ``(items, sharded_layout, exchange_entries)``:
+
+    * sharded leaves (``zero.is_sharded_state``) -> ``own`` subkey items
+      plus a manifest layout record (world-size-change restore);
+    * every other leaf is replicated state — round-robin owned: rank
+      ``leaf_index % world`` writes it (role ``replicated``);
+    * ``exchange_entries`` is what the neighbor-replica ring ships:
+      the sharded exports by key, plus this rank's replicated slice
+      under ``item:``-prefixed keys — so a lost rank's shard FILE is
+      fully reconstructible from its left neighbor's.
+    """
+    import jax
+
+    from horovod_tpu.parallel import zero
+
+    items: List[_Item] = []
+    layout: Dict[str, dict] = {}
+    exchange: Dict[str, Any] = {}
+    index = 0
+    for name in sorted(trees):
+        tree = trees[name]
+        if tree is None:
+            continue
+        flat, _ = jax.tree_util.tree_flatten(
+            tree, is_leaf=zero.is_sharded_state)
+        for leaf in flat:
+            i, index = index, index + 1
+            key = f"{name}/{i}"
+            if zero.is_sharded_state(leaf):
+                export = zero.export_shard_arrays(leaf)
+                layout[key] = zero.layout_of(leaf)
+                items.extend(_expand_sharded(key, export, mf.ROLE_OWN,
+                                             None))
+                exchange[key] = export
+            elif i % world == rank:
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    value = np.asarray(leaf)
+                    items.append(_Item(key, "array", mf.ROLE_REPLICATED,
+                                       value, None))
+                    exchange[f"item:{key}"] = {"kind": "array",
+                                               "value": value}
+                else:
+                    items.append(_Item(key, "object",
+                                       mf.ROLE_REPLICATED, leaf, None))
+                    exchange[f"item:{key}"] = {"kind": "object",
+                                               "value": leaf}
+    return items, layout, exchange
+
+
+def _replica_items(src_rank: int, entries: Dict[str, Any]) -> List[_Item]:
+    """The neighbor's exchanged entries -> ``replica`` role items."""
+    items: List[_Item] = []
+    for key in sorted(entries):
+        payload = entries[key]
+        if key.startswith("item:"):
+            items.append(_Item(key[len("item:"):], payload["kind"],
+                               mf.ROLE_REPLICA, payload["value"],
+                               src_rank))
+        elif isinstance(payload, dict) and "kind" in payload:
+            items.extend(_expand_sharded(key, payload, mf.ROLE_REPLICA,
+                                         src_rank))
+    return items
+
+
+# ---------------------------------------------------------------------------
+# Commit manager
+# ---------------------------------------------------------------------------
+
+class _Pending(NamedTuple):
+    step: int
+    generation: int
+    rank: int
+    world: int
+    items: List[_Item]
+    layout: Dict[str, dict]
+    leases: List[Any]
+
+
+class CheckpointManager:
+    """Per-process commit pipeline: inline host-slab snapshot + the
+    staged/barrier/publish protocol on a background writer thread."""
+
+    def __init__(self, directory: str, *,
+                 async_write: Optional[bool] = None,
+                 keep: Optional[int] = None,
+                 barrier_timeout: Optional[float] = None,
+                 generation_fn=None):
+        self.directory = directory
+        self._async = (_get_bool(HOROVOD_CKPT_ASYNC, True)
+                       if async_write is None else bool(async_write))
+        self._keep = (keep if keep is not None
+                      else _get_int(HOROVOD_CKPT_KEEP, DEFAULT_KEEP))
+        self._barrier_timeout = (
+            barrier_timeout if barrier_timeout is not None
+            else _get_float(HOROVOD_CKPT_BARRIER_TIMEOUT_SECONDS,
+                            DEFAULT_BARRIER_TIMEOUT))
+        self._generation_fn = generation_fn or (lambda: 0)
+        self._fault = parse_fault(os.environ.get(HOROVOD_CKPT_FAULT, ""))
+        self._slab = FusionBufferManager()
+        # one-slot blocking handoff: commit() blocks while a prior write
+        # is still queued (back-pressure keeps all ranks on the same
+        # step set — a latest-wins queue would starve the barrier)
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=1)
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._published_steps: List[int] = []  # this rank's, oldest first
+        self._warned_no_kv = False
+        os.makedirs(directory, exist_ok=True)
+
+    # -- training-thread side ---------------------------------------------
+
+    def commit(self, trees: Dict[str, Any], step: int,
+               generation: Optional[int] = None,
+               rank: Optional[int] = None,
+               world: Optional[int] = None,
+               copy: bool = True) -> None:
+        """Snapshot ``trees`` (host pytrees, e.g. ``ArrayState._saved``)
+        into reusable host slabs and hand them to the writer. Only the
+        slab copy and the (possibly blocking) handoff run inline.
+
+        ``copy=False`` skips the slab copy and hands the caller's arrays
+        to the writer directly — valid ONLY when the caller guarantees
+        the trees are a host-resident snapshot that is *replaced, never
+        mutated* after this call (``ArrayState._saved``'s contract: each
+        ``save()`` builds a fresh dict of fresh host copies, so a blob
+        the writer is still serializing can never change underneath it)."""
+        t0 = time.monotonic()
+        if rank is None or world is None:
+            from horovod_tpu.core import basics
+            from horovod_tpu.ops import collectives
+            st = basics._ensure_init()
+            if collectives._multiprocess_world(st):
+                rank = st.rank if rank is None else rank
+                world = st.size if world is None else world
+            else:
+                # single-process world (e.g. the 8-device virtual CPU
+                # mesh): ONE writer process owns every shard — a world
+                # of st.size would await shard files no other process
+                # exists to write and abandon every commit
+                rank = 0 if rank is None else rank
+                world = 1 if world is None else world
+        if generation is None:
+            generation = self._generation_fn()
+        items, layout, _exchange = build_rank_payload(trees, rank, world)
+        if copy:
+            items, leases = self._slab_copy(items)
+        else:
+            leases = []
+        rep = replica_mod.export_store()
+        if rep is not None and rep[1] == int(step):
+            items = items + _replica_items(rep[0], rep[2])
+        pending = _Pending(step=int(step), generation=int(generation),
+                           rank=int(rank), world=int(world),
+                           items=items, layout=layout, leases=leases)
+        if self._async:
+            self._ensure_thread()
+            self._queue.put(pending)  # blocks when the slot is full
+        else:
+            self._write_commit(pending)
+        stats.SNAPSHOT_SECONDS.observe(time.monotonic() - t0)
+
+    def _slab_copy(self, items: List[_Item]
+                   ) -> Tuple[List[_Item], List[Any]]:
+        """Copy array values into fusion-buffer leases grouped by dtype.
+        The returned items view the slab, so the caller's arrays may be
+        mutated or freed the moment commit() returns; the leases are
+        held until the write completes (copy-on-commit guard)."""
+        by_dtype: Dict[str, List[int]] = {}
+        for idx, item in enumerate(items):
+            if item.kind == "array":
+                by_dtype.setdefault(np.dtype(item.value.dtype).name,
+                                    []).append(idx)
+        out = list(items)
+        leases: List[Any] = []
+        for dts, idxs in sorted(by_dtype.items()):
+            total = sum(int(np.asarray(items[i].value).size)
+                        for i in idxs)
+            if total == 0:
+                continue
+            lease = self._slab.acquire(1, total, np.dtype(dts))
+            leases.append(lease)
+            flat = lease.array[0]
+            off = 0
+            for i in idxs:
+                src = np.asarray(items[i].value)
+                n = int(src.size)
+                np.copyto(flat[off:off + n], src.reshape(-1))
+                out[i] = items[i]._replace(
+                    value=flat[off:off + n].reshape(src.shape))
+                off += n
+        return out, leases
+
+    def wait(self) -> None:
+        """Block until every handed-off commit has been written (or
+        abandoned)."""
+        self._queue.join()
+
+    def close(self) -> None:
+        self.wait()
+        self._closed = True
+
+    # -- writer thread -----------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="hvd-ckpt-writer")
+            self._thread.start()
+
+    def _writer_loop(self) -> None:
+        while not self._closed:
+            try:
+                pending = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._write_commit(pending)
+            except Exception as exc:
+                stats.COMMITS_ABANDONED.inc()
+                log.warning("checkpoint commit at step %s abandoned: %s",
+                            pending.step, exc)
+            finally:
+                self._queue.task_done()
+
+    def _maybe_fault(self, phase: str, step: int) -> None:
+        spec = self._fault
+        if spec is None or spec.phase != phase:
+            return
+        if spec.rank != fault_inject.initial_rank():
+            return
+        if spec.step is not None and spec.step != step:
+            return
+        log.error("ckpt fault injection: killing rank %d at commit "
+                  "phase %r (step %d)", spec.rank, phase, step)
+        flight_recorder.emit("ckpt_fault_kill", phase=phase, step=step,
+                             rank=spec.rank)
+        flight_recorder.dump_on_failure("ckpt_fault_kill")
+        import sys
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(spec.code)
+
+    def _write_commit(self, p: _Pending) -> bool:
+        stats.COMMITS.inc()
+        t0 = time.monotonic()
+        tmp = None
+        try:
+            ckpt_io.clean_stale_tmps(self.directory)
+            entries = []
+            for item in p.items:
+                if item.kind == "array":
+                    entries.append(mf.array_entry(
+                        item.key, item.value, role=item.role,
+                        replica_of=item.replica_of))
+                else:
+                    entries.append(mf.object_entry(
+                        item.key, item.value, role=item.role,
+                        replica_of=item.replica_of))
+            blob = mf.pack_shard(entries, meta={
+                "step": p.step, "generation": p.generation,
+                "rank": p.rank, "world": p.world})
+            final_name = mf.shard_name(p.step, p.rank, p.world)
+            file_crc = ckpt_io.checksum(blob)
+            record = json.dumps({
+                "rank": p.rank, "file": final_name,
+                "bytes": len(blob), "crc": file_crc}).encode()
+            # -- stage ------------------------------------------------
+            fd, tmp = ckpt_io.make_tmp(self.directory, base=final_name)
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            self._maybe_fault("stage", p.step)
+            kv = self._barrier_kv(p)
+            if kv is not None:
+                kv.set(f"staged.{p.rank}", record)
+            self._maybe_fault("barrier", p.step)
+            if not self._await_count(kv, "staged.", p):
+                self._abandon(p, tmp, "barrier")
+                return False
+            # -- publish ----------------------------------------------
+            final = os.path.join(self.directory, final_name)
+            os.replace(tmp, final)
+            tmp = None
+            ckpt_io.fsync_dir(self.directory)
+            self._maybe_fault("publish", p.step)
+            if kv is not None:
+                kv.set(f"published.{p.rank}", record)
+            if p.rank == 0:
+                if not self._publish_manifest(kv, p, record):
+                    self._abandon(p, None, "publish")
+                    return False
+            self._published_steps.append(p.step)
+            self._gc(p)
+            stats.BYTES.inc(len(blob))
+            stats.COMMIT_SECONDS.observe(time.monotonic() - t0)
+            flight_recorder.emit(
+                "ckpt_commit", step=p.step, generation=p.generation,
+                rank=p.rank, bytes=len(blob),
+                seconds=round(time.monotonic() - t0, 6))
+            return True
+        except Exception:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            raise
+        finally:
+            for lease in p.leases:
+                try:
+                    self._slab.release(lease)
+                except Exception:  # pragma: no cover - release best-effort
+                    pass
+
+    # -- protocol helpers --------------------------------------------------
+
+    def _barrier_kv(self, p: _Pending):
+        if p.world <= 1:
+            return None
+        scope = f"ckpt.g{p.generation}.s{p.step}"
+        kv = _kv_from_env(scope, self._barrier_timeout)
+        if kv is None and not self._warned_no_kv:
+            self._warned_no_kv = True
+            log.warning(
+                "checkpointing in a %d-rank world without a rendezvous "
+                "KV (HOROVOD_RENDEZVOUS_HTTP_ADDR unset): the commit "
+                "barrier is skipped; restore-side manifest verification "
+                "is the only consistency net", p.world)
+        return kv
+
+    def _await_count(self, kv, prefix: str, p: _Pending) -> bool:
+        """True once all ``world`` ranks announced ``prefix``; False on
+        timeout or a generation change (the commit must be abandoned)."""
+        if kv is None:
+            return True
+        deadline = time.monotonic() + self._barrier_timeout
+        while True:
+            if self._generation_fn() != p.generation:
+                return False
+            try:
+                names = kv.keys()
+            except Exception:
+                names = []
+            if sum(1 for k in names if k.startswith(prefix)) >= p.world:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.1)
+
+    def _abandon(self, p: _Pending, tmp: Optional[str],
+                 phase: str) -> None:
+        stats.COMMITS_ABANDONED.inc()
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        log.warning("checkpoint commit at step %d abandoned at the %s "
+                    "phase (timeout %.1fs, generation %d); previous "
+                    "manifest stays authoritative", p.step, phase,
+                    self._barrier_timeout, p.generation)
+        flight_recorder.emit("ckpt_commit_abandoned", step=p.step,
+                             generation=p.generation, rank=p.rank,
+                             phase=phase)
+
+    def _publish_manifest(self, kv, p: _Pending, own_record) -> bool:
+        """Leader side of the publish phase: collect every rank's
+        published shard record, then atomically write the manifest —
+        THE commit point."""
+        shards: List[dict] = []
+        if kv is not None:
+            if not self._await_count(kv, "published.", p):
+                return False
+            for r in range(p.world):
+                try:
+                    shards.append(json.loads(
+                        kv.get(f"published.{r}", wait=False)))
+                except Exception as exc:
+                    log.warning("ckpt publish: lost rank %d's record "
+                                "(%s); abandoning manifest", r, exc)
+                    return False
+        elif p.world > 1:
+            # no KV: shared-filesystem fallback — wait for all final
+            # shard files to appear, then digest them directly
+            if not self._await_files(p):
+                return False
+            for r in range(p.world):
+                path = os.path.join(
+                    self.directory, mf.shard_name(p.step, r, p.world))
+                try:
+                    with open(path, "rb") as f:
+                        blob = f.read()
+                except OSError as exc:
+                    log.warning("ckpt publish: shard file %s unreadable "
+                                "(%s); abandoning manifest", path, exc)
+                    return False
+                shards.append({"rank": r,
+                               "file": os.path.basename(path),
+                               "bytes": len(blob),
+                               "crc": ckpt_io.checksum(blob)})
+        else:
+            shards.append(json.loads(own_record))
+        manifest = mf.build_manifest(p.step, p.generation, p.world,
+                                     shards, p.layout)
+        mf.write_manifest(self.directory, manifest)
+        if kv is not None:
+            try:
+                kv.clear_scope()
+            except Exception:
+                pass  # best-effort: the TTL reaper collects leftovers
+        return True
+
+    def _await_files(self, p: _Pending) -> bool:
+        deadline = time.monotonic() + self._barrier_timeout
+        want = [os.path.join(self.directory,
+                             mf.shard_name(p.step, r, p.world))
+                for r in range(p.world)]
+        while True:
+            if all(os.path.exists(w) for w in want):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.1)
+
+    def _gc(self, p: _Pending) -> None:
+        """Keep the last ``keep`` checkpoints. Every rank prunes its OWN
+        old shard files (they may live on rank-local disks); the leader
+        additionally prunes superseded manifests."""
+        if self._keep <= 0:
+            return
+        drop_own = self._published_steps[:-self._keep]
+        self._published_steps = self._published_steps[-self._keep:]
+        for step in drop_own:
+            path = os.path.join(self.directory,
+                                mf.shard_name(step, p.rank, p.world))
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        if p.rank != 0:
+            return
+        steps = mf.all_steps(self.directory)
+        for step in steps[:-self._keep]:
+            try:
+                manifest = mf.load_manifest(self.directory, step)
+                files = [rec["file"] for rec in manifest["shards"]]
+            except Exception:
+                files = []
+            try:
+                os.unlink(mf.manifest_path(self.directory, step))
+            except OSError:
+                pass
+            for name in files:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:
+                    pass

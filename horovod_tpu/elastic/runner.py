@@ -372,6 +372,13 @@ def run(func):
                     state.on_reset()
                 # either way the new rank 0's copy becomes authoritative
                 state.sync(root_rank=0)
+                # sync consumed any neighbor replicas (zero.resync); the
+                # old-rank tags are meaningless in the new membership
+                try:
+                    from horovod_tpu.ckpt import replica as _ckpt_replica
+                    _ckpt_replica.clear("reform")
+                except Exception:
+                    pass
                 if rollback:
                     _RESTARTS_TOTAL.inc()
                 rollback = False

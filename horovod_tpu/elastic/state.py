@@ -32,6 +32,7 @@ from horovod_tpu.utils.env import _get_bool
 
 HOROVOD_ELASTIC_SPILL_DIR = "HOROVOD_ELASTIC_SPILL_DIR"
 HOROVOD_ELASTIC_SPILL_SYNC = "HOROVOD_ELASTIC_SPILL_SYNC"
+HOROVOD_CKPT_DIR = "HOROVOD_CKPT_DIR"
 
 _COMMITS = _metrics().counter(
     "horovod_elastic_commits_total",
@@ -88,7 +89,8 @@ class State:
     the spill synchronous (tests / strict durability).
     """
 
-    def __init__(self, spill_dir: Optional[str] = None):
+    def __init__(self, spill_dir: Optional[str] = None,
+                 ckpt_dir: Optional[str] = None):
         self._spill_dir = spill_dir or os.environ.get(
             HOROVOD_ELASTIC_SPILL_DIR, "")
         self._spill_sync = _get_bool(HOROVOD_ELASTIC_SPILL_SYNC)
@@ -96,6 +98,8 @@ class State:
         self._spill_next: Optional[tuple] = None  # guarded-by: _spill_lock
         self._spill_thread: Optional[threading.Thread] = None  # guarded-by: _spill_lock
         self._reset_callbacks: list = []
+        self._ckpt_dir = ckpt_dir or os.environ.get(HOROVOD_CKPT_DIR, "")
+        self._ckpt = None  # CheckpointManager, created on first commit
 
     # -- subclass surface --------------------------------------------------
     def save(self) -> None:
@@ -114,6 +118,14 @@ class State:
         """(pytree, step) to persist on spill, or None to skip."""
         return None
 
+    def _exchange_replicas(self, step: int) -> None:
+        """Ship this rank's ZeRO shard bytes to its left neighbor
+        (``ckpt.replica``). Runs BEFORE ``save()``: either the exchange
+        and the snapshot both advance to ``step``, or neither does — a
+        mid-commit death can never leave survivors whose replica and
+        snapshot disagree about the rollback step. Base states hold no
+        sharded leaves; ArrayState overrides."""
+
     # -- public API (reference names: commit / restore / on_reset) --------
     def commit(self) -> None:
         step = int(getattr(self, "step", 0))
@@ -121,12 +133,15 @@ class State:
 
         fault_inject.maybe_inject(step, generation=_runner.restarts())
         t0 = time.monotonic()
+        self._exchange_replicas(step)
         self.save()
         _COMMITS.inc()
         _COMMIT_DURATION.observe(time.monotonic() - t0)
         flight_recorder.emit("state_commit", step=step,
                              seconds=round(time.monotonic() - t0, 6))
-        if self._spill_dir:
+        if self._ckpt_dir:
+            self._ckpt_commit(step, _runner.restarts())
+        elif self._spill_dir:
             payload = self._spill_payload()
             if payload is not None:
                 self._spill(payload[0], payload[1])
@@ -134,6 +149,29 @@ class State:
         # driver host-change notice here (raises HostsUpdatedInterrupt,
         # caught by @elastic.run AFTER this snapshot completed)
         _runner.check_host_updates()
+
+    def _ckpt_commit(self, step: int, generation: int) -> None:
+        """Hand the snapshot to the sharded two-phase checkpoint writer
+        (:class:`horovod_tpu.ckpt.CheckpointManager`)."""
+        payload = self._spill_payload()
+        if payload is None:
+            return
+        if self._ckpt is None:
+            from horovod_tpu import ckpt
+            from horovod_tpu.elastic import runner as _runner
+
+            self._ckpt = ckpt.CheckpointManager(
+                self._ckpt_dir, generation_fn=_runner.restarts)
+        # copy=False: _saved is replaced (never mutated) by each save(),
+        # so the writer can serialize it in place — no redundant slab copy
+        self._ckpt.commit(payload[0], payload[1], generation=generation,
+                          copy=False)
+
+    def checkpoint_wait(self) -> None:
+        """Block until every handed-off checkpoint commit finished (or
+        was abandoned) — end-of-training / test drains."""
+        if self._ckpt is not None:
+            self._ckpt.wait()
 
     def restore(self) -> None:
         self.restore_snapshot()
@@ -188,7 +226,8 @@ class ObjectState(State):
     them by value; sync ships rank 0's copies over the wire."""
 
     _INTERNAL = ("_spill_dir", "_spill_sync", "_spill_lock", "_spill_next",
-                 "_spill_thread", "_reset_callbacks", "_saved")
+                 "_spill_thread", "_reset_callbacks", "_saved",
+                 "_ckpt_dir", "_ckpt")
 
     def __init__(self, spill_dir: Optional[str] = None, **kwargs):
         super().__init__(spill_dir=spill_dir)
@@ -226,8 +265,9 @@ class ArrayState(State):
     starting point."""
 
     def __init__(self, params=None, optimizer=None, step: int = 0,
-                 spill_dir: Optional[str] = None, **trees):
-        super().__init__(spill_dir=spill_dir)
+                 spill_dir: Optional[str] = None,
+                 ckpt_dir: Optional[str] = None, **trees):
+        super().__init__(spill_dir=spill_dir, ckpt_dir=ckpt_dir)
         self.params = params
         self.optimizer = optimizer
         self.step = int(step)
@@ -236,6 +276,41 @@ class ArrayState(State):
             setattr(self, name, tree)
         self._saved: Dict[str, Any] = {}  # guarded-by: <owner-thread>
         self.save()
+
+    def _leaf_key_base(self, name: str) -> int:
+        """First global leaf index of tree ``name`` under the checkpoint
+        subsystem's key scheme (``ckpt.writer.build_rank_payload``
+        flattens the trees in sorted-name order)."""
+        import jax
+
+        from horovod_tpu.parallel import zero
+
+        index = 0
+        for n in sorted(self._tree_names):
+            if n == name:
+                return index
+            tree = getattr(self, n, None)
+            if tree is None:
+                continue
+            flat, _ = jax.tree_util.tree_flatten(
+                tree, is_leaf=zero.is_sharded_state)
+            index += len(flat)
+        raise KeyError(name)
+
+    def _exchange_replicas(self, step: int) -> None:
+        from horovod_tpu.ckpt import replica
+        from horovod_tpu.ckpt import writer as ckpt_writer
+
+        if not replica.enabled():
+            return
+        st = basics._ensure_init()
+        _items, _layout, exchange = ckpt_writer.build_rank_payload(
+            {name: getattr(self, name) for name in self._tree_names},
+            st.rank, st.size)
+        # the exchange is a COLLECTIVE: every rank joins even with an
+        # empty entry dict (small worlds can leave a rank owning no
+        # replicated slice), or the owning ranks would deadlock
+        replica.exchange(exchange, step)
 
     def save(self) -> None:
         self._saved = {name: _host_copy(getattr(self, name))
@@ -258,6 +333,7 @@ class ArrayState(State):
         first, so the fp32-master refill sees synced values)."""
         import jax
 
+        from horovod_tpu.ckpt import replica
         from horovod_tpu.ops import collectives
         from horovod_tpu.parallel import dp, zero
 
@@ -269,10 +345,14 @@ class ArrayState(State):
             flat, treedef = jax.tree_util.tree_flatten(
                 tree, is_leaf=zero.is_sharded_state)
             if any(zero.is_sharded_state(x) for x in flat):
-                flat = [zero.resync(x, self.params, root_rank)
+                base = self._leaf_key_base(name)
+                flat = [zero.resync(x, self.params, root_rank,
+                                    replica=replica.lookup(
+                                        f"{name}/{base + i}",
+                                        step=int(self.step)))
                         if zero.is_sharded_state(x)
                         else dp.broadcast_parameters(x, root_rank=root_rank)
-                        for x in flat]
+                        for i, x in enumerate(flat)]
                 setattr(self, name,
                         jax.tree_util.tree_unflatten(treedef, flat))
             else:
@@ -286,3 +366,27 @@ class ArrayState(State):
     def _spill_payload(self):
         return ({name: self._saved[name] for name in self._tree_names},
                 int(self._saved.get("step", 0)))
+
+    def load_latest(self, directory: Optional[str] = None) -> Optional[int]:
+        """Restore the newest consistent checkpoint cut from
+        ``directory`` (default: this state's ``HOROVOD_CKPT_DIR``) into
+        this state — sharded leaves re-scatter into the CURRENT world
+        size via the manifest's recorded layout. Returns the restored
+        step, or None when the directory holds no checkpoint."""
+        from horovod_tpu import ckpt
+
+        directory = directory or self._ckpt_dir
+        if not directory:
+            return None
+        trees, step = ckpt.restore_latest(
+            directory,
+            {name: getattr(self, name) for name in self._tree_names})
+        if step is None:
+            return None
+        for name, tree in trees.items():
+            setattr(self, name, tree)
+        self.step = int(step)
+        self.save()
+        flight_recorder.emit("ckpt_state_loaded", step=int(step),
+                             directory=directory)
+        return int(step)

@@ -44,6 +44,24 @@ class WorkerStallError(WorkersDownError):
     elastic layer can evict them and continue."""
 
 
+class CheckpointCorruptError(HorovodInternalError):
+    """A checkpoint failed its integrity check on restore: a truncated
+    shard, a CRC mismatch on a leaf, or an unparseable container. Carries
+    the offending file and (when the damage is attributable) the leaf
+    path, so the operator knows whether to distrust one tensor or the
+    whole file. Raised instead of whatever decoding error the serializer
+    would have thrown — restore callers get one typed failure mode for
+    every flavor of torn write or bit rot."""
+
+    def __init__(self, message: str, path: Optional[str] = None,
+                 leaf: Optional[str] = None) -> None:
+        super().__init__(message)
+        #: filesystem path of the damaged checkpoint file
+        self.path = path
+        #: pytree leaf key whose bytes failed verification, when known
+        self.leaf = leaf
+
+
 class HostsUpdatedInterrupt(Exception):
     """The elastic driver announced a host-set change (reference:
     horovod/common/exceptions.py HostsUpdatedInterrupt). Not an error:

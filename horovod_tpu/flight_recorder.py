@@ -455,11 +455,32 @@ def suspect_culprit(dumps: List[dict]) -> Optional[Tuple[Any, str]]:
     return None
 
 
-def format_postmortem(dumps: List[dict], last_n: int = 40) -> str:
+def load_restart_lineage(directory: str) -> Optional[dict]:
+    """The supervised-restart lineage ``tpurun --supervise`` records
+    next to the flight dumps (``restart-lineage.json``), or None."""
+    path = os.path.join(directory, "restart-lineage.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def format_postmortem(dumps: List[dict], last_n: int = 40,
+                      lineage: Optional[dict] = None) -> str:
     """Human-readable merged postmortem: per-rank dump inventory, the
-    last ``last_n`` interleaved events, and the suspected culprit."""
+    last ``last_n`` interleaved events, and the suspected culprit.
+    ``lineage`` (from :func:`load_restart_lineage`) prefixes the
+    supervised-restart history so a dump can be placed in its attempt."""
     lines = ["=== flight-recorder postmortem (%d dump%s) ==="
              % (len(dumps), "" if len(dumps) == 1 else "s")]
+    for att in (lineage or {}).get("attempts", ()):
+        dur = float(att.get("ended", 0)) - float(att.get("started", 0))
+        lines.append(
+            "restart attempt %s/%s: exit=%s duration=%.1fs" % (
+                att.get("attempt", "?"),
+                att.get("restart_budget", "?"),
+                att.get("exit_code", "?"), max(dur, 0.0)))
     for d in sorted(dumps, key=lambda d: d.get("launch_rank", 0)):
         offset = d.get("clock_offset_seconds")
         lines.append(

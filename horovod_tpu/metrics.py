@@ -51,6 +51,12 @@ RATIO_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
 # 1ms .. 60s.
 COMMIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                   10.0, 30.0, 60.0)
+# Durable-checkpoint commit buckets (seconds): serialization + disk +
+# the cross-rank barrier, so the tail stretches past COMMIT_BUCKETS —
+# a slow shared filesystem or a barrier riding a KV outage can
+# legitimately take minutes without being an anomaly.
+CKPT_COMMIT_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                       10.0, 30.0, 60.0, 120.0, 300.0)
 
 
 class Counter:

@@ -913,14 +913,124 @@ def is_sharded_state(x) -> bool:
     return isinstance(x, (ShardedOptState, FlatAdamState))
 
 
+def layout_of(state) -> dict:
+    """JSON-serializable shard layout of a sharded state — recorded in
+    checkpoint manifests so restore can re-flatten/re-scatter into a
+    different world size (``from_full_buffers``)."""
+    spec = state.spec
+    return {
+        "kind": ("flat_adamw" if isinstance(state, FlatAdamState)
+                 else "generic"),
+        "world": int(spec.world),
+        "groups": [[g.dtype, int(g.n), int(g.shard_elems), int(g.padded)]
+                   for g in spec.groups],
+    }
+
+
+def export_shard_arrays(state) -> dict:
+    """Host-resident copies of a sharded state's local arrays, in a
+    stable named layout — the unit the checkpoint writer serializes and
+    the neighbor-replica exchange ships. Parallel to
+    :func:`from_full_buffers` / the resync replica path."""
+    if isinstance(state, FlatAdamState):
+        return {"kind": "flat_adamw",
+                "count": np.asarray(state.count),
+                "master": [np.asarray(m) for m in state.master],
+                "mu": [np.asarray(m) for m in state.mu],
+                "nu": [np.asarray(m) for m in state.nu]}
+    leaves, _ = jax.tree_util.tree_flatten(state.inner)
+    return {"kind": "generic",
+            "leaves": [np.asarray(x) for x in leaves]}
+
+
+def _slice_new_shard(full_old: np.ndarray, old_n: int, g_new: GroupSpec,
+                     new_rank: int, dtype) -> jnp.ndarray:
+    return _reshard(full_old, GroupSpec(
+        dtype=g_new.dtype, indices=(), shapes=(), sizes=(), n=old_n,
+        shard_elems=0, padded=full_old.shape[0]), g_new, new_rank, dtype)
+
+
+def from_full_buffers(target, full: dict, old_groups):
+    """Rebuild a sharded state from FULL old flat buffers (one per
+    dtype group), slicing this rank's shard under ``target``'s (new)
+    layout — the disk-restore analogue of :func:`resync`, with the
+    gathers replaced by buffers read from shard files.
+
+    ``target`` supplies the new spec (typically a freshly-initialized
+    state); ``full`` is the named-array dict shape of
+    :func:`export_shard_arrays` but with *full* (old_padded,) buffers;
+    ``old_groups`` is the manifest's ``groups`` layout list."""
+    spec = target.spec
+    if len(old_groups) != len(spec.groups):
+        raise ValueError(
+            "checkpoint restore: parameter structure changed (dtype "
+            "group count mismatch between manifest and target)")
+    if isinstance(target, FlatAdamState):
+        master, mu, nu = [], [], []
+        for gi, g_new in enumerate(spec.groups):
+            _dt, old_n, _s, _p = old_groups[gi]
+            master.append(_slice_new_shard(
+                np.asarray(full["master"][gi]), old_n, g_new, spec.rank,
+                np.float32))
+            mu.append(_slice_new_shard(
+                np.asarray(full["mu"][gi]), old_n, g_new, spec.rank,
+                np.float32))
+            nu.append(_slice_new_shard(
+                np.asarray(full["nu"][gi]), old_n, g_new, spec.rank,
+                np.float32))
+        count = jnp.asarray(np.asarray(full["count"]).astype(np.int32))
+        new_state = FlatAdamState(spec=spec, count=count,
+                                  master=tuple(master), mu=tuple(mu),
+                                  nu=tuple(nu))
+        _set_state_bytes((new_state.master, new_state.mu, new_state.nu),
+                         spec.world)
+        return new_state
+    leaves, treedef = jax.tree_util.tree_flatten(target.inner)
+    by_shard: dict = {}
+    for gi, g in enumerate(spec.groups):
+        by_shard.setdefault(int(g.shard_elems), []).append(gi)
+    new_leaves = []
+    for li, leaf in enumerate(leaves):
+        stored = full["leaves"][li]
+        if not hasattr(leaf, "shape") or np.ndim(leaf) == 0:
+            val = np.asarray(stored).reshape(-1)[0]
+            new_leaves.append(jnp.asarray(val).astype(
+                leaf.dtype if hasattr(leaf, "dtype") else np.float64))
+            continue
+        cand = by_shard.get(int(np.shape(leaf)[0]), [])
+        if np.ndim(leaf) != 1 or len(cand) != 1:
+            raise ValueError(
+                "checkpoint restore of a generic sharded inner state "
+                "needs unambiguous 1-D shard leaves (one dtype group "
+                f"per shard length); got leaf shape {np.shape(leaf)}")
+        gi = cand[0]
+        _dt, old_n, _s, _p = old_groups[gi]
+        new_leaves.append(_slice_new_shard(
+            np.asarray(stored), old_n, spec.groups[gi], spec.rank,
+            leaf.dtype))
+    new_inner = treedef.unflatten(new_leaves)
+    new_state = ShardedOptState(spec=spec, inner=new_inner)
+    _set_state_bytes(new_inner, spec.world)
+    return new_state
+
+
 def _gather_old_segments(local: np.ndarray, old_rank: int,
                          old_world: int, old_shard: int,
-                         fill: np.ndarray) -> np.ndarray:
+                         fill: np.ndarray, replica_rank: int = -1,
+                         replica_local=None):
     """Rebuild the full old flat buffer from surviving shards: allgather
     (length, old_rank, shard) from every current rank, place each
     surviving old rank's segment, and leave ``fill`` in segments whose
     owner died. First claim wins — survivors occupy the lowest new
-    ranks, so a fresh joiner can never shadow a survivor's segment."""
+    ranks, so a fresh joiner can never shadow a survivor's segment.
+
+    A second gather round collects neighbor REPLICAS
+    (:mod:`horovod_tpu.ckpt.replica`): a survivor holding the dead
+    rank's shard bytes contributes them, so the dead segment gets its
+    true last-commit values instead of ``fill``. Every rank joins both
+    rounds (collective uniformity) — ranks with nothing to offer send a
+    one-element dummy tagged rank -1. Returns ``(full,
+    replica_restored_ranks)``."""
     lens = np.asarray(collectives.allgather(
         np.array([local.shape[0]], np.int64))).reshape(-1)
     ranks = np.asarray(collectives.allgather(
@@ -937,7 +1047,28 @@ def _gather_old_segments(local: np.ndarray, old_rank: int,
         if 0 <= r < old_world and ln == old_shard and r not in claimed:
             full[r * old_shard:(r + 1) * old_shard] = seg
             claimed.add(r)
-    return full
+    rep = (np.zeros((1,), local.dtype) if replica_local is None
+           else np.ascontiguousarray(
+               np.asarray(replica_local).reshape(-1).astype(
+                   local.dtype, copy=False)))
+    rlens = np.asarray(collectives.allgather(
+        np.array([rep.shape[0]], np.int64))).reshape(-1)
+    rranks = np.asarray(collectives.allgather(
+        np.array([replica_rank if replica_local is not None else -1],
+                 np.int64))).reshape(-1)
+    rcat = np.asarray(collectives.allgather(rep))
+    replica_restored = set()
+    off = 0
+    for j in range(len(rranks)):
+        ln = int(rlens[j])
+        r = int(rranks[j])
+        seg = rcat[off:off + ln]
+        off += ln
+        if 0 <= r < old_world and ln == old_shard and r not in claimed:
+            full[r * old_shard:(r + 1) * old_shard] = seg
+            claimed.add(r)
+            replica_restored.add(r)
+    return full, replica_restored
 
 
 def _reshard(full_old: np.ndarray, g_old: GroupSpec, g_new: GroupSpec,
@@ -962,12 +1093,20 @@ def _resync_needed(spec: ZeroSpec, st) -> bool:
     return int(total.reshape(-1)[0]) > 0
 
 
-def resync(state, params, root_rank: int = 0):
+def resync(state, params, root_rank: int = 0, replica=None):
     """Re-shard a sharded optimizer state after an elastic membership
     reform: allgather the surviving old shards, rebuild the full flat
     buffers (dead ranks' segments fall back to the neutral value —
     zeros for moments, the current params for fp32 masters; exact for
     stateless inners like SGD), and slice the new world's shard.
+
+    ``replica`` — ``(src_old_rank, exported_arrays)`` from
+    ``horovod_tpu.ckpt.replica.lookup`` when this rank holds a neighbor
+    replica of a (possibly dead) old rank's shard. A second gather
+    round offers those bytes to every rank, so a dead rank's moment
+    segments restore to their true last-commit values instead of the
+    neutral fill. Ranks without a replica pass None and still join the
+    round (collective uniformity).
 
     ``params`` must already be synced (ArrayState.sync broadcasts
     params before the optimizer tree). No-op when the layout still
@@ -999,17 +1138,52 @@ def resync(state, params, root_rank: int = 0):
             "reform (dtype group count mismatch)")
     flight_recorder.emit("sharded_resync", old_world=int(old_world),
                          new_world=int(st.size), rank=int(st.rank))
+    rep_rank = -1
+    rep_entries = None
+    want_kind = ("flat_adamw" if isinstance(state, FlatAdamState)
+                 else "generic")
+    if replica is not None:
+        rep_rank, rep_entries = replica
+        if (not isinstance(rep_entries, dict)
+                or rep_entries.get("kind") != want_kind):
+            rep_rank, rep_entries = -1, None
+    replica_restored: set = set()  # (component, old_rank) placements
 
-    def regroup(leaf, gi, fill_np):
+    def regroup(leaf, gi, fill_np, rep_arr=None, tag=""):
         _dt, old_n, old_shard, old_padded = old_groups[gi]
         g_new = new_spec.groups[gi]
         g_old = GroupSpec(dtype=_dt, indices=(), shapes=(), sizes=(),
                           n=old_n, shard_elems=old_shard,
                           padded=old_padded)
         local = np.asarray(leaf).reshape(-1)
-        full = _gather_old_segments(local, spec.rank, old_world,
-                                    old_shard, fill_np)
+        full, from_replica = _gather_old_segments(
+            local, spec.rank, old_world, old_shard, fill_np,
+            replica_rank=(rep_rank if rep_arr is not None else -1),
+            replica_local=rep_arr)
+        replica_restored.update((tag, r) for r in from_replica)
         return _reshard(full, g_old, g_new, st.rank, leaf.dtype)
+
+    def _rep(component, idx):
+        if rep_entries is None:
+            return None
+        try:
+            arr = rep_entries[component][idx]
+        except (KeyError, IndexError, TypeError):
+            return None
+        return None if arr is None else np.asarray(arr)
+
+    def _finish_replica_accounting():
+        if replica_restored:
+            try:
+                from horovod_tpu.ckpt import stats as ckpt_stats
+                ckpt_stats.REPLICA_RESTORES.inc(len(replica_restored))
+            except Exception:  # pragma: no cover - metrics must not kill
+                pass
+            flight_recorder.emit(
+                "sharded_resync_replica",
+                restored_old_ranks=sorted(
+                    {r for _t, r in replica_restored}),
+                segments=len(replica_restored), rank=int(st.rank))
 
     if isinstance(state, FlatAdamState):
         new_master, new_mu, new_nu = [], [], []
@@ -1023,9 +1197,13 @@ def resync(state, params, root_rank: int = 0):
                 shard_elems=old_shard, padded=old_padded)
             ).astype(np.float32)
             zfill = np.zeros((old_padded,), np.float32)
-            new_master.append(regroup(state.master[gi], gi, pfill))
-            new_mu.append(regroup(state.mu[gi], gi, zfill))
-            new_nu.append(regroup(state.nu[gi], gi, zfill))
+            new_master.append(regroup(state.master[gi], gi, pfill,
+                                      _rep("master", gi),
+                                      tag=f"master/{gi}"))
+            new_mu.append(regroup(state.mu[gi], gi, zfill,
+                                  _rep("mu", gi), tag=f"mu/{gi}"))
+            new_nu.append(regroup(state.nu[gi], gi, zfill,
+                                  _rep("nu", gi), tag=f"nu/{gi}"))
         count = jnp.asarray(np.asarray(collectives.broadcast(
             np.array([int(state.count)], np.int64),
             root_rank)).reshape(-1)[0].astype(np.int32))
@@ -1034,6 +1212,7 @@ def resync(state, params, root_rank: int = 0):
             mu=tuple(new_mu), nu=tuple(new_nu))
         _set_state_bytes((new_state.master, new_state.mu, new_state.nu),
                          new_spec.world)
+        _finish_replica_accounting()
         return new_state
 
     # generic ShardedOptState: re-shard every array leaf of the inner
@@ -1044,7 +1223,7 @@ def resync(state, params, root_rank: int = 0):
     for gi, (_dt, _n, old_shard, _p) in enumerate(old_groups):
         by_shard.setdefault(old_shard, []).append(gi)
     new_leaves = []
-    for leaf in leaves:
+    for li, leaf in enumerate(leaves):
         if not hasattr(leaf, "shape") or np.ndim(leaf) == 0:
             val = np.asarray(collectives.broadcast(
                 np.asarray(leaf).reshape(1).astype(np.float64),
@@ -1062,8 +1241,10 @@ def resync(state, params, root_rank: int = 0):
         gi = cand[0]
         _dt, _n, _s, old_padded = old_groups[gi]
         zfill = np.zeros((old_padded,), np.dtype(leaf.dtype))
-        new_leaves.append(regroup(leaf, gi, zfill))
+        new_leaves.append(regroup(leaf, gi, zfill, _rep("leaves", li),
+                                  tag=f"leaf/{li}"))
     new_inner = treedef.unflatten(new_leaves)
     new_state = ShardedOptState(spec=new_spec, inner=new_inner)
     _set_state_bytes(new_inner, new_spec.world)
+    _finish_replica_accounting()
     return new_state

@@ -356,6 +356,66 @@ def launch_job(command: str, slots: List[SlotInfo],
     return code
 
 
+RESTART_LINEAGE_FILE = "restart-lineage.json"
+
+
+def launch_supervised(command: str, slots: List[SlotInfo],
+                      restart_budget: int = 3,
+                      env: Optional[Dict[str, str]] = None,
+                      **kwargs) -> int:
+    """``launch_job`` under supervision: a failed job (any non-zero exit
+    the elastic layer could not absorb) is relaunched up to
+    ``restart_budget`` times — the crash-consistent checkpoint
+    (``HOROVOD_CKPT_DIR``) is what makes the relaunch resume instead of
+    retrain.
+
+    Every attempt runs with ``HOROVOD_RESTART_ATTEMPT=<n>`` in the
+    worker env, and the restart lineage — per attempt: exit code, wall
+    times, budget — is appended to ``restart-lineage.json`` in the
+    flight-recorder dir, where ``tpurun --postmortem`` folds it into the
+    merged report (which restart a dump belongs to is otherwise
+    guesswork)."""
+    import json
+    import time
+
+    base_env = dict(os.environ if env is None else env)
+    flight_dir = kwargs.get("flight_recorder_dir")
+    lineage: List[dict] = []
+    attempt = 0
+    while True:
+        base_env["HOROVOD_RESTART_ATTEMPT"] = str(attempt)
+        t0 = time.time()
+        code = launch_job(command, slots, env=dict(base_env), **kwargs)
+        lineage.append({"attempt": attempt, "exit_code": code,
+                        "started": t0, "ended": time.time(),
+                        "restart_budget": restart_budget})
+        if flight_dir:
+            try:
+                os.makedirs(flight_dir, exist_ok=True)
+                from horovod_tpu.ckpt import io as ckpt_io
+
+                ckpt_io.atomic_write(
+                    os.path.join(flight_dir, RESTART_LINEAGE_FILE),
+                    json.dumps({"attempts": lineage}, indent=1).encode(),
+                    base="lineage")
+            except Exception as exc:
+                print(f"tpurun: could not record restart lineage: {exc}",
+                      file=sys.stderr)
+        if code == 0:
+            if attempt:
+                print(f"tpurun: job succeeded on supervised restart "
+                      f"{attempt}/{restart_budget}", file=sys.stderr)
+            return 0
+        if attempt >= restart_budget:
+            print(f"tpurun: restart budget exhausted "
+                  f"({restart_budget} restarts); giving up with exit "
+                  f"code {code}", file=sys.stderr)
+            return code
+        attempt += 1
+        print(f"tpurun: job failed (exit {code}); supervised restart "
+              f"{attempt}/{restart_budget}", file=sys.stderr)
+
+
 def _finalize_flight_dumps(directory: str, shipped: Dict[str, bytes],
                            exit_code: int) -> None:
     """Persist rendezvous-shipped dumps (only for ranks that left no local

@@ -185,7 +185,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         # a rank declaring itself finished with the scope
-        # (reference: http_server.py scope_size bookkeeping)
+        # (reference: http_server.py scope_size bookkeeping); the
+        # special key "*" drops the whole scope (checkpoint commit
+        # scopes are per-step — without this they accumulate forever)
         sk = self._split()
         if sk is None:
             return
@@ -193,9 +195,14 @@ class _Handler(BaseHTTPRequestHandler):
         if self._chaos_outage(scope):
             return
         with self.server.lock:
-            self.server.store.get(scope, {}).pop(key, None)
-            self.server.put_times.get(scope, {}).pop(key, None)
-            self.server.finished.setdefault(scope, set()).add(key)
+            if key == "*":
+                self.server.store.pop(scope, None)
+                self.server.put_times.pop(scope, None)
+                self.server.finished.pop(scope, None)
+            else:
+                self.server.store.get(scope, {}).pop(key, None)
+                self.server.put_times.get(scope, {}).pop(key, None)
+                self.server.finished.setdefault(scope, set()).add(key)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -411,3 +418,8 @@ class KVStoreClient:
         req = Request(self._url(key, scope), method="DELETE")
         self._retry.call(self._open, req, self._retry.attempt_timeout,
                          "finish", phase="kv.finish")
+
+    def clear_scope(self, scope: Optional[str] = None) -> None:
+        """Drop the whole scope server-side (DELETE of the ``*`` key) —
+        used by per-step checkpoint commit scopes once published."""
+        self.finish("*", scope)

@@ -207,6 +207,19 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
          help="Executable printing the current 'hostname[:slots]' set, one "
               "per line; polled by the elastic driver to add/remove "
               "hosts at runtime.")
+    _add(elastic_group, "--supervise", dest="supervise",
+         action="store_true",
+         help="Supervised restarts: when the whole job fails (beyond "
+              "what elastic re-forms absorb), relaunch it — resuming "
+              "from the crash-consistent checkpoint directory "
+              "(HOROVOD_CKPT_DIR) when the training script uses "
+              "hvd.elastic state. Each attempt gets "
+              "HOROVOD_RESTART_ATTEMPT=<n>; the restart lineage is "
+              "recorded in the flight-recorder dir for --postmortem.")
+    _add(elastic_group, "--restart-budget", dest="restart_budget",
+         type=int,
+         help="Maximum supervised relaunches before giving up "
+              "(default 3; only with --supervise).")
 
     stall = parser.add_argument_group("stall check")
     _add(stall, "--no-stall-check", dest="no_stall_check",
@@ -409,7 +422,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             sys.stderr.write(f"tpurun --postmortem: no flight-recorder "
                              f"dumps found in {args.postmortem!r}\n")
             return 1
-        print(flight_recorder.format_postmortem(dumps))
+        lineage = flight_recorder.load_restart_lineage(args.postmortem)
+        print(flight_recorder.format_postmortem(dumps, lineage=lineage))
         return 0
     if not command:
         sys.stderr.write("tpurun: no command given\n")
@@ -461,8 +475,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         return 2
 
     command_str = " ".join(_shlex.quote(c) for c in command)
-    return launcher.launch_job(
-        command_str, slots, env=env, ssh_port=args.ssh_port,
+    launch_kwargs = dict(
+        env=env, ssh_port=args.ssh_port,
         output_dir=args.output_dir,
         use_jax_distributed=not args.no_jax_distributed,
         start_timeout=args.start_timeout, backend=backend,
@@ -471,6 +485,14 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         discovery_script=args.host_discovery_script,
         flight_recorder_dir=args.flight_recorder_dir,
         profile_dir=args.profile_dir)
+    if args.supervise:
+        budget = (args.restart_budget if args.restart_budget is not None
+                  else 3)
+        launch_kwargs.pop("env")
+        return launcher.launch_supervised(
+            command_str, slots, restart_budget=budget, env=env,
+            **launch_kwargs)
+    return launcher.launch_job(command_str, slots, **launch_kwargs)
 
 
 def main() -> None:

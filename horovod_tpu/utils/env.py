@@ -90,6 +90,11 @@ ENV_DIRECT_KNOBS = (
     "HOROVOD_ELASTIC_REJOIN_TIMEOUT_SECONDS",
     "HOROVOD_ELASTIC_SETTLE_SECONDS",
     "HOROVOD_ELASTIC_SPILL_DIR", "HOROVOD_ELASTIC_SPILL_SYNC",
+    # crash-consistent sharded checkpointing (ckpt/; docs/checkpointing.md)
+    "HOROVOD_CKPT_DIR", "HOROVOD_CKPT_ASYNC", "HOROVOD_CKPT_KEEP",
+    "HOROVOD_CKPT_REPLICATION", "HOROVOD_CKPT_VERIFY",
+    "HOROVOD_CKPT_BARRIER_TIMEOUT_SECONDS", "HOROVOD_CKPT_FAULT",
+    "HOROVOD_RESTART_ATTEMPT",
     # control-plane resilience (utils/resilience.py; docs/robustness.md)
     "HOROVOD_COLLECTIVE_TIMEOUT", "HOROVOD_NET_MAX_RETRIES",
     "HOROVOD_NET_BACKOFF_BASE_SECONDS", "HOROVOD_NET_BACKOFF_MAX_SECONDS",

@@ -5,6 +5,7 @@ import os
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from horovod_tpu import checkpoint, training
 from horovod_tpu.models.mnist import MnistConvNet
@@ -75,6 +76,63 @@ class TestCheckpoint:
                                restored["opt_state"], images, labels)
         l2, p2, _, _ = step_fn(params, stats, opt_state, images, labels)
         np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+
+class TestCheckpointIntegrity:
+    """The ``.crc`` sidecar must turn silent disk damage into a typed,
+    leaf-naming :class:`CheckpointCorruptError` (PR-9 regression: a
+    truncated msgpack used to parse into garbage silently)."""
+
+    def _save(self, tmp_path):
+        d = str(tmp_path / "ckpts")
+        tree = {"params": {"w": jnp.arange(64, dtype=jnp.float32),
+                           "b": jnp.ones((8,), jnp.float32)}}
+        path = checkpoint.save(d, tree, step=1)
+        assert os.path.exists(path + ".crc")
+        return path, tree
+
+    def test_truncated_file_raises(self, hvd, tmp_path):
+        from horovod_tpu.exceptions import CheckpointCorruptError
+
+        path, tree = self._save(tmp_path)
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:-10])
+        with pytest.raises(CheckpointCorruptError) as ei:
+            checkpoint.restore(path, tree, broadcast=False)
+        assert "truncated or torn" in str(ei.value)
+
+    def test_bitflip_names_offending_leaf(self, hvd, tmp_path):
+        from horovod_tpu.exceptions import CheckpointCorruptError
+
+        path, tree = self._save(tmp_path)
+        blob = bytearray(open(path, "rb").read())
+        # flip one byte inside w's payload: msgpack still decodes, so
+        # the error narrows the damage down to the leaf
+        off = bytes(blob).index(
+            np.asarray(tree["params"]["w"]).tobytes()) + 5
+        blob[off] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        with pytest.raises(CheckpointCorruptError) as ei:
+            checkpoint.restore(path, tree, broadcast=False)
+        assert ei.value.leaf == "params/w"
+        assert "params/w" in str(ei.value)
+
+    def test_unverified_restore_still_decodes(self, hvd, tmp_path):
+        """verify=False opts out (the pre-PR-9 behavior) — damage that
+        happens to decode flows through, proving the sidecar check is
+        what raised above."""
+        path, tree = self._save(tmp_path)
+        blob = bytearray(open(path, "rb").read())
+        off = bytes(blob).index(
+            np.asarray(tree["params"]["w"]).tobytes()) + 5
+        blob[off] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        restored = checkpoint.restore(path, tree, broadcast=False,
+                                      verify=False)
+        assert restored["params"]["w"].shape == (64,)
 
 
 def _leaves(tree):

@@ -1,0 +1,394 @@
+"""Crash-consistent sharded checkpointing (horovod_tpu.ckpt): shard
+container integrity, two-phase commit + GC, tmp hygiene keyed on writer
+liveness, replica fallback, and world-size-change restore."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ckpt import io as ckpt_io
+from horovod_tpu.ckpt import manifest as mf
+from horovod_tpu.ckpt import restore as rst
+from horovod_tpu.ckpt import writer as wr
+from horovod_tpu.exceptions import CheckpointCorruptError
+
+
+def _write(path, blob):
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+# ---------------------------------------------------------------------------
+# Shard container
+# ---------------------------------------------------------------------------
+
+class TestShardContainer:
+    def _entries(self):
+        return [
+            mf.array_entry("params/0", np.arange(5, dtype=np.float32)),
+            mf.array_entry("params/1", np.int32(7),
+                           role=mf.ROLE_REPLICATED),
+            mf.object_entry("meta/2", {"epoch": 3}, role=mf.ROLE_REPLICA,
+                            replica_of=1),
+        ]
+
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "s.hvd")
+        _write(path, mf.pack_shard(self._entries(),
+                                   meta={"step": 4, "rank": 0}))
+        meta, entries = mf.read_shard(path)
+        assert meta["step"] == 4
+        assert [e["key"] for e in entries] == \
+            ["params/0", "params/1", "meta/2"]
+        np.testing.assert_array_equal(
+            entries[0]["value"], np.arange(5, dtype=np.float32))
+        assert entries[0]["value"].dtype == np.float32
+        assert entries[1]["value"] == np.int32(7)
+        assert entries[1]["role"] == mf.ROLE_REPLICATED
+        assert entries[2]["value"] == {"epoch": 3}
+        assert entries[2]["replica_of"] == 1
+
+    def test_bitflip_names_offending_leaf(self, tmp_path):
+        path = str(tmp_path / "s.hvd")
+        blob = bytearray(mf.pack_shard(self._entries(),
+                                       meta={"step": 1}))
+        # last byte sits in the final leaf's payload
+        blob[-1] ^= 0xFF
+        _write(path, bytes(blob))
+        with pytest.raises(CheckpointCorruptError) as ei:
+            mf.read_shard(path)
+        assert ei.value.leaf == "meta/2"
+        assert path in str(ei.value)
+
+    def test_truncation_detected(self, tmp_path):
+        path = str(tmp_path / "s.hvd")
+        blob = mf.pack_shard(self._entries(), meta={"step": 1})
+        _write(path, blob[:-3])
+        with pytest.raises(CheckpointCorruptError) as ei:
+            mf.read_shard(path)
+        assert "truncated" in str(ei.value)
+        assert ei.value.leaf == "meta/2"
+
+    def test_bad_magic(self, tmp_path):
+        path = str(tmp_path / "s.hvd")
+        _write(path, b"not a shard container at all")
+        with pytest.raises(CheckpointCorruptError) as ei:
+            mf.read_shard(path)
+        assert ei.value.leaf is None
+
+    def test_verify_manifest_files_catches_rewrite(self, tmp_path):
+        d = str(tmp_path)
+        blob = mf.pack_shard(self._entries(), meta={"step": 1})
+        name = mf.shard_name(1, 0, 1)
+        _write(os.path.join(d, name), blob)
+        manifest = mf.build_manifest(
+            1, 0, 1, [{"rank": 0, "file": name, "bytes": len(blob),
+                       "crc": ckpt_io.checksum(blob)}], {})
+        mf.write_manifest(d, manifest)
+        mf.verify_manifest_files(d, mf.load_manifest(d, 1))
+        _write(os.path.join(d, name), blob[:-1])
+        with pytest.raises(CheckpointCorruptError):
+            mf.verify_manifest_files(d, mf.load_manifest(d, 1))
+
+
+# ---------------------------------------------------------------------------
+# Tmp hygiene: staleness keyed on writer liveness, not mtime
+# ---------------------------------------------------------------------------
+
+class TestTmpHygiene:
+    def test_live_writers_old_tmp_survives(self, tmp_path):
+        # regression: the pre-PR-9 mtime-only rule let a peer with a
+        # skewed clock delete a LIVE writer's in-flight tmp
+        d = str(tmp_path)
+        fd, tmp = ckpt_io.make_tmp(d)
+        os.close(fd)
+        os.utime(tmp, (1.0, 1.0))  # looks hours stale by mtime
+        assert ckpt_io.clean_stale_tmps(d) == 0
+        assert os.path.exists(tmp)
+
+    def test_dead_writers_fresh_tmp_removed(self, tmp_path):
+        d = str(tmp_path)
+        proc = subprocess.Popen(["sleep", "0"])
+        proc.wait()
+        tmp = os.path.join(
+            d, f"ckpt.{ckpt_io.hostname()}.{proc.pid}.x1y2.tmp")
+        _write(tmp, b"torn")
+        assert ckpt_io.clean_stale_tmps(d) == 1
+        assert not os.path.exists(tmp)
+
+    def test_foreign_host_tmp_falls_back_to_mtime(self, tmp_path):
+        d = str(tmp_path)
+        tmp = os.path.join(d, f"ckpt.elsewhere.{os.getpid()}.ab.tmp")
+        _write(tmp, b"torn")
+        assert ckpt_io.clean_stale_tmps(d) == 0  # fresh: kept
+        os.utime(tmp, (1.0, 1.0))
+        assert ckpt_io.clean_stale_tmps(d) == 1  # stale: removed
+
+    def test_parse_tmp_writer(self):
+        host, pid = ckpt_io.parse_tmp_writer("base.myhost.123.r4nd.tmp")
+        assert (host, pid) == ("myhost", 123)
+        assert ckpt_io.parse_tmp_writer("legacy.tmp") == (None, None)
+        assert ckpt_io.parse_tmp_writer("a.b.notanint.c.tmp") == \
+            (None, None)
+        assert ckpt_io.parse_tmp_writer("published.hvd") == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# HOROVOD_CKPT_FAULT parser
+# ---------------------------------------------------------------------------
+
+class TestParseFault:
+    def test_full_spec(self):
+        spec = wr.parse_fault("kill:rank=2:phase=publish:step=7:code=19")
+        assert spec == wr.FaultSpec(rank=2, phase="publish", step=7,
+                                    code=19)
+
+    def test_defaults(self):
+        spec = wr.parse_fault("kill:rank=0:phase=stage")
+        assert spec.step is None and spec.code == 1
+
+    def test_empty_disarms(self):
+        assert wr.parse_fault("") is None
+        assert wr.parse_fault(None) is None
+
+    def test_rejects_bad_specs(self):
+        with pytest.raises(ValueError):
+            wr.parse_fault("pause:rank=0:phase=stage")
+        with pytest.raises(ValueError):
+            wr.parse_fault("kill:rank=0")
+        with pytest.raises(ValueError):
+            wr.parse_fault("kill:rank=0:phase=flush")
+
+
+# ---------------------------------------------------------------------------
+# Two-phase commit, single-writer world
+# ---------------------------------------------------------------------------
+
+def _trees(scale):
+    return {"params": {"w": np.full((6,), float(scale), np.float32),
+                       "b": np.float32(scale)},
+            "extra": None}
+
+
+def _target():
+    return {"params": {"w": np.zeros((6,), np.float32),
+                       "b": np.float32(0)},
+            "extra": None}
+
+
+class TestCommitRestore:
+    def test_default_world_is_process_topology(self, hvd, tmp_path):
+        # an initialized single-process 8-device mesh is ONE writer:
+        # commit() with defaulted rank/world must publish as world 1
+        # immediately, not await 7 shard files no other process will
+        # ever write (and abandon at the barrier timeout)
+        d = str(tmp_path)
+        mgr = wr.CheckpointManager(d, async_write=False, keep=4,
+                                   barrier_timeout=5.0)
+        mgr.commit(_trees(1), step=1, generation=0)
+        mgr.close()
+        assert mf.all_steps(d) == [1]
+        assert mf.load_manifest(d, 1)["world"] == 1
+
+    def test_commit_restore_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        mgr = wr.CheckpointManager(d, async_write=False, keep=4)
+        mgr.commit(_trees(1), step=1, generation=0, rank=0, world=1)
+        mgr.commit(_trees(2), step=2, generation=0, rank=0, world=1)
+        mgr.close()
+        assert mf.all_steps(d) == [1, 2]
+        trees, step = rst.restore_latest(d, _target())
+        assert step == 2
+        np.testing.assert_array_equal(
+            trees["params"]["w"], np.full((6,), 2.0, np.float32))
+        assert float(trees["params"]["b"]) == 2.0
+        assert trees["extra"] is None
+
+    def test_gc_keeps_last_k(self, tmp_path):
+        d = str(tmp_path)
+        mgr = wr.CheckpointManager(d, async_write=False, keep=2)
+        for s in (1, 2, 3, 4):
+            mgr.commit(_trees(s), step=s, generation=0, rank=0, world=1)
+        mgr.close()
+        assert mf.all_steps(d) == [3, 4]
+        assert not os.path.exists(
+            os.path.join(d, mf.shard_name(1, 0, 1)))
+
+    def test_async_commit_flushes_on_wait(self, tmp_path):
+        d = str(tmp_path)
+        mgr = wr.CheckpointManager(d, async_write=True, keep=2)
+        mgr.commit(_trees(5), step=5, generation=0, rank=0, world=1)
+        mgr.wait()
+        mgr.close()
+        trees, step = rst.restore_latest(d, _target())
+        assert step == 5
+        np.testing.assert_array_equal(
+            trees["params"]["w"], np.full((6,), 5.0, np.float32))
+
+    def test_torn_newest_falls_back_to_previous(self, tmp_path):
+        d = str(tmp_path)
+        mgr = wr.CheckpointManager(d, async_write=False, keep=4)
+        mgr.commit(_trees(1), step=1, generation=0, rank=0, world=1)
+        mgr.commit(_trees(2), step=2, generation=0, rank=0, world=1)
+        mgr.close()
+        shard2 = os.path.join(d, mf.shard_name(2, 0, 1))
+        blob = bytearray(open(shard2, "rb").read())
+        blob[-1] ^= 0xFF
+        _write(shard2, bytes(blob))
+        trees, step = rst.restore_latest(d, _target())
+        assert step == 1  # damaged cut skipped, previous restored
+        np.testing.assert_array_equal(
+            trees["params"]["w"], np.full((6,), 1.0, np.float32))
+        # every published cut damaged -> loud failure, not silent zeros
+        shard1 = os.path.join(d, mf.shard_name(1, 0, 1))
+        _write(shard1, b"")
+        with pytest.raises(CheckpointCorruptError):
+            rst.restore_latest(d, _target())
+
+    def test_staged_tmp_invisible_to_restore(self, tmp_path):
+        d = str(tmp_path)
+        mgr = wr.CheckpointManager(d, async_write=False, keep=4)
+        mgr.commit(_trees(1), step=1, generation=0, rank=0, world=1)
+        mgr.close()
+        fd, tmp = ckpt_io.make_tmp(d, base=mf.shard_name(2, 0, 1))
+        with os.fdopen(fd, "wb") as f:
+            f.write(b"half a shard, writer died here")
+        assert mf.all_steps(d) == [1]
+        trees, step = rst.restore_latest(d, _target())
+        assert step == 1
+
+    def test_restore_empty_dir(self, tmp_path):
+        trees, step = rst.restore_latest(str(tmp_path), _target())
+        assert trees is None and step is None
+
+    def test_structure_change_is_loud(self, tmp_path):
+        d = str(tmp_path)
+        mgr = wr.CheckpointManager(d, async_write=False, keep=4)
+        mgr.commit(_trees(1), step=1, generation=0, rank=0, world=1)
+        mgr.close()
+        target = {"params": {"w": np.zeros((6,), np.float32),
+                             "b": np.float32(0),
+                             "new_leaf": np.zeros((2,), np.float32)},
+                  "extra": None}
+        with pytest.raises(CheckpointCorruptError):
+            rst.restore_step(d, 1, target)
+
+
+# ---------------------------------------------------------------------------
+# Replica fallback: a damaged shard file restores from its left
+# neighbor's replica section
+# ---------------------------------------------------------------------------
+
+class TestReplicaFallback:
+    def _publish_world2(self, d):
+        """Hand-build a 2-rank checkpoint where rank 0's file also
+        carries rank 1's bytes as replica entries (what the neighbor
+        ring produces)."""
+        w0 = np.arange(4, dtype=np.float32)
+        w1 = np.arange(4, dtype=np.float32) * 10
+        shards = []
+        for rank, entries in (
+            (0, [mf.array_entry("params/0", w0,
+                                role=mf.ROLE_REPLICATED),
+                 mf.array_entry("params/1", w1, role=mf.ROLE_REPLICA,
+                                replica_of=1)]),
+            (1, [mf.array_entry("params/1", w1,
+                                role=mf.ROLE_REPLICATED)]),
+        ):
+            blob = mf.pack_shard(entries, meta={"step": 3, "rank": rank})
+            name = mf.shard_name(3, rank, 2)
+            _write(os.path.join(d, name), blob)
+            shards.append({"rank": rank, "file": name,
+                           "bytes": len(blob),
+                           "crc": ckpt_io.checksum(blob)})
+        mf.write_manifest(d, mf.build_manifest(3, 0, 2, shards, {}))
+        return w0, w1
+
+    def test_missing_shard_recovered_from_replica(self, tmp_path):
+        from horovod_tpu.ckpt import stats
+
+        d = str(tmp_path)
+        w0, w1 = self._publish_world2(d)
+        os.unlink(os.path.join(d, mf.shard_name(3, 1, 2)))
+        before = stats.REPLICA_RESTORES.value
+        target = {"params": {"a": np.zeros(4, np.float32),
+                             "b": np.zeros(4, np.float32)}}
+        trees, step = rst.restore_step(d, 3, target)
+        assert step == 3
+        np.testing.assert_array_equal(trees["params"]["a"], w0)
+        np.testing.assert_array_equal(trees["params"]["b"], w1)
+        assert stats.REPLICA_RESTORES.value == before + 1
+
+    def test_unrecoverable_without_replica(self, tmp_path):
+        d = str(tmp_path)
+        self._publish_world2(d)
+        # rank 0's file is the one carrying the replica: losing IT
+        # leaves params/0 with no copy anywhere
+        os.unlink(os.path.join(d, mf.shard_name(3, 0, 2)))
+        target = {"params": {"a": np.zeros(4, np.float32),
+                             "b": np.zeros(4, np.float32)}}
+        with pytest.raises(CheckpointCorruptError):
+            rst.restore_step(d, 3, target)
+
+
+# ---------------------------------------------------------------------------
+# World-size-change restore: re-flatten + re-scatter sharded state
+# ---------------------------------------------------------------------------
+
+class TestWorldChange:
+    N = 10  # real elements; pads differently under world 2 and 3
+
+    def _state(self, world, rank, shard_elems, fill=None):
+        from horovod_tpu.parallel import zero
+
+        g = zero.GroupSpec(dtype=np.dtype(np.float32).str, indices=(0,),
+                           shapes=((self.N,),), sizes=(self.N,),
+                           n=self.N, shard_elems=shard_elems,
+                           padded=shard_elems * world)
+        spec = zero.ZeroSpec(groups=(g,), world=world, rank=rank,
+                             num_leaves=1)
+        if fill is None:
+            seg = np.zeros((shard_elems,), np.float32)
+            return zero.FlatAdamState(
+                spec=spec, count=np.int32(0), master=(seg,),
+                mu=(seg.copy(),), nu=(seg.copy(),))
+        lo = rank * shard_elems
+        full = np.zeros((shard_elems * world,), np.float32)
+        full[:self.N] = fill
+        seg = full[lo:lo + shard_elems]
+        return zero.FlatAdamState(
+            spec=spec, count=np.int32(9), master=(seg.copy(),),
+            mu=(seg.copy() * 2,), nu=(seg.copy() * 3,))
+
+    def test_restore_world2_into_world3(self, tmp_path):
+        d = str(tmp_path)
+        fill = np.arange(self.N, dtype=np.float32) + 1
+        # world 2 commits (shard_elems 6): rank 1 first, then the
+        # leader finds both files via the shared-fs fallback
+        for rank in (1, 0):
+            mgr = wr.CheckpointManager(d, async_write=False, keep=2,
+                                       barrier_timeout=5.0)
+            mgr.commit({"opt": self._state(2, rank, 6, fill=fill)},
+                       step=1, generation=0, rank=rank, world=2)
+            mgr.close()
+        manifest = mf.load_manifest(d, 1)
+        assert manifest["world"] == 2
+        assert manifest["sharded"]["opt/0"]["groups"][0][1] == self.N
+        # restore every rank of a world-3 job (shard_elems 4)
+        seen = {"master": [], "mu": [], "nu": []}
+        for new_rank in range(3):
+            target = {"opt": self._state(3, new_rank, 4)}
+            trees, step = rst.restore_step(d, 1, target)
+            assert step == 1
+            got = trees["opt"]
+            assert int(got.count) == 9
+            assert got.spec.world == 3 and got.spec.rank == new_rank
+            for comp in seen:
+                arr = np.asarray(getattr(got, comp)[0])
+                assert arr.shape == (4,)
+                seen[comp].append(arr)
+        for comp, scale in (("master", 1), ("mu", 2), ("nu", 3)):
+            full_new = np.concatenate(seen[comp])[:self.N]
+            np.testing.assert_array_equal(full_new, fill * scale)

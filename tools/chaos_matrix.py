@@ -22,6 +22,22 @@ Scenarios (docs/robustness.md has the failure-model table):
   the deadline, finish, and the merged flight-recorder postmortem names
   the partitioned rank.
 
+Checkpoint crash-consistency scenarios (ISSUE 9; docs/checkpointing.md):
+
+* ``ckpt_kill_mid_commit`` — rank 1 killed at the PUBLISH phase of the
+  step-3 two-phase commit (after its shard rename, before its
+  ``published`` announcement; ``CHAOS_CKPT_PHASE=stage|barrier``
+  re-aims the kill at the other protocol points — the invariant is the
+  same at every phase): the leader abandons the step-3 manifest,
+  the survivors re-form and finish, and afterwards EVERY manifest in
+  the directory restores bit-identically (``w == step`` exactly) while
+  no step-3 manifest exists — a kill mid-commit can never corrupt or
+  publish a partial cut.
+* ``ckpt_reform_sharded_adamw`` — rank 1 killed at training step 3
+  under ZeRO-1 sharded AdamW: after the re-form the dead rank's
+  fp32 moment segments are restored from its left neighbor's replica
+  (nonzero, uniform across shards), not zero-filled.
+
 Usage: python tools/chaos_matrix.py [--only NAME] [--json PATH]
 """
 
@@ -41,9 +57,6 @@ sys.path.insert(0, REPO)
 from horovod_tpu import flight_recorder  # noqa: E402
 from horovod_tpu.run.rendezvous import RendezvousServer  # noqa: E402
 from horovod_tpu.runtime.native import native_built  # noqa: E402
-
-WORKER = os.path.join(REPO, "tools", "chaos_worker.py")
-
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -100,7 +113,96 @@ SCENARIOS = {
         "require_culprit": 1,
         "timeout": 240,
     },
+    "ckpt_kill_mid_commit": {
+        "world": 3,
+        "ckpt": True,
+        "env": {
+            # CHAOS_CKPT_PHASE widens the cell to the other protocol
+            # points (stage / barrier) without a separate scenario:
+            # the acceptance invariant is phase-independent
+            "HOROVOD_CKPT_FAULT":
+                "kill:rank=1:phase="
+                + os.environ.get("CHAOS_CKPT_PHASE", "publish")
+                + ":step=3:code=19",
+            "HOROVOD_CKPT_ASYNC": "0",
+            "HOROVOD_CKPT_KEEP": "20",
+            "HOROVOD_CKPT_BARRIER_TIMEOUT_SECONDS": "3",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        },
+        "expected_exit": {1: 19},
+        "require_reform": True,
+        "ckpt_verify": "midcommit",
+        "timeout": 240,
+    },
+    "ckpt_reform_sharded_adamw": {
+        "world": 3,
+        "worker": "ckpt_chaos_worker.py",
+        "ckpt": True,
+        "env": {
+            "HOROVOD_FAULT_INJECT": "kill:rank=1:step=3:code=17",
+            "HOROVOD_CKPT_ASYNC": "0",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        },
+        "expected_exit": {1: 17},
+        "require_reform": True,
+        "check_w": False,
+        "require_true": ["steps_ok", "moments_nonzero",
+                         "moments_uniform", "replica_restored"],
+        "ckpt_verify": "manifest",
+        "timeout": 240,
+    },
 }
+
+
+def _verify_ckpt_midcommit(ckpt_dir, total, failures):
+    """Every manifest left behind restores bit-identically (the loop
+    adds exactly 1.0 per step, so ``w == float32(step)`` exactly), the
+    abandoned step-3 manifest does not exist, and the newest cut is the
+    final step."""
+    import numpy as np
+
+    from horovod_tpu import ckpt
+    from horovod_tpu.ckpt import manifest as mf_mod
+
+    steps = mf_mod.all_steps(ckpt_dir)
+    if 3 in steps:
+        failures.append(
+            "step-3 manifest exists — the publish-phase kill should "
+            "have abandoned that commit")
+    if not steps or max(steps) != total:
+        failures.append(
+            f"newest manifest is {max(steps) if steps else None}, "
+            f"want {total} (steps: {steps})")
+    target = {"params": {"w": np.zeros(4, np.float32)}, "optimizer": None}
+    for s in steps:
+        try:
+            trees, _ = ckpt.restore_step(ckpt_dir, s, target)
+        except Exception as exc:
+            failures.append(f"restore_step({s}) failed: {exc}")
+            continue
+        w = np.asarray(trees["params"]["w"])
+        if not np.array_equal(w, np.full(4, np.float32(s))):
+            failures.append(
+                f"step {s} restored w={w.tolist()} — not bit-identical "
+                f"to the committed value {float(s)}")
+
+
+def _verify_ckpt_manifest(ckpt_dir, total, failures):
+    """The newest manifest is the final step and every shard file it
+    names passes its whole-file digest."""
+    from horovod_tpu.ckpt import manifest as mf_mod
+
+    steps = mf_mod.all_steps(ckpt_dir)
+    if not steps or max(steps) != total:
+        failures.append(
+            f"newest manifest is {max(steps) if steps else None}, "
+            f"want {total} (steps: {steps})")
+        return
+    try:
+        manifest = mf_mod.load_manifest(ckpt_dir, max(steps))
+        mf_mod.verify_manifest_files(ckpt_dir, manifest)
+    except Exception as exc:
+        failures.append(f"final manifest failed verification: {exc}")
 
 
 def _collect_dumps(flight_dir, server):
@@ -125,7 +227,11 @@ def run_scenario(name, spec):
     timeout = spec.get("timeout", 240)
     hung = set(spec.get("hung_ranks", ()))
     expected_exit = dict(spec.get("expected_exit", {}))
+    worker = os.path.join(REPO, "tools",
+                          spec.get("worker", "chaos_worker.py"))
     flight_dir = tempfile.mkdtemp(prefix="chaos-flight-")
+    ckpt_dir = (tempfile.mkdtemp(prefix="chaos-ckpt-")
+                if spec.get("ckpt") else None)
     server = RendezvousServer(host="127.0.0.1")
     http_port = server.start()
     socket_port = _free_port()
@@ -149,9 +255,11 @@ def run_scenario(name, spec):
                 "HOROVOD_FLIGHT_RECORDER_DIR": flight_dir,
                 "JAX_PLATFORMS": "cpu",
             })
+            if ckpt_dir:
+                env["HOROVOD_CKPT_DIR"] = ckpt_dir
             env.update(spec.get("env", {}))
             procs.append(subprocess.Popen(
-                [sys.executable, WORKER], env=env,
+                [sys.executable, worker], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True))
         # wait for every rank that is expected to terminate on its own;
@@ -198,11 +306,18 @@ def run_scenario(name, spec):
         if not survivors:
             failures.append("no surviving rank reported CHAOS_RESULT")
         total = int(os.environ.get("CHAOS_TOTAL_STEPS", "8"))
-        for r in survivors:
-            if r["step"] != total or abs(r["w"] - total) > 1e-4:
-                failures.append(
-                    f"lost steps on rank {r['rank']}: step={r['step']} "
-                    f"w={r['w']} (want {total})")
+        if spec.get("check_w", True):
+            for r in survivors:
+                if r["step"] != total or abs(r["w"] - total) > 1e-4:
+                    failures.append(
+                        f"lost steps on rank {r['rank']}: "
+                        f"step={r['step']} w={r['w']} (want {total})")
+        for field in spec.get("require_true", ()):
+            for r in survivors:
+                if not r.get(field):
+                    failures.append(
+                        f"rank {r['rank']}: expected {field}=true, "
+                        f"got {r.get(field)!r}")
         retries = sum(r["net_retries_total"] for r in survivors)
         injections = sum(r["chaos_injected_total"] for r in survivors)
         if spec.get("require_retries") and retries <= 0:
@@ -213,6 +328,11 @@ def run_scenario(name, spec):
         if spec.get("require_reform") and not any(
                 r["generation"] >= 1 for r in survivors):
             failures.append("expected an elastic re-form (generation >= 1)")
+
+        if ckpt_dir and spec.get("ckpt_verify") == "midcommit":
+            _verify_ckpt_midcommit(ckpt_dir, total, failures)
+        elif ckpt_dir and spec.get("ckpt_verify") == "manifest":
+            _verify_ckpt_manifest(ckpt_dir, total, failures)
 
         postmortem = ""
         culprit = spec.get("require_culprit")
@@ -240,6 +360,8 @@ def run_scenario(name, spec):
                 p.kill()
         server.stop()
         shutil.rmtree(flight_dir, ignore_errors=True)
+        if ckpt_dir:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
 def main() -> int:

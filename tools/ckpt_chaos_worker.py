@@ -1,0 +1,148 @@
+"""Worker for the sharded-AdamW checkpoint chaos scenario
+(``ckpt_reform_sharded_adamw`` in tools/chaos_matrix.py).
+
+ZeRO-1 ``hvd.sharded_adamw`` training where every parameter element
+starts equal and every gradient element is 1.0 — so every REAL element
+of the flat fp32 master/mu/nu buffers stays exactly equal across the
+whole (sharded) buffer at every step. That uniformity is the oracle for
+the neighbor-replica restore: when rank 1 is killed and the survivors
+re-form, the dead rank's moment segments must come back from its left
+neighbor's replica (PR-9), not as zeros (the PR-5 ``zero.resync`` data
+loss). Zero-filled segments would evolve differently from the
+surviving segments for the rest of the run, so the final check — all
+real mu/nu elements nonzero AND identical across every surviving
+shard — distinguishes a replica restore from a zero-fill, not just
+from a crash.
+
+Emits ``CHAOS_RESULT {json}`` with boolean fields the matrix asserts
+via ``require_true``: ``steps_ok``, ``moments_nonzero``,
+``moments_uniform``, ``replica_restored``.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic, flight_recorder
+
+TOTAL_STEPS = int(os.environ.get("CHAOS_TOTAL_STEPS", "8"))
+STEP_SLEEP = float(os.environ.get("CHAOS_STEP_SLEEP", "0"))
+# not divisible by 2 or 3: both the pre- and post-reform shard layouts
+# carry zero-padding, so the real-vs-padding masking is exercised
+N = 37
+
+SOPT = None
+
+
+@elastic.run
+def train(state):
+    import jax.numpy as jnp
+
+    while state.step < TOTAL_STEPS:
+        grads = {"w": jnp.ones((N,), jnp.float32)}
+        state.params, state.optimizer = SOPT.apply(
+            state.params, state.optimizer, grads)
+        state.step += 1
+        state.commit()
+        if STEP_SLEEP:
+            time.sleep(STEP_SLEEP)
+    return state
+
+
+def _real_moments(opt_state):
+    """Per-component REAL (non-padding) elements of this rank's moment
+    shards: ``{"mu": array, "nu": array}``. spec.rank * shard_elems is
+    this shard's offset in the flat buffer; elements whose global index
+    is >= n are padding. mu and nu hold different values by nature, so
+    uniformity is only meaningful per component."""
+    from horovod_tpu.parallel import zero
+
+    export = zero.export_shard_arrays(opt_state)
+    spec = opt_state.spec
+    out = {}
+    for comp in ("mu", "nu"):
+        parts = []
+        for g, arr in zip(spec.groups, export[comp]):
+            arr = np.asarray(arr).reshape(-1)
+            offset = spec.rank * g.shard_elems
+            parts.append(arr[:max(0, min(g.n - offset, arr.size))])
+        out[comp] = (np.concatenate(parts) if parts
+                     else np.zeros(0, np.float32))
+    return out
+
+
+def _metric_total(snap, name):
+    fam = snap.get(name, {})
+    return float(sum(row.get("value", 0.0)
+                     for row in fam.get("values", ())))
+
+
+def main() -> int:
+    global SOPT
+    import jax.numpy as jnp
+
+    hvd.init()
+    SOPT = hvd.sharded_adamw(0.1)
+    params = {"w": jnp.full((N,), 0.5, jnp.float32)}
+    state = elastic.ArrayState(
+        params=params, optimizer=SOPT.init(params), step=0)
+    train(state)
+    state.checkpoint_wait()
+
+    moments = _real_moments(state.optimizer)
+    moments_nonzero = bool(all(
+        arr.size == 0 or np.all(np.abs(arr) > 0)
+        for arr in moments.values()) and any(
+        arr.size for arr in moments.values()))
+    # per component: locally uniform, and the uniform value agrees
+    # across every surviving shard (min/max allgather) — a zero-filled
+    # replica would break one or the other
+    moments_uniform = True
+    for comp in ("mu", "nu"):
+        arr = moments[comp]
+        local = np.array([arr.min() if arr.size else np.nan,
+                          arr.max() if arr.size else np.nan], np.float64)
+        gathered = np.asarray(hvd.allgather(
+            local, name=f"ckpt_chaos_mm_{comp}"))
+        vals = gathered[np.isfinite(gathered)]
+        if vals.size and float(vals.max() - vals.min()) != 0.0:
+            moments_uniform = False
+
+    snap = hvd.metrics()
+    replica_restores = _metric_total(
+        snap, "horovod_ckpt_replica_restores_total")
+    result = {
+        "rank": hvd.rank(),
+        "size": hvd.size(),
+        "step": state.step,
+        "generation": elastic.restarts(),
+        "steps_ok": state.step == TOTAL_STEPS,
+        "moments_nonzero": moments_nonzero,
+        "moments_uniform": moments_uniform,
+        "replica_restored": replica_restores > 0,
+        "replica_restores_total": replica_restores,
+        "net_retries_total": _metric_total(
+            snap, "horovod_net_retries_total"),
+        "net_gave_up_total": _metric_total(
+            snap, "horovod_net_gave_up_total"),
+        "chaos_injected_total": _metric_total(
+            snap, "horovod_net_chaos_injected_total"),
+    }
+    try:  # the postmortem needs post-reform events
+        flight_recorder.dump_debug_state(reason="chaos_run_complete")
+    except Exception:
+        pass
+    print("CHAOS_RESULT " + json.dumps(result), flush=True)
+    ok = (result["steps_ok"] and moments_nonzero and moments_uniform)
+    hvd.shutdown()
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
