@@ -701,6 +701,124 @@ def collectives_main(tiny: bool = False):
     return result
 
 
+def integrity_main(tiny: bool = False):
+    """Integrity-plane microbench (ISSUE 10): steady-state cost of the
+    in-band collective digests on the fused allreduce path, at
+    BERT-Large gradient shapes (one encoder layer's worth of kernels per
+    step — the fusion buckets the flagship workload actually reduces).
+
+    Three interleaved phases over identical named tensors so dispatch
+    drift cannot masquerade as digest cost: integrity OFF (the pre-PR-10
+    data plane), ON at the default ``HOROVOD_INTEGRITY_INTERVAL``
+    (headline ``value``: added p50 step %, goal < 1%), and ON checking
+    EVERY dispatch (the worst case, reported for context). Warmup runs
+    with checks on every dispatch so the masked digest program compiles
+    before timing starts; the timed phases must add ZERO new program
+    compiles (same canary as --collectives).
+
+    ``tiny`` (--tiny / the tier-1 smoke test): toy shapes + 2 steps."""
+    hvd.init()
+    from horovod_tpu import integrity as integ
+    from horovod_tpu.integrity import digest as integ_digest
+    from horovod_tpu.runtime import executor as executor_mod
+
+    world = hvd.size()
+    if tiny:
+        shapes = [(256,), (64, 8)]
+        warmup_steps, timed_steps = 3, 2
+    else:
+        # one BERT-Large encoder layer's gradient tensors (d=1024,
+        # ff=4096): two attention kernels + the MLP pair + a layernorm
+        shapes = [(1024, 1024), (1024, 1024), (1024, 4096), (4096, 1024),
+                  (1024,)]
+        warmup_steps, timed_steps = 6, 7
+    rng = np.random.RandomState(0)
+    payloads = [rng.randn(world, *s).astype(np.float32) for s in shapes]
+    n_elems = sum(int(np.prod(s)) for s in shapes)
+    log(f"integrity bench: {len(shapes)} tensors, "
+        f"{n_elems * 4 / 1e6:.1f} MB/step/worker, np={world}"
+        f"{' (tiny)' if tiny else ''}")
+
+    def one_step(step):
+        hs = [hvd.allreduce_async(
+            hvd.stack_per_worker(list(payloads[j] + np.float32(step))),
+            name=f"integ/t{j}") for j in range(len(shapes))]
+        for h in hs:
+            hvd.synchronize(h)
+
+    saved = {k: os.environ.get(k)
+             for k in ("HOROVOD_INTEGRITY", "HOROVOD_INTEGRITY_INTERVAL")}
+
+    def set_phase(interval):
+        if interval is None:
+            os.environ.pop("HOROVOD_INTEGRITY", None)
+            os.environ.pop("HOROVOD_INTEGRITY_INTERVAL", None)
+        else:
+            os.environ["HOROVOD_INTEGRITY"] = "1"
+            os.environ["HOROVOD_INTEGRITY_INTERVAL"] = str(interval)
+
+    default_iv = integ.DEFAULT_INTEGRITY_INTERVAL
+    try:
+        # warmup with checks on EVERY dispatch: compiles the fused
+        # programs AND the masked digest program for every bucket
+        set_phase(1)
+        for s in range(warmup_steps):
+            one_step(s)
+        compiles0 = executor_mod._PROGRAM_COMPILES.value
+        checks0 = integ_digest._CHECKS.value
+
+        phases = {"off": (None, []), "default": (default_iv, []),
+                  "every": (1, [])}
+        for s in range(timed_steps):
+            for name, (interval, lat) in phases.items():
+                set_phase(interval)
+                t0 = time.perf_counter()
+                one_step(1000 + s * len(phases))
+                lat.append(time.perf_counter() - t0)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    steady_compiles = executor_mod._PROGRAM_COMPILES.value - compiles0
+    checks = integ_digest._CHECKS.value - checks0
+    p50 = {name: float(np.median(lat))
+           for name, (_, lat) in phases.items()}
+
+    def pct(on):
+        return (round(100.0 * (p50[on] - p50["off"]) / p50["off"], 2)
+                if p50["off"] > 0 else None)
+
+    result = {
+        "metric": f"integrity digest steady-state step overhead "
+                  f"(in-band digests every {default_iv} dispatches, "
+                  f"{'toy' if tiny else 'BERT-Large layer'} gradient "
+                  f"shapes, np={world})",
+        "value": pct("default"),
+        "unit": "%",
+        "goal": "< 1%",
+        "p50_ms_integrity_off": round(p50["off"] * 1e3, 3),
+        "p50_ms_default_interval": round(p50["default"] * 1e3, 3),
+        "p50_ms_every_dispatch": round(p50["every"] * 1e3, 3),
+        "every_dispatch_overhead_pct": pct("every"),
+        "digest_interval": default_iv,
+        "digest_checks_timed_phase": int(checks),
+        "steady_state_compiles": int(steady_compiles),
+    }
+    if tiny:
+        result["tiny"] = True
+    log(f"integrity: p50 off {result['p50_ms_integrity_off']} ms, "
+        f"default-interval {result['p50_ms_default_interval']} ms "
+        f"({result['value']}%), every-dispatch "
+        f"{result['p50_ms_every_dispatch']} ms "
+        f"({result['every_dispatch_overhead_pct']}%); "
+        f"compiles(timed)={steady_compiles}")
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def _bert_large_param_shapes():
     """BERT-Large parameter shapes (L=24, d=1024, ff=4096, vocab 30522,
     seq 512) as a flat dict — ~335M params, the flagship workload's
@@ -1057,6 +1175,11 @@ if __name__ == "__main__":
                         help="microbench the data plane: steady-state "
                              "fused allreduce latency vs payload size + "
                              "XLA compile count (one JSON line)")
+    parser.add_argument("--integrity", action="store_true",
+                        help="microbench the numerical-integrity plane: "
+                             "in-band digest overhead vs interval at "
+                             "BERT-Large gradient shapes + compile-count "
+                             "canary (one JSON line)")
     parser.add_argument("--sharded-optimizer", action="store_true",
                         help="microbench the ZeRO-1 sharded optimizer "
                              "update phase (replicated vs sharded AdamW "
@@ -1082,6 +1205,8 @@ if __name__ == "__main__":
     cli = parser.parse_args()
     if cli.collectives:
         collectives_main(tiny=cli.tiny)
+    elif cli.integrity:
+        integrity_main(tiny=cli.tiny)
     elif cli.checkpoint:
         checkpoint_main(tiny=cli.tiny)
     elif cli.sharded_optimizer:

@@ -126,10 +126,13 @@ from horovod_tpu import checkpoint
 from horovod_tpu import ckpt
 from horovod_tpu import data
 from horovod_tpu import elastic
+from horovod_tpu import integrity
 from horovod_tpu.exceptions import (
     CheckpointCorruptError,
+    CollectiveIntegrityError,
     HorovodInternalError,
     HostsUpdatedInterrupt,
+    NumericalError,
     WorkersDownError,
     WorkerLostError,
     WorkerStallError,
@@ -178,4 +181,6 @@ __all__ = [
     "elastic",
     "HorovodInternalError", "HostsUpdatedInterrupt",
     "WorkersDownError", "WorkerLostError", "WorkerStallError",
+    # numerical integrity plane (digests / guards / rollback-and-replay)
+    "integrity", "NumericalError", "CollectiveIntegrityError",
 ]

@@ -99,12 +99,15 @@ def parse_spec(text: str) -> FaultSpec:
 def spec_from_env() -> Optional[FaultSpec]:
     """First process-fault clause of the (possibly composite) env spec.
     Network-fault clauses (partition/kv_outage/flaky/netdelay) belong to
-    ``utils.resilience`` and are skipped here, not rejected."""
+    ``utils.resilience`` and data-corruption clauses (bitflip/nan) to
+    ``integrity.inject``; both are skipped here, not rejected."""
+    from horovod_tpu.integrity import inject as _integrity_inject
     from horovod_tpu.utils import resilience
 
     for clause in os.environ.get(HOROVOD_FAULT_INJECT, "").split(";"):
         clause = clause.strip()
-        if not clause or resilience.is_net_clause(clause):
+        if not clause or resilience.is_net_clause(clause) \
+                or _integrity_inject.is_integrity_clause(clause):
             continue
         return parse_spec(clause)
     return None

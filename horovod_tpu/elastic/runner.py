@@ -389,6 +389,20 @@ def run(func):
                             "host set", exc)
                 flight_recorder.emit("hosts_updated", notice=str(exc)[:200])
                 rollback = None  # re-form without rollback
+            except exceptions.NumericalError as exc:
+                # no worker is down: every rank raised the identical
+                # digest/guard verdict together, so recovery is an
+                # in-place rollback-and-replay — no membership re-form,
+                # no process restart. handle_failure re-raises when the
+                # HOROVOD_ROLLBACK_BUDGET is spent (supervised restart
+                # takes over) and may exit a quarantined suspect.
+                log.warning("elastic: integrity failure (%s) — rolling "
+                            "back in place", exc)
+                flight_recorder.dump_on_failure("integrity_violation")
+                from horovod_tpu.integrity import rollback as _rollback
+
+                _rollback.handle_failure(state, exc)
+                continue
             except exceptions.WorkersDownError as exc:
                 log.warning("elastic: workers down (%s) — attempting "
                             "recovery", exc)

@@ -62,6 +62,33 @@ class CheckpointCorruptError(HorovodInternalError):
         self.leaf = leaf
 
 
+class NumericalError(HorovodInternalError):
+    """A payload or training statistic went numerically bad: non-finite
+    values entered a collective, or the step guard's spike budget was
+    exhausted. Deliberately NOT a :class:`WorkersDownError` — no worker
+    is down; the elastic runner handles it by rolling back to the last
+    committed state and replaying instead of re-forming membership."""
+
+    def __init__(self, message: str, bucket: Optional[str] = None,
+                 tensor: Optional[str] = None,
+                 suspect_rank: Optional[int] = None) -> None:
+        super().__init__(message)
+        #: fusion bucket / lane the bad payload traveled in, when known
+        self.bucket = bucket
+        #: tensor (or group member) name carrying non-finite values
+        self.tensor = tensor
+        #: rank whose local payload was non-finite, when attributable
+        self.suspect_rank = suspect_rank
+
+
+class CollectiveIntegrityError(NumericalError):
+    """Cross-rank digest disagreement on a collective's *result*: the
+    replicated output differs between ranks, i.e. silent data corruption
+    (a flipped bit, a divergent reduction) somewhere in the data plane.
+    Carries the digest vote's minority rank as ``suspect_rank`` so the
+    rollback path can optionally quarantine it."""
+
+
 class HostsUpdatedInterrupt(Exception):
     """The elastic driver announced a host-set change (reference:
     horovod/common/exceptions.py HostsUpdatedInterrupt). Not an error:

@@ -413,6 +413,14 @@ def suspect_culprit(dumps: List[dict]) -> Optional[Tuple[Any, str]]:
             if ev.get("kind") == "fault_inject" and ev.get("action") == \
                     "kill":
                 return ev.get("rank"), "recorded its own injected kill"
+    # integrity plane (integrity/): a digest vote that convicted a rank
+    # is direct evidence — stronger than any absence/straggler heuristic
+    for d in dumps:
+        for ev in d.get("events", ()):
+            if ev.get("kind") in ("integrity_violation", "rollback") \
+                    and ev.get("suspect") is not None:
+                return ev.get("suspect"), (
+                    "voted out by collective digest disagreement")
     named: Dict[Any, int] = {}
     for d in dumps:
         for ev in d.get("events", ()):

@@ -1,0 +1,69 @@
+"""Numerical integrity plane: in-band collective digests, NaN/SDC
+guards, and automatic rollback-and-replay.
+
+PRs 2/8/9 made the system survive *loud* failures — killed ranks,
+network partitions, torn checkpoints. This subsystem defends against
+*silent* ones: a bit flipped in a collective result, a NaN that poisons
+every replica through allreduce, or one divergent rank corrupting the
+globally-averaged weights ("Silent Data Corruptions at Scale", Dinh et
+al. 2022; "Cores that don't count", Hochschild et al. 2021). Three
+cooperating layers, all off by default and armed by ``HOROVOD_INTEGRITY``:
+
+* :mod:`~horovod_tpu.integrity.digest` — per-fusion-bucket payload
+  digests (non-finite count on the *input*, checksum of the *result*)
+  computed in band with the existing fused programs every
+  ``HOROVOD_INTEGRITY_INTERVAL`` dispatches, plus the cross-rank
+  digest-agreement exchange and majority vote that names the suspect
+  rank.
+* :mod:`~horovod_tpu.integrity.guards` — EWMA loss/grad-norm spike
+  detection and the skip-step policy hooked into
+  ``DistributedOptimizer`` and ``training.make_train_step``.
+* :mod:`~horovod_tpu.integrity.rollback` — on a typed integrity
+  failure, restore the last committed checkpoint in place (no process
+  restart), optionally quarantine the voted-out rank, and replay under
+  ``HOROVOD_ROLLBACK_BUDGET``.
+
+:mod:`~horovod_tpu.integrity.inject` extends the PR-2 fault harness
+with the silent-corruption fault kinds (``bitflip:<rank>[:after=N]``,
+``nan:<rank>[:after=N]``) that validate the whole loop end to end.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.utils.env import _get_bool, _get_int
+
+# Master switch for the digest/guard machinery. Injection
+# (integrity/inject.py) is armed by HOROVOD_FAULT_INJECT alone so a
+# chaos run can prove that *undetected* corruption really corrupts.
+HOROVOD_INTEGRITY = "HOROVOD_INTEGRITY"
+# Digest cadence in fused dispatches per lane; 0 disables digests while
+# leaving the step guards armed.
+HOROVOD_INTEGRITY_INTERVAL = "HOROVOD_INTEGRITY_INTERVAL"
+DEFAULT_INTEGRITY_INTERVAL = 32
+
+
+def enabled() -> bool:
+    """Whether the integrity plane is armed (read per call: tests and
+    the elastic re-form rewrite env between generations)."""
+    return _get_bool(HOROVOD_INTEGRITY)
+
+
+def interval() -> int:
+    """Digest cadence in dispatches (<=0 disables digest checks)."""
+    return _get_int(HOROVOD_INTEGRITY_INTERVAL, DEFAULT_INTEGRITY_INTERVAL)
+
+
+# Submodules import after the knob helpers they read; importing them
+# here registers the horovod_integrity_* metrics family on package
+# import so snapshots show zeros instead of missing families.
+from horovod_tpu.integrity import digest  # noqa: E402
+from horovod_tpu.integrity import guards  # noqa: E402
+from horovod_tpu.integrity import inject  # noqa: E402
+from horovod_tpu.integrity import rollback  # noqa: E402
+from horovod_tpu.integrity.guards import StepGuard  # noqa: E402,F401
+
+__all__ = [
+    "HOROVOD_INTEGRITY", "HOROVOD_INTEGRITY_INTERVAL",
+    "DEFAULT_INTEGRITY_INTERVAL", "enabled", "interval",
+    "digest", "guards", "inject", "rollback", "StepGuard",
+]

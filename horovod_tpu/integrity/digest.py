@@ -1,0 +1,185 @@
+"""In-band collective payload digests and the cross-rank agreement vote.
+
+Two complementary digests ride each checked fused dispatch:
+
+* **input non-finite count** — computed on the rank's *local* payload
+  before the reduction. A NaN/Inf that enters a sum/avg collective
+  poisons every replica's result identically, so the post-reduce output
+  cannot name the origin; the pre-reduce count can, and the agreement
+  exchange turns it into a typed :class:`~horovod_tpu.exceptions.
+  NumericalError` carrying ``suspect_rank``.
+* **result checksum** — CRC-32 of the reduced bytes each rank holds.
+  The reduction's output is replicated by construction, so any
+  disagreement is silent data corruption (a flipped bit, a divergent
+  reduction order) on the minority rank; the majority vote names it and
+  raises :class:`~horovod_tpu.exceptions.CollectiveIntegrityError`.
+
+The exchange itself is one small ``allgatherv`` of a fixed 12-byte
+record per rank, run only every ``HOROVOD_INTEGRITY_INTERVAL`` checked
+dispatches, on the same thread and in the same negotiated order as the
+payload traffic — in band, never racing the transport. Every rank
+computes the identical verdict from the identical gathered records, so
+all ranks raise together and the elastic rollback stays lockstep.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from horovod_tpu import exceptions
+from horovod_tpu.metrics import registry as _metrics
+
+_CHECKS = _metrics().counter(
+    "horovod_integrity_checks_total",
+    "Digest checks performed on fused collective payloads.")
+_VIOLATIONS = _metrics().counter(
+    "horovod_integrity_violations_total",
+    "Integrity violations detected (non-finite payloads, cross-rank "
+    "digest divergence, guard-budget exhaustion).",
+    labelnames=("kind",))
+
+# one record per rank on the wire: int64 non-finite count + uint32 CRC
+_RECORD = struct.Struct("<qI")
+
+# per-lane dispatch counters for the eager call sites (collectives /
+# zero) that have no executor to hang cadence state on
+_cadence: Dict[str, int] = {}  # guarded-by: <owner-thread>
+
+
+def nonfinite_count(arr) -> int:
+    """Count of NaN/Inf elements in ``arr``; 0 for non-float dtypes
+    (integer payloads cannot go non-finite)."""
+    a = np.asarray(arr)
+    if a.dtype.kind not in ("f", "c", "V"):
+        return 0
+    if a.dtype.kind == "V":  # ml_dtypes (bf16) registers as void to numpy
+        a = a.astype(np.float32)
+    return int(np.sum(~np.isfinite(a)))
+
+
+def checksum(arr) -> int:
+    """CRC-32 of the array's bytes. Bitwise, not numeric: two results
+    that differ only in NaN payload bits or -0.0 vs 0.0 still diverge,
+    which is exactly the SDC signal wanted here."""
+    a = np.ascontiguousarray(arr)
+    return zlib.crc32(a.view(np.uint8).tobytes()) & 0xFFFFFFFF
+
+
+def cadence_due(key: str, interval: Optional[int] = None) -> bool:
+    """Per-lane dispatch cadence for eager call sites: True on the
+    first and every ``interval``-th call for ``key``. Deterministic
+    across ranks because the call sites execute in program order."""
+    from horovod_tpu import integrity
+
+    if not integrity.enabled():
+        return False
+    if interval is None:
+        interval = integrity.interval()
+    if interval <= 0:
+        return False
+    n = _cadence.get(key, 0)
+    _cadence[key] = n + 1
+    return n % interval == 0
+
+
+def reset() -> None:
+    """Forget cadence state (tests; elastic re-form)."""
+    _cadence.clear()
+
+
+def exchange(net, nf_count: int, crc: int) -> List[Tuple[int, int]]:
+    """Gather every rank's (non-finite count, result CRC) record.
+
+    Must run on the thread that owns ``net`` (the cycle thread for the
+    executor paths), in the same negotiated order on every rank."""
+    blobs = net.allgatherv(_RECORD.pack(int(nf_count), int(crc) & 0xFFFFFFFF))
+    return [_RECORD.unpack(bytes(blob)) for blob in blobs]
+
+
+def vote(crcs: Sequence[int]) -> Tuple[bool, Optional[int]]:
+    """Majority vote over result checksums.
+
+    Returns ``(diverged, suspect_rank)``: ``suspect_rank`` is the rank
+    holding a minority checksum when the minority is a single rank,
+    else None (a split vote is still a violation, just unattributable).
+    """
+    tally: Dict[int, List[int]] = {}
+    for rank, crc in enumerate(crcs):
+        tally.setdefault(crc, []).append(rank)
+    if len(tally) <= 1:
+        return False, None
+    sizes = sorted(len(ranks) for ranks in tally.values())
+    # attributable only when a UNIQUE single-rank minority exists — a
+    # 1-vs-1 split (world of 2) or a multi-rank minority cannot say who
+    # corrupted
+    if sizes[0] != 1 or (len(sizes) > 1 and sizes[1] == 1):
+        return True, None
+    minority = min(tally.values(), key=len)
+    return True, minority[0]
+
+
+def verify(records: Sequence[Tuple[int, int]], bucket: str,
+           tensor: Optional[str] = None) -> None:
+    """Turn gathered digest records into the typed verdict.
+
+    Every rank holds identical ``records`` (the exchange is an
+    allgather), computes the identical verdict, and raises together —
+    the elastic runner's rollback therefore stays lockstep with no
+    extra barrier. Non-finite inputs outrank checksum divergence: a NaN
+    propagates through the reduction and *causes* CRC agreement (every
+    rank reduces to the same NaN), so the input digest is the only
+    attribution signal for that class."""
+    _CHECKS.inc()
+    bad = [(rank, nf) for rank, (nf, _) in enumerate(records) if nf > 0]
+    if bad:
+        _VIOLATIONS.labels(kind="nonfinite").inc()
+        suspect, count = bad[0]
+        _emit_violation("nonfinite", bucket, tensor, suspect,
+                        detail=f"{count} non-finite elements "
+                               f"({len(bad)} rank(s) affected)")
+        raise exceptions.NumericalError(
+            f"non-finite payload entered collective bucket {bucket!r}: "
+            f"rank {suspect} contributed {count} NaN/Inf element(s)",
+            bucket=bucket, tensor=tensor, suspect_rank=suspect)
+    diverged, suspect = vote([crc for _, crc in records])
+    if diverged:
+        _VIOLATIONS.labels(kind="divergence").inc()
+        _emit_violation("divergence", bucket, tensor, suspect,
+                        detail="result checksum disagreement "
+                               f"{[hex(c) for _, c in records]}")
+        raise exceptions.CollectiveIntegrityError(
+            f"collective result diverged across ranks in bucket "
+            f"{bucket!r} (checksums {[hex(c) for _, c in records]}); "
+            f"suspect rank {suspect}",
+            bucket=bucket, tensor=tensor, suspect_rank=suspect)
+
+
+def verify_local(nf_count: int, bucket: str, tensor: Optional[str] = None,
+                 suspect_rank: Optional[int] = None) -> None:
+    """Single-copy verdict for paths with no cross-rank exchange (the
+    single-controller fused program, the ZeRO sharded update): a
+    non-finite count alone convicts, no vote needed."""
+    _CHECKS.inc()
+    if nf_count <= 0:
+        return
+    _VIOLATIONS.labels(kind="nonfinite").inc()
+    _emit_violation("nonfinite", bucket, tensor, suspect_rank,
+                    detail=f"{nf_count} non-finite elements")
+    raise exceptions.NumericalError(
+        f"non-finite payload in collective bucket {bucket!r}"
+        + (f" from rank {suspect_rank}" if suspect_rank is not None else "")
+        + f": {nf_count} NaN/Inf element(s)",
+        bucket=bucket, tensor=tensor, suspect_rank=suspect_rank)
+
+
+def _emit_violation(kind: str, bucket: str, tensor: Optional[str],
+                    suspect: Optional[int], detail: str) -> None:
+    from horovod_tpu import flight_recorder
+
+    flight_recorder.emit("integrity_violation", violation=kind,
+                         bucket=bucket, tensor=tensor, suspect=suspect,
+                         detail=detail)

@@ -1,0 +1,158 @@
+"""Step-level numerical guards: EWMA spike detection + skip-step policy.
+
+The digests (digest.py) catch corruption *in the collective*; the step
+guard catches what they structurally cannot — a numerically-poisoned
+batch, an exploding loss, a gradient blow-up that is finite but wrong.
+:class:`StepGuard` keeps an exponentially-weighted mean/variance of a
+scalar stream (loss or global grad-norm) and flags observations that
+are non-finite or spike above ``mean + sigma * std``. A flagged step is
+*skipped* (the optimizer update suppressed, the data consumed) up to
+``HOROVOD_INTEGRITY_SKIP_STEPS`` consecutive times; past the budget the
+guard raises :class:`~horovod_tpu.exceptions.NumericalError` so the
+elastic runner rolls back instead of letting a persistent divergence
+eat the run.
+
+Determinism note: the guard observes *globally-reduced* scalars (the
+allreduced loss / grad norm), so every rank sees the same stream, makes
+the same skip decision, and raises on the same step — no extra
+agreement traffic needed.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from horovod_tpu import exceptions
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import _get_float, _get_int
+
+HOROVOD_INTEGRITY_SPIKE_SIGMA = "HOROVOD_INTEGRITY_SPIKE_SIGMA"
+HOROVOD_INTEGRITY_SKIP_STEPS = "HOROVOD_INTEGRITY_SKIP_STEPS"
+DEFAULT_SPIKE_SIGMA = 6.0
+DEFAULT_SKIP_STEPS = 3
+
+_SKIPPED = _metrics().counter(
+    "horovod_integrity_skipped_steps_total",
+    "Optimizer steps suppressed by the integrity spike guard.")
+
+
+class StepGuard:
+    """EWMA spike detector over one scalar training statistic.
+
+    ``observe(v)`` returns True to accept the step, False to skip it;
+    raises :class:`NumericalError` when ``skip_budget`` consecutive
+    steps have been skipped. State is single-threaded (the training
+    loop's thread).
+    """
+
+    def __init__(self, sigma: Optional[float] = None,
+                 skip_budget: Optional[int] = None,
+                 warmup: int = 5, decay: float = 0.9,
+                 name: str = "loss") -> None:
+        self.sigma = sigma if sigma is not None else _get_float(
+            HOROVOD_INTEGRITY_SPIKE_SIGMA, DEFAULT_SPIKE_SIGMA)
+        self.skip_budget = skip_budget if skip_budget is not None \
+            else _get_int(HOROVOD_INTEGRITY_SKIP_STEPS, DEFAULT_SKIP_STEPS)
+        self.warmup = warmup
+        self.decay = decay
+        self.name = name
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+        self.consecutive_skips = 0
+
+    def _is_spike(self, v: float) -> bool:
+        if not math.isfinite(v):
+            return True
+        if self.n < self.warmup:
+            return False
+        std = math.sqrt(max(self.var, 0.0))
+        # one-sided upward test with a small relative slack: a constant
+        # stream has std ~= 0 and must not trip on float jitter, and a
+        # *drop* in loss is progress, never a spike
+        slack = 1e-6 + 1e-3 * abs(self.mean)
+        return v > self.mean + self.sigma * std + slack
+
+    def observe(self, v: float) -> bool:
+        v = float(v)
+        if self._is_spike(v):
+            self.consecutive_skips += 1
+            _SKIPPED.inc()
+            self._emit_spike(v)
+            log.warning(
+                "integrity guard: %s spike (%r vs mean %.6g std %.3g), "
+                "skipping step (%d/%d consecutive)", self.name, v,
+                self.mean, math.sqrt(max(self.var, 0.0)),
+                self.consecutive_skips, self.skip_budget)
+            if self.consecutive_skips > self.skip_budget:
+                raise exceptions.NumericalError(
+                    f"integrity guard: {self.name} spiked on "
+                    f"{self.consecutive_skips} consecutive steps "
+                    f"(budget {self.skip_budget}); last value {v!r}, "
+                    f"EWMA mean {self.mean:.6g}", tensor=self.name)
+            return False
+        self.consecutive_skips = 0
+        # EW moments (West-style update): first observation seeds the mean
+        if self.n == 0:
+            self.mean = v
+        else:
+            diff = v - self.mean
+            incr = (1.0 - self.decay) * diff
+            self.mean += incr
+            self.var = self.decay * (self.var + diff * incr)
+        self.n += 1
+        return True
+
+    def reset(self) -> None:
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+        self.consecutive_skips = 0
+
+    def _emit_spike(self, v: float) -> None:
+        from horovod_tpu import flight_recorder
+
+        flight_recorder.emit(
+            "integrity_spike", stat=self.name, value=repr(v),
+            mean=self.mean, std=math.sqrt(max(self.var, 0.0)),
+            consecutive=self.consecutive_skips, budget=self.skip_budget)
+
+
+# process-default guard for the DistributedOptimizer hook: one stream of
+# global grad norms per process
+_default_guard: Optional[StepGuard] = None  # guarded-by: <owner-thread>
+
+
+def default_guard() -> StepGuard:
+    global _default_guard
+    if _default_guard is None:
+        _default_guard = StepGuard(name="grad_norm")
+    return _default_guard
+
+
+def reset() -> None:
+    """Drop the process-default guard (tests; elastic re-form)."""
+    global _default_guard
+    _default_guard = None
+
+
+def guard_gradients(tree) -> bool:
+    """Observe the global gradient norm of an (already allreduced)
+    gradient pytree; True = apply the update, False = skip it.
+
+    The squared-norm accumulation propagates NaN/Inf, so a single bad
+    leaf flags the whole step."""
+    import jax
+    import numpy as np
+
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf)
+        if a.dtype.kind not in ("f", "c", "V"):
+            continue
+        if a.dtype.kind == "V":
+            a = a.astype(np.float32)
+        total += float(np.sum(np.square(a.astype(np.float64))))
+    return default_guard().observe(math.sqrt(total))

@@ -1,0 +1,168 @@
+"""Silent-data-corruption fault kinds for the HOROVOD_FAULT_INJECT
+harness.
+
+The PR-2 process faults (kill/hang/slow) and PR-8 network faults
+(partition/kv_outage/...) are *loud*; these are the silent ones that the
+integrity plane exists to catch. Grammar (composes with the other kinds
+in one ``;``-separated spec)::
+
+    bitflip:<rank>[:after=<n>]
+    nan:<rank>[:after=<n>]
+
+* ``nan`` poisons one element of the target rank's *input* payload in
+  the executor pack path, before the reduction — the NaN then spreads
+  to every replica through sum/avg, modeling a poisoned gradient.
+* ``bitflip`` flips one bit in the target rank's *local copy of the
+  reduced result* after the collective, modeling SDC on the readback
+  path — the other ranks hold the correct bytes, so only the cross-rank
+  checksum vote can see it.
+* ``after`` counts eligible fused dispatches to skip before the
+  one-shot fires (default 0: the first checked dispatch).
+
+Injection is armed by HOROVOD_FAULT_INJECT alone, independent of
+``HOROVOD_INTEGRITY`` — a chaos run with detection disabled proves that
+undetected corruption really corrupts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.utils import logging as log
+
+INTEGRITY_FAULT_KINDS = ("bitflip", "nan")
+
+# armed one-shot specs, parsed lazily from the env; None = not parsed yet
+_specs: "Optional[List[FaultSpec]]" = None  # guarded-by: <owner-thread>
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    action: str
+    rank: int
+    after: int = 0      # eligible dispatches to skip before firing
+    fired: bool = False  # one-shot latch
+
+
+def is_integrity_clause(clause: str) -> bool:
+    """Whether a HOROVOD_FAULT_INJECT clause belongs to this module (so
+    ``fault_inject.spec_from_env`` skips it rather than rejecting)."""
+    return clause.strip().split(":", 1)[0].strip().lower() \
+        in INTEGRITY_FAULT_KINDS
+
+
+def parse_clause(clause: str) -> FaultSpec:
+    parts = [p.strip() for p in clause.strip().split(":")]
+    action = parts[0].lower()
+    if action not in INTEGRITY_FAULT_KINDS:
+        raise ValueError(
+            f"HOROVOD_FAULT_INJECT: unknown integrity action {action!r} "
+            f"(expected one of {INTEGRITY_FAULT_KINDS})")
+    if len(parts) < 2 or not parts[1].lstrip("-").isdigit():
+        raise ValueError(
+            f"HOROVOD_FAULT_INJECT: {action} clause must name a rank, "
+            f"got {clause!r}")
+    rank = int(parts[1])
+    after = 0
+    for part in parts[2:]:
+        key, _, value = part.partition("=")
+        if key.strip().lower() != "after" or not value:
+            raise ValueError(
+                f"HOROVOD_FAULT_INJECT: malformed integrity clause part "
+                f"{part!r} (expected after=<n>)")
+        after = int(value)
+    return FaultSpec(action=action, rank=rank, after=after)
+
+
+def specs_from_env() -> List[FaultSpec]:
+    """All armed integrity clauses, parsed once and cached so the
+    ``after`` countdown and one-shot latch persist across dispatches."""
+    global _specs
+    if _specs is None:
+        _specs = [
+            parse_clause(clause)
+            for clause in os.environ.get("HOROVOD_FAULT_INJECT", "")
+            .split(";")
+            if clause.strip() and is_integrity_clause(clause)
+        ]
+    return _specs
+
+
+def reset() -> None:
+    """Re-read the env and forget countdown state (tests)."""
+    global _specs
+    _specs = None
+
+
+def _plan(rank_filter: Optional[int]) -> Optional[Tuple[str, int]]:
+    """Advance every armed spec's countdown by one eligible dispatch and
+    return ``(action, spec_rank)`` for the first spec that fires now."""
+    fire = None
+    for spec in specs_from_env():
+        if spec.fired:
+            continue
+        if rank_filter is not None and spec.rank != rank_filter:
+            continue
+        if spec.after > 0:
+            spec.after -= 1
+            continue
+        if fire is None:
+            spec.fired = True
+            fire = (spec.action, spec.rank)
+    return fire
+
+
+def plan_dispatch() -> Optional[str]:
+    """Multi-process paths: fire when this worker's *launch* rank is
+    the clause target (re-forms renumber ranks; faults must not
+    re-target). Returns the action or None."""
+    from horovod_tpu.elastic import fault_inject
+
+    if not specs_from_env():
+        return None
+    fire = _plan(fault_inject.initial_rank())
+    if fire is None:
+        return None
+    _announce(fire[0], fire[1])
+    return fire[0]
+
+
+def plan_dispatch_any() -> Optional[Tuple[str, int]]:
+    """Single-controller path: one process owns every rank's rows, so
+    the clause rank selects the *row* instead of filtering the process.
+    Returns ``(action, row)`` or None."""
+    if not specs_from_env():
+        return None
+    fire = _plan(None)
+    if fire is not None:
+        _announce(fire[0], fire[1])
+    return fire
+
+
+def corrupt_nan(buf: np.ndarray) -> None:
+    """Poison element 0 of a float buffer in place (pre-reduce input)."""
+    flat = buf.reshape(-1)
+    if flat.dtype.kind == "V":  # ml_dtypes bf16
+        flat.view(np.uint16)[0] = 0x7FC1  # bf16 quiet NaN
+    else:
+        flat[0] = np.nan
+
+
+def corrupt_bitflip(buf: np.ndarray) -> None:
+    """Flip the lowest bit of byte 0 in place (post-reduce local copy)."""
+    raw = buf.reshape(-1).view(np.uint8)
+    raw[0] ^= 0x01
+
+
+def _announce(action: str, rank: int) -> None:
+    from horovod_tpu import flight_recorder
+    from horovod_tpu.elastic import fault_inject
+
+    log.error("fault injection: %s corruption armed for rank %d fires now",
+              action, rank)
+    fault_inject._FAULTS_INJECTED.inc()
+    flight_recorder.emit("fault_inject", action=action, rank=rank)

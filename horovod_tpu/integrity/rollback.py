@@ -1,0 +1,124 @@
+"""Automatic rollback-and-replay for integrity failures.
+
+When the digest plane (or the step guard's exhausted skip budget)
+raises a typed :class:`~horovod_tpu.exceptions.NumericalError`, every
+rank raises it together at the same dispatch — so recovery needs no
+membership re-form, no process restart, and no extra barrier: each
+rank restores the last committed checkpoint *in place* and the elastic
+runner re-enters the training function to replay the lost steps.
+
+Policy knobs:
+
+* ``HOROVOD_ROLLBACK_BUDGET`` — in-place replays allowed per process
+  lifetime (default 2). An exhausted budget re-raises the integrity
+  error so the PR-9 supervised-restart path takes over; corruption that
+  survives N replays is not transient and needs a human (or new
+  hardware).
+* ``HOROVOD_INTEGRITY_QUARANTINE`` — when the digest vote named *this*
+  rank as the corruption source, exit instead of replaying; the PR-2
+  elastic reform then re-forms the survivors without the suspect
+  machine. Off by default: a single flipped bit is usually transient.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+from horovod_tpu import flight_recorder
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils.env import _get_bool, _get_int
+
+HOROVOD_ROLLBACK_BUDGET = "HOROVOD_ROLLBACK_BUDGET"
+HOROVOD_INTEGRITY_QUARANTINE = "HOROVOD_INTEGRITY_QUARANTINE"
+DEFAULT_ROLLBACK_BUDGET = 2
+
+_ROLLBACKS = _metrics().counter(
+    "horovod_integrity_rollbacks_total",
+    "In-place rollback-and-replay recoveries from integrity failures.")
+
+_replays = 0  # guarded-by: <owner-thread>
+
+
+def replays() -> int:
+    return _replays
+
+
+def reset() -> None:
+    """Forget the replay count (tests)."""
+    global _replays
+    _replays = 0
+
+
+def should_quarantine(exc: Exception) -> bool:
+    """Whether this process is the digest vote's suspect and quarantine
+    is armed."""
+    if not _get_bool(HOROVOD_INTEGRITY_QUARANTINE):
+        return False
+    suspect = getattr(exc, "suspect_rank", None)
+    if suspect is None:
+        return False
+    from horovod_tpu.elastic import fault_inject
+
+    return suspect == fault_inject.initial_rank()
+
+
+def quarantine_self(exc: Exception) -> None:
+    """Leave the job so the elastic reform excludes this rank. Exits
+    the process (the PR-2 path treats it like a worker loss)."""
+    log.error("integrity quarantine: this rank (%s) was voted the "
+              "corruption source — exiting so the job re-forms without "
+              "it (%s)", getattr(exc, "suspect_rank", "?"), exc)
+    flight_recorder.emit("integrity_quarantine",
+                         suspect=getattr(exc, "suspect_rank", None),
+                         error=str(exc)[:200])
+    flight_recorder.dump_on_failure("integrity_quarantine")
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(1)
+
+
+def handle_failure(state, exc: Exception) -> Optional[int]:
+    """Restore the last committed state in place and account the replay.
+
+    Called by the elastic runner's ``NumericalError`` clause on every
+    rank (all ranks raise the identical verdict together). Re-raises
+    ``exc`` when the rollback budget is exhausted so the supervised
+    restart (PR 9) takes over. Returns the restored step when a
+    checkpoint cut was reloaded, else None (memory-snapshot restore).
+    """
+    global _replays
+    if should_quarantine(exc):
+        quarantine_self(exc)  # does not return
+    budget = _get_int(HOROVOD_ROLLBACK_BUDGET, DEFAULT_ROLLBACK_BUDGET)
+    if _replays >= budget:
+        log.error("integrity rollback budget exhausted (%d/%d) — "
+                  "escalating to supervised restart", _replays, budget)
+        flight_recorder.emit("rollback_budget_exhausted",
+                             replays=_replays, budget=budget,
+                             error=str(exc)[:200])
+        flight_recorder.dump_on_failure("rollback_budget_exhausted")
+        raise exc
+    _replays += 1
+    _ROLLBACKS.inc()
+    restored_step = None
+    # prefer the durable PR-9 cut (bit-identical, survives a poisoned
+    # in-memory snapshot); fall back to the commit-time memory snapshot
+    if getattr(state, "_ckpt_dir", None):
+        wait = getattr(state, "checkpoint_wait", None)
+        if wait is not None:
+            wait()  # an in-flight async commit must land before restore
+        restored_step = state.load_latest()
+    if restored_step is None:
+        state.on_reset()
+        restored_step = getattr(state, "step", None)
+    log.warning("integrity rollback %d/%d: restored step %s after %s: %s",
+                _replays, budget, restored_step, type(exc).__name__, exc)
+    flight_recorder.emit("rollback", replay=_replays, budget=budget,
+                         restored_step=restored_step,
+                         suspect=getattr(exc, "suspect_rank", None),
+                         error="%s: %s" % (type(exc).__name__,
+                                           str(exc)[:200]))
+    return restored_step

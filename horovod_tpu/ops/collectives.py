@@ -498,6 +498,27 @@ def _reducescatter_stacked_fn(mesh, op: int, world: int):
     return _cached(("rs_stacked", mesh, op, world), build)
 
 
+def _integrity_check_stacked(x, name: str) -> None:
+    """Eager worker-stacked payload digest: per-row non-finite counts
+    name the contributing worker (row == rank) BEFORE the reduction
+    collapses attribution. Tiny jnp ops cached by shape in jax's own
+    executable cache; gated to every HOROVOD_INTEGRITY_INTERVAL calls
+    per lane, no-op when HOROVOD_INTEGRITY is off."""
+    from horovod_tpu.integrity import digest as integ_digest
+
+    if np.dtype(x.dtype).kind not in ("f", "V"):  # V: ml_dtypes bf16
+        return
+    if not integ_digest.cadence_due(f"eager.{name}"):
+        return
+    counts = np.asarray(jnp.sum(
+        ~jnp.isfinite(jnp.reshape(x, (x.shape[0], -1))), axis=1,
+        dtype=jnp.int32))
+    bad = np.nonzero(counts)[0]
+    integ_digest.verify_local(
+        int(counts.sum()), bucket="eager", tensor=name,
+        suspect_rank=int(bad[0]) if bad.size else None)
+
+
 # ---------------------------------------------------------------------------
 # Public collectives
 # ---------------------------------------------------------------------------
@@ -568,6 +589,7 @@ def allreduce(
     st = basics._ensure_init()
     x = _to_plane(tensor_c)
     if _is_worker_stacked(x):
+        _integrity_check_stacked(x, name or "allreduce")
         if (st.config.hierarchical_allreduce
                 and _hierarchical_enabled(st, red_op)):
             out = _hierarchical_reduce_stacked_fn(st.mesh, red_op)(x)

@@ -186,17 +186,28 @@ def DistributedOptimizer(
         # is a step boundary, and the inner update is the optimizer phase.
         # Inside jit/shard_map everything is a tracer — the whole step is
         # one program and the profiler attributes it as compute.
+        from horovod_tpu import integrity as _integrity
         from horovod_tpu import profiler as _profiler
 
-        eager = _profiler.enabled() and not any(
-            isinstance(g, jax.core.Tracer)
-            for g in jax.tree_util.tree_leaves(grads))
+        traced = any(isinstance(g, jax.core.Tracer)
+                     for g in jax.tree_util.tree_leaves(grads))
+        eager = _profiler.enabled() and not traced
         if eager:
             _profiler.auto_step()
         reduced = allreduce_gradients(
             grads, average=average, compression=compression,
             axis_name=axis_name, sparse_as_dense=sparse_as_dense,
         )
+        if _integrity.enabled() and not traced:
+            from horovod_tpu.integrity import guards as _guards
+
+            # the guard observes the globally-reduced grad norm, so every
+            # rank sees the same stream and skips the same steps; a skip
+            # suppresses the update (zero deltas, state untouched) while
+            # the batch stays consumed
+            if not _guards.guard_gradients(reduced):
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, reduced)
+                return zeros, opt_state
         if eager:
             with _profiler.annotate("optimizer"):
                 return optimizer.update(reduced, opt_state, params, **extra)

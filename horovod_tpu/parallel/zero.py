@@ -549,6 +549,42 @@ def sharded_update(optimizer, *, average: bool = True,
             _unpack_group(full, g, out)
         return tuple(out), ShardedOptState(spec, new_inner)
 
+    def _integrity_check_leaves(leaves, st, mode):
+        """Single-controller digest over the eager gradient leaves (the
+        multi-process path is covered in band by the runtime's
+        reduce-scatter digest instead — a caller-thread check there
+        could diverge across ranks). Worker-stacked leaves attribute
+        the non-finite row to its rank."""
+        from horovod_tpu.integrity import digest as integ_digest
+
+        if collectives._multiprocess_world(st):
+            return
+        if not integ_digest.cadence_due("zero.update"):
+            return
+        total = 0
+        suspect = None
+        bad_leaf = None
+        for i, leaf in enumerate(leaves):
+            if np.dtype(leaf.dtype).kind not in ("f", "V"):
+                continue
+            if mode == "stacked":
+                counts = np.asarray(jnp.sum(
+                    ~jnp.isfinite(jnp.reshape(leaf, (leaf.shape[0], -1))),
+                    axis=1, dtype=jnp.int32))
+                bad = np.nonzero(counts)[0]
+                if bad.size and suspect is None:
+                    suspect = int(bad[0])
+                n = int(counts.sum())
+            else:
+                n = int(jnp.sum(~jnp.isfinite(leaf)))
+            if n and bad_leaf is None:
+                bad_leaf = i
+            total += n
+        integ_digest.verify_local(
+            total, bucket="zero.grads",
+            tensor=None if bad_leaf is None else f"leaf[{bad_leaf}]",
+            suspect_rank=suspect)
+
     def update_fn(grads, state, params=None, **extra):
         if not isinstance(state, ShardedOptState):
             raise TypeError(
@@ -583,6 +619,7 @@ def sharded_update(optimizer, *, average: bool = True,
                 f"current world is {st.size}; re-init (elastic re-forms "
                 "go through elastic.ArrayState.sync / zero.resync)")
         mode = _mode(leaves, st)
+        _integrity_check_leaves(leaves, st, mode)
         t0 = time.monotonic()
         if mode == "local":
             out, new_state = _update_multiprocess(leaves, state, pleaves,

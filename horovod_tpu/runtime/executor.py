@@ -237,7 +237,15 @@ class _PendingOp:
     def fail_exc(self, exc: Exception) -> None:
         from horovod_tpu import exceptions
 
-        if (isinstance(exc, exceptions.WorkersDownError)
+        if (isinstance(exc, exceptions.NumericalError)
+                and self.executor.integrity_failure is None):
+            # a typed integrity verdict must reach the waiting caller
+            # WITHOUT marking the runtime as down: the runtime survives
+            # the rollback-and-replay, so this never touches
+            # executor.failure (which the cycle body lifts into a
+            # runtime shutdown). RuntimeHandle.wait lifts and clears it.
+            self.executor.integrity_failure = exc
+        elif (isinstance(exc, exceptions.WorkersDownError)
                 and self.executor.failure is None):
             # a data-plane transport loss is a workers-down event even
             # though this cycle completes "normally" (entries failed by
@@ -287,6 +295,14 @@ class Executor:
         # typed workers-down verdict from a data-plane failure (see
         # _PendingOp.fail_exc); lifted by the runtime's cycle body
         self.failure = None
+        # typed integrity verdict (NumericalError family) from a digest
+        # check; lifted AND CLEARED by RuntimeHandle.wait so the runtime
+        # itself survives the rollback-and-replay
+        self.integrity_failure = None  # guarded-by: <cycle-thread>
+        # eligible fused-allreduce dispatches seen, for the digest
+        # cadence; deterministic across ranks (dispatch order is
+        # negotiated)
+        self._integrity_dispatches = 0  # guarded-by: <cycle-thread>
         # persistent host staging (reference: FusionBufferManager) + the
         # size-bucket policy keying the program caches
         quantum = None
@@ -368,6 +384,46 @@ class Executor:
                 return reducer(buf, axis=0)
 
         fn = jax.jit(reduce_buf, out_shardings=self._replicated())
+        with self._lock:
+            self._programs[key] = fn
+        return fn
+
+    def _integrity_due(self) -> bool:
+        """Advance the digest cadence by one eligible dispatch; True on
+        the first and every HOROVOD_INTEGRITY_INTERVAL-th. Called at the
+        same negotiated dispatch on every rank, so the decision (and the
+        in-band exchange it triggers) stays lockstep."""
+        from horovod_tpu import integrity
+
+        if not integrity.enabled():
+            return False
+        iv = integrity.interval()
+        if iv <= 0:
+            return False
+        n = self._integrity_dispatches
+        self._integrity_dispatches = n + 1
+        return n % iv == 0
+
+    def _digest_nonfinite_program(self, rows: int, capacity: int, dtype):
+        """Per-row non-finite count over the packed fusion buffer, in
+        band with the fused reduction. ``total`` is a traced scalar so
+        one program per (rows, bucket, dtype) serves every payload size
+        in the bucket; the mask keeps the reduction-identity padding
+        (±inf for min/max) from counting as corruption."""
+        key = ("digest_nf", rows, capacity, str(dtype))
+        with self._lock:
+            fn = self._programs.get(key)
+            if fn is not None:
+                _PROGRAM_CACHE_HITS.inc()
+                return fn
+        _PROGRAM_COMPILES.inc()
+
+        def count_nonfinite(buf, total):
+            mask = jnp.arange(capacity)[None, :] < total
+            bad = jnp.logical_and(mask, ~jnp.isfinite(buf))
+            return jnp.sum(bad, axis=1, dtype=jnp.int32)
+
+        fn = jax.jit(count_nonfinite, out_shardings=self._replicated())
         with self._lock:
             self._programs[key] = fn
         return fn
@@ -585,6 +641,22 @@ class Executor:
                                   reduce_identity(dtype, reduce_op), dtype))
             _PAD_BYTES.inc((capacity - total) * rows * dtype.itemsize)
         buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        from horovod_tpu.integrity import digest as integ_digest
+        from horovod_tpu.integrity import inject as integ_inject
+
+        is_float = dtype.kind in ("f", "V")  # V: ml_dtypes bf16
+        plan = integ_inject.plan_dispatch_any()
+        if plan is not None and plan[0] == "nan" and is_float:
+            # one process owns every worker's row here, so the clause
+            # rank selects the ROW to poison (bitflip is a no-op on this
+            # path: a single replicated result has no copy to diverge)
+            row = min(max(plan[1], 0), rows - 1)
+            buf = buf.at[row, 0].set(jnp.nan)
+        nf_dev = None
+        if is_float and self._integrity_due():
+            digest_fn = self._digest_nonfinite_program(rows, capacity,
+                                                       dtype)
+            nf_dev = digest_fn(buf, np.int32(total))
         if timeline is not None:
             timeline.activity_end(name0)
             timeline.activity_start(name0, timeline_mod.XLA_COLLECTIVE)
@@ -601,6 +673,13 @@ class Executor:
             # at the drain, but keep results resident as replicated
             # jax.Arrays (callers rely on device residency/sharding)
             jax.block_until_ready(out_dev)
+            if nf_dev is not None:
+                counts = np.asarray(nf_dev)
+                bad = np.nonzero(counts)[0]
+                integ_digest.verify_local(
+                    int(counts.sum()), bucket=f"fused[{capacity}]",
+                    tensor=name0,
+                    suspect_rank=int(bad[0]) if bad.size else None)
             if timeline is not None:
                 timeline.activity_end(name0)
                 timeline.activity_start(
@@ -640,6 +719,16 @@ class Executor:
             for w in wire:
                 np.copyto(buf[off:off + w.size], w.ravel())
                 off += w.size
+            from horovod_tpu.integrity import digest as integ_digest
+            from horovod_tpu.integrity import inject as integ_inject
+
+            plan = integ_inject.plan_dispatch()
+            if plan == "nan" and buf.dtype.kind == "f":
+                # poison this rank's INPUT before the ring pass — the
+                # NaN spreads to every replica through the reduction
+                integ_inject.corrupt_nan(buf)
+            check = self._integrity_due()
+            nf_in = integ_digest.nonfinite_count(buf) if check else 0
             if timeline is not None:
                 timeline.activity_end(entries[0].name)
                 timeline.activity_start(entries[0].name,
@@ -650,6 +739,21 @@ class Executor:
                 timeline.activity_end(entries[0].name)
             if reduce_op == types.REDUCE_AVERAGE:
                 buf = buf / world  # new array; slab is released unscaled
+            if plan == "bitflip":
+                # SDC on this rank's LOCAL copy of the reduced result:
+                # the other ranks hold the correct bytes, so only the
+                # cross-rank checksum vote can convict
+                if reduce_op != types.REDUCE_AVERAGE:
+                    buf = buf.copy()  # don't poison the reusable slab
+                integ_inject.corrupt_bitflip(buf)
+            if check:
+                # in-band agreement: one 12-byte record per rank over
+                # the same wire, same thread, same negotiated order as
+                # the payload — raises BEFORE any output is unpacked
+                records = integ_digest.exchange(
+                    self.net, nf_in, integ_digest.checksum(buf))
+                integ_digest.verify(records, bucket=f"ring[{total}]",
+                                    tensor=entries[0].name)
             off = 0
             for e, orig, w in zip(entries, arrays, wire):
                 n = w.size
@@ -713,6 +817,17 @@ class Executor:
             pend.lease = lease
             pend.bucket = lease.capacity
         flat = lease.array  # (1, bucket) — already the row layout
+        from horovod_tpu.integrity import digest as integ_digest
+        from horovod_tpu.integrity import inject as integ_inject
+
+        plan = integ_inject.plan_dispatch()
+        if plan == "nan" and flat.dtype.kind in ("f", "V"):
+            integ_inject.corrupt_nan(flat)  # pre-reduce input poisoning
+        check = self._integrity_due()
+        # input digest over the exact payload — the [total:] tail is
+        # reduction-identity padding (±inf for min/max), not corruption
+        nf_in = (integ_digest.nonfinite_count(flat.ravel()[:total])
+                 if check else 0)
         mesh = self._proc_mesh
         n_proc = mesh.devices.size
         row_sharding = NamedSharding(mesh, P("proc"))
@@ -730,6 +845,19 @@ class Executor:
 
         def finish():
             out = np.asarray(out_dev)  # D2H, blocks on the collective
+            if plan == "bitflip":
+                out = out.copy()  # np.asarray of a jax.Array is read-only
+                integ_inject.corrupt_bitflip(out)
+            if check:
+                # the drain runs on the cycle thread in dispatch order,
+                # so the agreement exchange is in band with (never racing)
+                # the ring's payload traffic; raises before unpack, and
+                # complete() routes it to executor.integrity_failure
+                records = integ_digest.exchange(
+                    self.net, nf_in, integ_digest.checksum(out[:total]))
+                integ_digest.verify(records,
+                                    bucket=f"spmd[{lease.capacity}]",
+                                    tensor=name0)
             if timeline is not None:
                 timeline.activity_end(name0)
                 timeline.activity_start(
@@ -789,6 +917,18 @@ class Executor:
         import numpy as np
 
         world = self.net.world
+        from horovod_tpu.integrity import digest as integ_digest
+
+        if self._integrity_due():
+            # pre-reduce input digest (the ZeRO sharded-gradient lane):
+            # each rank ends up holding a DIFFERENT shard, so there is
+            # no replicated result to checksum — the agreement exchange
+            # carries the non-finite counts only (constant CRC)
+            nf_in = sum(integ_digest.nonfinite_count(np.asarray(e.tensor))
+                        for e in entries)
+            records = integ_digest.exchange(self.net, nf_in, 0)
+            integ_digest.verify(records, bucket=f"rs[{len(entries)}]",
+                                tensor=entries[0].name)
         for e in entries:
             a = np.asarray(e.tensor)
             wire = _widen_for_ring(a, copy=True)  # consumed as scratch

@@ -100,6 +100,18 @@ class RuntimeHandle:
                 with rt._inflight_lock:
                     rt._waiters -= 1
         if not self._status.ok():
+            # integrity verdicts outrank transport failures and must NOT
+            # mark the runtime as down: lift-and-clear so the runtime
+            # survives the in-place rollback-and-replay (integrity/) and
+            # a later unrelated failure can't re-raise a stale verdict
+            integ = (getattr(rt.executor, "integrity_failure", None)
+                     if rt is not None else None)
+            if integ is not None:
+                rt.executor.integrity_failure = None
+                raise type(integ)(
+                    f"collective '{self.name}' failed integrity check: "
+                    f"{integ}", bucket=integ.bucket, tensor=integ.tensor,
+                    suspect_rank=integ.suspect_rank) from integ
             # typed propagation for the elastic layer: when the runtime
             # recorded a workers-down failure, surface it as the same
             # exception type (WorkersDownError subclasses RuntimeError, so

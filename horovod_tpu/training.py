@@ -164,7 +164,8 @@ def make_train_step(model, optimizer,
         out_shardings=(repl, repl, repl, repl),
         donate_argnums=(0, 1, 2) if donate else (),
     )
-    return _with_profiler_hook(step_fn), batch_sharding
+    return _with_integrity_guard(_with_profiler_hook(step_fn)), \
+        batch_sharding
 
 
 def make_train_round(model, optimizer,
@@ -201,7 +202,40 @@ def make_train_round(model, optimizer,
         out_shardings=(repl, repl, repl, repl),
         donate_argnums=(0, 1, 2) if donate else (),
     )
-    return _with_profiler_hook(round_jit), batch_sharding
+    return _with_integrity_guard(_with_profiler_hook(round_jit)), \
+        batch_sharding
+
+
+def _with_integrity_guard(step_fn):
+    """Watch the returned loss with the integrity spike guard
+    (integrity/guards.py) when HOROVOD_INTEGRITY is on. The step's
+    arguments are donated, so a flagged loss cannot un-apply the update
+    that produced it — the remedy at this level is the guard's budget
+    raise (``NumericalError`` after HOROVOD_INTEGRITY_SKIP_STEPS
+    consecutive spikes), which the elastic runner answers with
+    rollback-and-replay; the skip-step policy that *suppresses* updates
+    lives in ``DistributedOptimizer``. Disabled integrity returns the
+    callable untouched, like the profiler hook."""
+    from horovod_tpu import integrity
+
+    if not integrity.enabled():
+        return step_fn
+    from horovod_tpu.integrity import guards
+
+    guard = guards.StepGuard(name="loss")
+
+    def guarded(*args, **kwargs):
+        result = step_fn(*args, **kwargs)
+        loss = result[0] if isinstance(result, tuple) else result
+        try:
+            guard.observe(float(loss))
+        except TypeError:
+            pass  # non-scalar first output: nothing to observe
+        return result
+
+    guarded.__wrapped__ = step_fn
+    guarded.__integrity_guard__ = guard
+    return guarded
 
 
 def _with_profiler_hook(step_fn):

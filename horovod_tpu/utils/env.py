@@ -102,6 +102,10 @@ ENV_DIRECT_KNOBS = (
     # native/build/test switches
     "HOROVOD_NATIVE_CYCLE", "HOROVOD_TPU_WITHOUT_NATIVE",
     "HOROVOD_PALLAS_INTERPRET", "HOROVOD_FAULT_INJECT",
+    # numerical integrity plane (integrity/; docs/integrity.md)
+    "HOROVOD_INTEGRITY", "HOROVOD_INTEGRITY_INTERVAL",
+    "HOROVOD_INTEGRITY_SPIKE_SIGMA", "HOROVOD_INTEGRITY_SKIP_STEPS",
+    "HOROVOD_INTEGRITY_QUARANTINE", "HOROVOD_ROLLBACK_BUDGET",
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379

@@ -43,6 +43,26 @@ def test_collectives_suite_tiny(bench, capsys):
     assert json.loads(line)["metric"] == result["metric"]
 
 
+def test_integrity_suite_tiny(bench, capsys, monkeypatch):
+    """PR 10 acceptance shape: the --integrity microbench emits one JSON
+    line with the off/default/every-dispatch p50s and the zero-compile
+    canary; the env knobs it toggles are restored afterwards."""
+    monkeypatch.delenv("HOROVOD_INTEGRITY", raising=False)
+    result = bench.integrity_main(tiny=True)
+    assert result["tiny"] is True
+    assert result["unit"] == "%"
+    assert result["goal"] == "< 1%"
+    assert result["p50_ms_integrity_off"] > 0
+    assert result["p50_ms_default_interval"] > 0
+    assert result["p50_ms_every_dispatch"] > 0
+    # warmup compiled the digest program; the timed phases reuse it
+    assert result["steady_state_compiles"] == 0
+    assert result["digest_checks_timed_phase"] >= 1
+    assert os.environ.get("HOROVOD_INTEGRITY") is None
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["value"] == result["value"]
+
+
 def test_sharded_optimizer_tiny(bench, capsys):
     result = bench.sharded_optimizer_main(tiny=True)
     assert result["tiny"] is True

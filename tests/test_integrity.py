@@ -1,0 +1,426 @@
+"""Numerical integrity plane (ISSUE.md PR 10): digests, agreement vote,
+fault injection grammar, spike guard, and rollback accounting.
+
+The multiprocess halves (real digest exchange over the socket ring,
+in-place rollback with real checkpoints) live in
+tests/test_integrity_multiprocess.py and tools/chaos_matrix.py; this
+module covers the single-controller paths and the pure logic.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import exceptions
+from horovod_tpu.integrity import digest, guards, inject, rollback
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_integrity_state():
+    """Integrity state is process-global (cadence counters, one-shot
+    injection latches, the default guard, the replay budget) — every
+    test starts and ends clean."""
+    digest.reset()
+    inject.reset()
+    guards.reset()
+    rollback.reset()
+    yield
+    digest.reset()
+    inject.reset()
+    guards.reset()
+    rollback.reset()
+
+
+@pytest.fixture
+def integrity_on(monkeypatch):
+    monkeypatch.setenv("HOROVOD_INTEGRITY", "1")
+    monkeypatch.setenv("HOROVOD_INTEGRITY_INTERVAL", "1")
+
+
+# ---------------------------------------------------------------------------
+# digest primitives
+# ---------------------------------------------------------------------------
+
+class TestDigestPrimitives:
+    def test_nonfinite_count(self):
+        assert digest.nonfinite_count(np.zeros(4, np.float32)) == 0
+        assert digest.nonfinite_count(
+            np.array([1.0, np.nan, np.inf, -np.inf], np.float32)) == 3
+        # integers cannot go non-finite, by definition
+        assert digest.nonfinite_count(np.arange(8, dtype=np.int32)) == 0
+
+    def test_nonfinite_count_bf16(self):
+        x = jnp.array([1.0, 2.0, 3.0], jnp.bfloat16)
+        assert digest.nonfinite_count(np.asarray(x)) == 0
+        y = np.asarray(x).copy()
+        inject.corrupt_nan(y)
+        assert digest.nonfinite_count(y) == 1
+
+    def test_checksum_bitwise(self):
+        a = np.arange(16, dtype=np.float32)
+        assert digest.checksum(a) == digest.checksum(a.copy())
+        b = a.copy()
+        inject.corrupt_bitflip(b)
+        assert digest.checksum(b) != digest.checksum(a)
+        # -0.0 == 0.0 numerically but is a different byte pattern: the
+        # digest is an SDC detector, so it must see the difference
+        assert digest.checksum(np.array([0.0], np.float32)) != \
+            digest.checksum(np.array([-0.0], np.float32))
+
+    def test_vote(self):
+        assert digest.vote([7, 7, 7]) == (False, None)
+        assert digest.vote([7, 9, 7]) == (True, 1)
+        assert digest.vote([9, 7, 7, 7]) == (True, 0)
+        # a 1-vs-1 split cannot say who corrupted
+        assert digest.vote([7, 9]) == (True, None)
+        # nor can a multi-rank minority
+        assert digest.vote([7, 7, 9, 9, 7]) == (True, None)
+        # two distinct single-rank minorities: unattributable
+        assert digest.vote([7, 7, 9, 5]) == (True, None)
+
+    def test_verify_clean(self):
+        digest.verify([(0, 42), (0, 42), (0, 42)], bucket="b")
+
+    def test_verify_nonfinite_names_contributor(self):
+        with pytest.raises(exceptions.NumericalError) as ei:
+            digest.verify([(0, 1), (3, 1), (0, 1)], bucket="fused[8]",
+                          tensor="grad/w")
+        assert ei.value.suspect_rank == 1
+        assert ei.value.bucket == "fused[8]"
+        assert ei.value.tensor == "grad/w"
+
+    def test_verify_nonfinite_outranks_divergence(self):
+        # a NaN usually propagates to CRC *agreement*; when both signals
+        # fire, the input digest is the attribution that matters
+        with pytest.raises(exceptions.NumericalError) as ei:
+            digest.verify([(2, 1), (0, 9), (0, 9)], bucket="b")
+        assert not isinstance(ei.value, exceptions.CollectiveIntegrityError)
+        assert ei.value.suspect_rank == 0
+
+    def test_verify_divergence_votes_suspect(self):
+        with pytest.raises(exceptions.CollectiveIntegrityError) as ei:
+            digest.verify([(0, 7), (0, 7), (0, 9)], bucket="ring[12]")
+        assert ei.value.suspect_rank == 2
+
+    def test_verify_local(self):
+        digest.verify_local(0, bucket="b")
+        with pytest.raises(exceptions.NumericalError) as ei:
+            digest.verify_local(4, bucket="zero.grads", tensor="leaf[1]",
+                                suspect_rank=5)
+        assert ei.value.suspect_rank == 5
+
+    def test_cadence(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_INTEGRITY", "1")
+        monkeypatch.setenv("HOROVOD_INTEGRITY_INTERVAL", "3")
+        hits = [digest.cadence_due("lane") for _ in range(7)]
+        assert hits == [True, False, False, True, False, False, True]
+        # interval 0 disables; master switch off disables
+        monkeypatch.setenv("HOROVOD_INTEGRITY_INTERVAL", "0")
+        assert not digest.cadence_due("lane")
+        monkeypatch.setenv("HOROVOD_INTEGRITY_INTERVAL", "1")
+        monkeypatch.delenv("HOROVOD_INTEGRITY")
+        assert not digest.cadence_due("lane")
+
+
+# ---------------------------------------------------------------------------
+# fault-injection grammar
+# ---------------------------------------------------------------------------
+
+class TestInjectGrammar:
+    def test_parse(self):
+        spec = inject.parse_clause("bitflip:1")
+        assert (spec.action, spec.rank, spec.after) == ("bitflip", 1, 0)
+        spec = inject.parse_clause(" nan : 3 : after=5 ")
+        assert (spec.action, spec.rank, spec.after) == ("nan", 3, 5)
+        with pytest.raises(ValueError):
+            inject.parse_clause("bitflip")  # no rank
+        with pytest.raises(ValueError):
+            inject.parse_clause("nan:0:steps=2")  # unknown key
+        with pytest.raises(ValueError):
+            inject.parse_clause("melt:0")
+
+    def test_composes_with_process_fault_grammar(self, monkeypatch):
+        from horovod_tpu.elastic import fault_inject
+
+        monkeypatch.setenv(
+            "HOROVOD_FAULT_INJECT",
+            "kill:rank=1:step=3:code=17;bitflip:0:after=2")
+        inject.reset()
+        # each module sees only its own clauses
+        spec = fault_inject.spec_from_env()
+        assert spec is not None and spec.action == "kill"
+        specs = inject.specs_from_env()
+        assert len(specs) == 1 and specs[0].action == "bitflip"
+
+    def test_after_countdown_and_one_shot(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FAULT_INJECT", "nan:5:after=2")
+        inject.reset()
+        assert inject.plan_dispatch_any() is None
+        assert inject.plan_dispatch_any() is None
+        assert inject.plan_dispatch_any() == ("nan", 5)
+        assert inject.plan_dispatch_any() is None  # one-shot spent
+
+    def test_plan_dispatch_filters_by_launch_rank(self, monkeypatch):
+        from horovod_tpu.elastic import fault_inject
+
+        monkeypatch.setenv("HOROVOD_FAULT_INJECT", "bitflip:1")
+        monkeypatch.setattr(fault_inject, "_initial_rank", 0)
+        inject.reset()
+        assert inject.plan_dispatch() is None  # we are rank 0, target is 1
+        monkeypatch.setattr(fault_inject, "_initial_rank", 1)
+        inject.reset()
+        assert inject.plan_dispatch() == "bitflip"
+
+    def test_corruptors(self):
+        buf = np.ones(4, np.float32)
+        inject.corrupt_nan(buf)
+        assert np.isnan(buf[0]) and buf[1] == 1.0
+        buf = np.ones(4, np.float32)
+        before = buf.copy()
+        inject.corrupt_bitflip(buf)
+        assert not np.array_equal(buf.view(np.uint8), before.view(np.uint8))
+
+
+# ---------------------------------------------------------------------------
+# step guard
+# ---------------------------------------------------------------------------
+
+class TestStepGuard:
+    def test_warmup_accepts_everything_finite(self):
+        g = guards.StepGuard(sigma=3.0, skip_budget=2, warmup=5)
+        assert all(g.observe(v) for v in (100.0, 1.0, 50.0, 2.0, 80.0))
+
+    def test_nonfinite_skipped_even_during_warmup(self):
+        g = guards.StepGuard(skip_budget=5)
+        assert not g.observe(float("nan"))
+        assert not g.observe(float("inf"))
+        assert g.observe(1.0)
+        assert g.consecutive_skips == 0  # a clean step resets the streak
+
+    def test_constant_stream_never_trips(self):
+        g = guards.StepGuard(sigma=3.0, warmup=3)
+        assert all(g.observe(2.5) for _ in range(50))
+
+    def test_spike_skipped_and_drop_is_not_a_spike(self):
+        g = guards.StepGuard(sigma=3.0, skip_budget=10, warmup=5)
+        for v in (1.0, 1.1, 0.9, 1.0, 1.05, 0.95):
+            assert g.observe(v)
+        assert not g.observe(1e6)  # blow-up: skip
+        assert g.observe(1e-4)    # collapse toward zero: progress, accept
+
+    def test_skip_budget_exhaustion_raises(self):
+        g = guards.StepGuard(skip_budget=2, name="loss")
+        assert not g.observe(float("nan"))
+        assert not g.observe(float("nan"))
+        with pytest.raises(exceptions.NumericalError) as ei:
+            g.observe(float("nan"))
+        assert ei.value.tensor == "loss"
+
+    def test_skipped_metric_counted(self):
+        before = guards._SKIPPED.value
+        guards.StepGuard().observe(float("nan"))
+        assert guards._SKIPPED.value == before + 1
+
+    def test_guard_gradients_flags_bad_leaf(self):
+        assert guards.guard_gradients(
+            {"a": np.ones(3, np.float32), "b": np.zeros(2, np.float32)})
+        guards.reset()
+        assert not guards.guard_gradients(
+            {"a": np.ones(3, np.float32),
+             "b": np.array([1.0, np.nan], np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# rollback accounting
+# ---------------------------------------------------------------------------
+
+class _FakeState:
+    """Duck-typed elastic state: records which restore path ran. The
+    real ArrayState restore paths are exercised end-to-end in
+    tests/test_integrity_multiprocess.py."""
+
+    def __init__(self, ckpt_dir=""):
+        self._ckpt_dir = ckpt_dir
+        self.step = 7
+        self.waited = False
+        self.loaded = False
+        self.resets = 0
+
+    def checkpoint_wait(self):
+        self.waited = True
+
+    def load_latest(self):
+        self.loaded = True
+        self.step = 3
+        return 3
+
+    def on_reset(self):
+        self.resets += 1
+        self.step = 5
+
+
+class TestRollback:
+    def _exc(self, suspect=1):
+        return exceptions.CollectiveIntegrityError(
+            "boom", bucket="fused[8]", suspect_rank=suspect)
+
+    def test_prefers_checkpoint_cut(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("HOROVOD_ROLLBACK_BUDGET", "2")
+        st = _FakeState(ckpt_dir=str(tmp_path))
+        assert rollback.handle_failure(st, self._exc()) == 3
+        assert st.waited and st.loaded and st.resets == 0
+        assert st.step == 3
+        assert rollback.replays() == 1
+
+    def test_memory_snapshot_fallback(self):
+        st = _FakeState(ckpt_dir="")
+        assert rollback.handle_failure(st, self._exc()) == 5
+        assert st.resets == 1 and not st.loaded
+
+    def test_budget_exhaustion_reraises(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_ROLLBACK_BUDGET", "1")
+        st = _FakeState()
+        rollback.handle_failure(st, self._exc())
+        with pytest.raises(exceptions.CollectiveIntegrityError):
+            rollback.handle_failure(st, self._exc())
+        assert rollback.replays() == 1  # the refused replay is not counted
+
+    def test_quarantine_gating(self, monkeypatch):
+        from horovod_tpu.elastic import fault_inject
+
+        monkeypatch.setattr(fault_inject, "_initial_rank", 1)
+        assert not rollback.should_quarantine(self._exc(suspect=1))  # off
+        monkeypatch.setenv("HOROVOD_INTEGRITY_QUARANTINE", "1")
+        assert rollback.should_quarantine(self._exc(suspect=1))
+        assert not rollback.should_quarantine(self._exc(suspect=0))
+        assert not rollback.should_quarantine(self._exc(suspect=None))
+
+    def test_memory_rollback_restores_bit_identical(self, monkeypatch):
+        """ArrayState memory-snapshot path: after a poisoned update the
+        rollback restores the exact committed bytes."""
+        from horovod_tpu.elastic.state import ArrayState
+
+        golden = np.arange(4, dtype=np.float32) * 0.1
+        st = ArrayState(params={"w": golden.copy()}, optimizer=None, step=3)
+        st.params["w"] = st.params["w"] * np.float32(np.nan)
+        st.step = 9
+        assert rollback.handle_failure(st, self._exc()) == 3
+        assert st.step == 3
+        np.testing.assert_array_equal(np.asarray(st.params["w"]), golden)
+
+
+# ---------------------------------------------------------------------------
+# data-plane digests (single-controller, 8 virtual devices)
+# ---------------------------------------------------------------------------
+
+class TestDataPlaneDigests:
+    @pytest.mark.parametrize("op", ["sum", "avg", "min", "max"])
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int32"])
+    def test_fused_digest_agrees_on_clean_payloads(
+            self, hvd, integrity_on, op, dtype):
+        """Every reduce op × dtype passes the in-band digest with no
+        false positive — in particular min/max, whose fused-bucket
+        padding is the ±inf reduce identity and must be masked out of
+        the non-finite count."""
+        red = {"sum": hvd.Sum, "avg": hvd.Average,
+               "min": hvd.Min, "max": hvd.Max}[op]
+        reducer = {"sum": np.sum, "avg": np.mean,
+                   "min": np.min, "max": np.max}[op]
+        vals = [np.full((5,), i + 1).astype(dtype)
+                for i in range(hvd.size())]
+        h = hvd.allreduce_async(hvd.stack_per_worker(vals),
+                                name=f"dig/{op}/{dtype}", op=red)
+        out = np.asarray(hvd.synchronize(h)).astype(np.float64)
+        np.testing.assert_allclose(
+            out, reducer(np.stack(vals).astype(np.float64), axis=0),
+            rtol=1e-2 if dtype == "bfloat16" else 1e-6)
+
+    def test_fused_digest_deterministic_bf16(self, hvd, integrity_on):
+        """Same bf16 payload twice → bit-identical reduced bytes, so
+        identical digests on every replica (the agreement vote relies on
+        reduction-order determinism)."""
+        rng = np.random.RandomState(7)
+        vals = [jnp.asarray(rng.randn(33).astype(np.float32), jnp.bfloat16)
+                for _ in range(hvd.size())]
+        outs = []
+        for trial in range(2):
+            h = hvd.allreduce_async(hvd.stack_per_worker(vals),
+                                    name=f"det/bf16/{trial}", op=hvd.Sum)
+            outs.append(np.asarray(hvd.synchronize(h)).copy())
+        assert digest.checksum(outs[0]) == digest.checksum(outs[1])
+
+    def test_fused_nan_injection_names_row(self, hvd, integrity_on,
+                                           monkeypatch):
+        """The executor pack-path injection fires after one clean
+        dispatch; the on-device digest names the poisoned row and the
+        runtime survives the verdict."""
+        monkeypatch.setenv("HOROVOD_FAULT_INJECT", "nan:5:after=1")
+        inject.reset()
+
+        def reduce_once(tag):
+            h = hvd.allreduce_async(
+                hvd.stack_per_worker(
+                    [np.full((4,), float(i), np.float32)
+                     for i in range(hvd.size())]),
+                name=f"inj/{tag}")
+            return hvd.synchronize(h)
+
+        reduce_once("warm")  # countdown: not fired yet
+        with pytest.raises(exceptions.NumericalError) as ei:
+            reduce_once("hit")
+        assert ei.value.suspect_rank == 5
+        assert "fused" in (ei.value.bucket or "")
+        # the failure was surfaced to the caller, not the cycle loop:
+        # the next collective must succeed
+        out = np.asarray(reduce_once("after"))
+        np.testing.assert_allclose(
+            out, np.full((4,), np.mean(np.arange(hvd.size()))))
+
+    def test_eager_stacked_nan_names_rank(self, hvd, integrity_on):
+        vals = [np.full((3,), 1.0, np.float32) for _ in range(hvd.size())]
+        vals[3][1] = np.nan
+        with pytest.raises(exceptions.NumericalError) as ei:
+            hvd.allreduce(hvd.stack_per_worker(vals), name="g0")
+        assert ei.value.suspect_rank == 3
+        assert ei.value.tensor == "g0"
+
+    def test_zero_sharded_digest_flags_nan_grad(self, hvd, integrity_on):
+        import optax
+
+        params = {"w": np.ones(16, np.float32)}
+        sh = hvd.sharded_update(optax.sgd(0.1))
+        state = sh.init(params)
+        grads = {"w": np.ones(16, np.float32)}
+        upd, state = sh.update(grads, state, params)  # cadence hit, clean
+        digest.reset()
+        grads["w"][2] = np.nan
+        with pytest.raises(exceptions.NumericalError) as ei:
+            sh.update(grads, state, params)
+        assert ei.value.bucket == "zero.grads"
+
+    def test_disabled_by_default(self, hvd, monkeypatch):
+        """HOROVOD_INTEGRITY off: a NaN flows through unchecked (the
+        pre-PR-10 behavior is the default)."""
+        monkeypatch.delenv("HOROVOD_INTEGRITY", raising=False)
+        vals = [np.full((3,), 1.0, np.float32) for _ in range(hvd.size())]
+        vals[0][0] = np.nan
+        out = np.asarray(hvd.allreduce(hvd.stack_per_worker(vals),
+                                       name="off"))
+        assert np.isnan(out[0])
+
+
+# ---------------------------------------------------------------------------
+# metrics presence
+# ---------------------------------------------------------------------------
+
+def test_metric_families_registered():
+    from horovod_tpu.metrics import registry
+
+    snap = registry().snapshot()
+    for fam in ("horovod_integrity_checks_total",
+                "horovod_integrity_violations_total",
+                "horovod_integrity_rollbacks_total",
+                "horovod_integrity_skipped_steps_total"):
+        assert fam in snap, sorted(snap)

@@ -38,6 +38,21 @@ Checkpoint crash-consistency scenarios (ISSUE 9; docs/checkpointing.md):
   fp32 moment segments are restored from its left neighbor's replica
   (nonzero, uniform across shards), not zero-filled.
 
+Numerical-integrity scenarios (ISSUE 10; docs/integrity.md):
+
+* ``integrity_bitflip_rollback`` — a one-shot bit flip corrupts rank 1's
+  copy of the 5th allreduce result: the per-dispatch digest exchange
+  detects the CRC divergence, the cross-rank vote names rank 1, every
+  rank rolls back IN PLACE (no process restart, no re-form) to the
+  step-4 checkpoint and replays — training finishes with ``w == step``
+  bit-identical to an uninjected run, and the merged postmortem names
+  the flipped rank.
+* ``integrity_nan_skipstep`` — a one-shot NaN poisons rank 1's
+  contribution to the 5th allreduce with digests disabled, so the NaN
+  reaches every rank's reduced gradient: the step-level spike guard
+  skips that step in lockstep (one retry, nothing applied or
+  committed) and training converges to the exact final weights.
+
 Usage: python tools/chaos_matrix.py [--only NAME] [--json PATH]
 """
 
@@ -150,6 +165,36 @@ SCENARIOS = {
                          "moments_uniform", "replica_restored"],
         "ckpt_verify": "manifest",
         "timeout": 240,
+    },
+    "integrity_bitflip_rollback": {
+        "world": 3,
+        "ckpt": True,
+        "env": {
+            "HOROVOD_FAULT_INJECT": "bitflip:1:after=4",
+            "HOROVOD_INTEGRITY": "1",
+            "HOROVOD_INTEGRITY_INTERVAL": "1",
+            "HOROVOD_CKPT_ASYNC": "0",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "3",
+        },
+        "require_true": ["integrity_violations", "rollbacks"],
+        "require_culprit": 1,
+        "ckpt_verify": "manifest",
+        "timeout": 240,
+    },
+    "integrity_nan_skipstep": {
+        "world": 2,
+        "env": {
+            "HOROVOD_FAULT_INJECT": "nan:1:after=4",
+            "HOROVOD_INTEGRITY": "1",
+            # digests off: the nan flows through the ring to every rank
+            # and the step-level guard (not the collective plane) must
+            # catch it
+            "HOROVOD_INTEGRITY_INTERVAL": "0",
+            "CHAOS_INTEGRITY_GUARD": "1",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        },
+        "require_true": ["skipped_steps"],
+        "timeout": 180,
     },
 }
 

@@ -28,6 +28,16 @@ from horovod_tpu import elastic, flight_recorder
 
 TOTAL_STEPS = int(os.environ.get("CHAOS_TOTAL_STEPS", "8"))
 STEP_SLEEP = float(os.environ.get("CHAOS_STEP_SLEEP", "0"))
+# integrity skip-step mode: watch the reduced "gradient" with the spike
+# guard and retry a flagged step without applying or committing it —
+# the nan chaos scenario proves a poisoned batch costs one retried step,
+# not a corrupted w (guard lives outside train: replays must not reset
+# its EWMA statistics)
+GUARD = None
+if os.environ.get("CHAOS_INTEGRITY_GUARD") == "1":
+    from horovod_tpu.integrity import guards as _guards
+
+    GUARD = _guards.StepGuard(name="chaos_grad")
 
 
 @elastic.run
@@ -35,6 +45,9 @@ def train(state):
     while state.step < TOTAL_STEPS:
         grad = hvd.allreduce(np.ones(4, np.float32), average=True,
                              name="chaos_grad")
+        if GUARD is not None and not GUARD.observe(
+                float(np.asarray(grad)[0])):
+            continue  # skip: every rank saw the same reduced value
         state.params["w"] = state.params["w"] + np.asarray(grad)
         state.step += 1
         state.commit()
@@ -69,6 +82,14 @@ def main() -> int:
             snap, "horovod_net_gave_up_total"),
         "chaos_injected_total": _metric_total(
             snap, "horovod_net_chaos_injected_total"),
+        "integrity_checks": _metric_total(
+            snap, "horovod_integrity_checks_total"),
+        "integrity_violations": _metric_total(
+            snap, "horovod_integrity_violations_total"),
+        "rollbacks": _metric_total(
+            snap, "horovod_integrity_rollbacks_total"),
+        "skipped_steps": _metric_total(
+            snap, "horovod_integrity_skipped_steps_total"),
     }
     try:  # the postmortem needs post-reform events (elastic_reform)
         flight_recorder.dump_debug_state(reason="chaos_run_complete")
