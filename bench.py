@@ -1092,6 +1092,107 @@ def checkpoint_main(tiny: bool = False):
     return result
 
 
+def serve_main(tiny: bool = False):
+    """``--serve``: load-generate Poisson traffic against an in-process
+    continuous-batching replica set (serve/; docs/inference.md) and
+    report the serving headline — p50/p99 request latency, tokens/s/chip
+    and batch occupancy — plus the zero-steady-state-compiles canary:
+    after one warmup prefill per prompt-length bucket per replica, the
+    measured window must compile NOTHING (the fixed-shape decode program
+    and the bucketed prefill programs are already hot).
+
+    ``--tiny`` shrinks to a toy model + 16 requests for the tier-1 smoke
+    (tests/test_bench_smoke.py); numbers are then meaningless."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import GPT2Small, Transformer
+    from horovod_tpu.serve import prompt_bucket, serve as hvd_serve
+
+    if tiny:
+        model = Transformer(vocab_size=128, d_model=32, num_layers=2,
+                            num_heads=2, d_ff=64, max_seq=96, causal=True,
+                            dtype=jnp.float32)
+        replicas, slots, n_requests = 2, 4, 16
+        rate_rps, max_new = 400.0, 8
+        prompt_choices = (4, 9, 17, 33)
+    else:
+        # "GPT-small" replica set: the GPT-2 shape at a serving-friendly
+        # context length
+        model = GPT2Small(vocab_size=50304, max_seq=512)
+        replicas, slots, n_requests = 2, 8, 200
+        rate_rps, max_new = 40.0, 32
+        prompt_choices = (24, 56, 100, 180, 250)
+
+    log(f"serve: initializing {replicas} replica(s) "
+        f"(slots={slots}, max_new={max_new})")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    handle = hvd_serve(model, params, replicas=replicas, slots=slots,
+                       max_new_tokens=max_new, admission_ms=25.0,
+                       decode_block=4, max_batch_tokens=4096)
+    try:
+        # warmup: hit every prompt-length bucket on EVERY replica's own
+        # program cache (replicas compile independently), plus one
+        # decode step each — all while the queue is idle, so the replica
+        # threads never race these direct engine calls
+        buckets = sorted({prompt_bucket(p, model.max_seq)
+                          for p in prompt_choices})
+        for replica in handle._replicas:
+            for b in buckets:
+                replica.engine.prefill(0, [1] * b)
+            replica.engine.decode([0], [1], [0])
+        warm_compiles = handle.compiles_total()
+        warm_steps = sum(r.engine.decode_steps for r in handle._replicas)
+        log(f"serve: warm ({warm_compiles} compiles across "
+            f"{len(buckets)} buckets x {replicas} replicas)")
+
+        rng = np.random.RandomState(0)
+        uids = []
+        t0 = time.perf_counter()
+        for _ in range(n_requests):
+            time.sleep(rng.exponential(1.0 / rate_rps))
+            prompt_len = int(rng.choice(prompt_choices))
+            prompt = rng.randint(1, model.vocab_size,
+                                 prompt_len).tolist()
+            uids.append(handle.submit(prompt))
+        outs = [handle.result(u, timeout=300.0) for u in uids]
+        elapsed = time.perf_counter() - t0
+
+        latencies_ms = sorted(o.latency_s * 1000.0 for o in outs)
+        ttft_ms = sorted(o.ttft_s * 1000.0 for o in outs)
+        decode_tokens = sum(len(o.tokens) for o in outs)
+        steps = (sum(r.engine.decode_steps for r in handle._replicas)
+                 - warm_steps)
+        occ = sum(r.occupancy_sum for r in handle._replicas)
+        steady_compiles = handle.compiles_total() - warm_compiles
+        result = {
+            "bench": "serve",
+            "metric": "serving decode throughput (Poisson load, "
+                      "continuous batching)",
+            "value": round(decode_tokens / elapsed / replicas, 2),
+            "unit": "tokens/sec/chip",
+            "replicas": replicas,
+            "requests": n_requests,
+            "offered_rps": rate_rps,
+            "p50_latency_ms": round(
+                float(np.percentile(latencies_ms, 50)), 3),
+            "p99_latency_ms": round(
+                float(np.percentile(latencies_ms, 99)), 3),
+            "p50_ttft_ms": round(float(np.percentile(ttft_ms, 50)), 3),
+            "p99_ttft_ms": round(float(np.percentile(ttft_ms, 99)), 3),
+            "avg_batch_occupancy": round(occ / max(steps, 1), 3),
+            "steady_state_compiles": steady_compiles,
+            "warmup_compiles": warm_compiles,
+            "served_by": sorted({o.rank for o in outs}),
+            "tiny": tiny,
+        }
+    finally:
+        handle.close()
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def tiny_main():
     """Bare ``--tiny``: a toy flagship headline through the REAL measured
     path — DistributedOptimizer + make_train_round + the step profiler —
@@ -1189,10 +1290,17 @@ if __name__ == "__main__":
                              "async commit inline/e2e latency, bytes/rank "
                              "and the derived steady-state step overhead "
                              "at the BERT-Large shape (one JSON line)")
+    parser.add_argument("--serve", action="store_true",
+                        help="benchmark the online serving plane: Poisson "
+                             "arrivals against a GPT-small continuous-"
+                             "batching replica set — p50/p99 latency, "
+                             "tokens/s/chip, batch occupancy and the "
+                             "zero-steady-state-compiles canary (one "
+                             "JSON line)")
     parser.add_argument("--tiny", action="store_true",
                         help="toy sizes + a couple of steps for "
                              "--collectives/--sharded-optimizer/"
-                             "--checkpoint, or (with "
+                             "--checkpoint/--serve, or (with "
                              "no workload flag) a toy flagship headline "
                              "with step_breakdown/comm_hidden_fraction — "
                              "the tier-1 smoke-test mode; numbers are "
@@ -1203,7 +1311,9 @@ if __name__ == "__main__":
                              "(loudly) once it would be exceeded "
                              "(default: BENCH_TIME_BUDGET env, 660)")
     cli = parser.parse_args()
-    if cli.collectives:
+    if cli.serve:
+        serve_main(tiny=cli.tiny)
+    elif cli.collectives:
         collectives_main(tiny=cli.tiny)
     elif cli.integrity:
         integrity_main(tiny=cli.tiny)

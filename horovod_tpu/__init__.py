@@ -127,6 +127,9 @@ from horovod_tpu import ckpt
 from horovod_tpu import data
 from horovod_tpu import elastic
 from horovod_tpu import integrity
+# `hvd.serve(model, params, ...)` is the API; the module stays reachable
+# as `horovod_tpu.serve` via sys.modules for internal imports.
+from horovod_tpu.serve import ServePolicy, serve
 from horovod_tpu.exceptions import (
     CheckpointCorruptError,
     CollectiveIntegrityError,
@@ -183,4 +186,6 @@ __all__ = [
     "WorkersDownError", "WorkerLostError", "WorkerStallError",
     # numerical integrity plane (digests / guards / rollback-and-replay)
     "integrity", "NumericalError", "CollectiveIntegrityError",
+    # online serving plane (continuous batching; docs/inference.md)
+    "serve", "ServePolicy",
 ]

@@ -297,6 +297,19 @@ class MetricsRegistry:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif route == "/serve":
+                    # serving-plane status: live replica sets, queue
+                    # depths, program-cache warmth (serve.serve_state)
+                    from horovod_tpu.serve import api as serve_api
+
+                    body = json.dumps(
+                        serve_api.serve_state(),
+                        default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif route == "/profile":
                     # step-profiler state: the last N per-step phase
                     # breakdowns + summary (rate-limited snapshot, see

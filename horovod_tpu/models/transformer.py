@@ -29,9 +29,39 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from horovod_tpu.ops.pallas.flash_attention import flash_attention
+from horovod_tpu.ops.pallas.flash_attention import NEG_INF, flash_attention
 
 Dtype = Any
+
+
+def cached_attention(q, k, v, q_positions):
+    """Masked attention against an absolute-position KV cache.
+
+    ``q``: (batch, heads, new, head_dim) — the new tokens' queries;
+    ``k``/``v``: (batch, heads, cache_len, head_dim) — the FULL per-slot
+    cache, freshly-written rows and stale/zero rows alike;
+    ``q_positions``: (batch, new) int32 absolute position of each query.
+
+    The mask ``key_pos <= q_pos`` is what makes the cache safe to reuse
+    without per-slot length bookkeeping: a key row is attendable only
+    once some query's absolute position has reached it, and by then it
+    was written either by this request's prefill or by an earlier decode
+    step of this request — stale rows from a previous slot occupant sit
+    at positions the current request has not reached, padded prefill
+    rows are overwritten by decode before a query passes them.
+
+    Plain XLA einsum + f32 softmax (the shapes are decode-sized: one or
+    a few queries against ``max_seq`` keys — no flash-kernel tiling to
+    win, and it must run everywhere, CPU tests included).
+    """
+    head_dim = q.shape[-1]
+    scale = 1.0 / float(np.sqrt(head_dim))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    key_ids = jnp.arange(k.shape[2], dtype=jnp.int32)
+    mask = key_ids[None, None, None, :] <= q_positions[:, None, :, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
 class SelfAttention(nn.Module):
@@ -40,6 +70,13 @@ class SelfAttention(nn.Module):
     ``attention_fn`` takes ``(q, k, v, causal=...)`` over
     ``(batch, heads, seq, head_dim)`` and defaults to the single-device
     Pallas kernel; sequence-parallel callers inject a ring/Ulysses closure.
+
+    ``decode=True`` switches to the serving path: a ``cache`` variable
+    collection holds per-row key/value tensors of length
+    ``max_cache_len``, new tokens are scattered in at their absolute
+    ``positions`` and attention runs masked against the whole cache
+    (:func:`cached_attention`). Parameters are identical to the training
+    module — only runtime behavior and the (non-param) cache change.
     """
 
     num_heads: int
@@ -47,9 +84,11 @@ class SelfAttention(nn.Module):
     dtype: Dtype = jnp.bfloat16
     attention_fn: Optional[Callable] = None
     fused_qkv: bool = False
+    decode: bool = False
+    max_cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         d_model = x.shape[-1]
         if d_model % self.num_heads:
             raise ValueError(
@@ -72,6 +111,36 @@ class SelfAttention(nn.Module):
             q = dense(features=qkv_shape, name="query")(x)
             k = dense(features=qkv_shape, name="key")(x)
             v = dense(features=qkv_shape, name="value")(x)
+
+        if self.decode:
+            if positions is None:
+                raise ValueError("decode=True requires per-row positions")
+            if self.max_cache_len <= 0:
+                raise ValueError("decode=True requires max_cache_len > 0")
+            batch, new_tokens = x.shape[0], x.shape[1]
+            cache_shape = (batch, self.max_cache_len, self.num_heads,
+                           head_dim)
+            cached_key = self.variable("cache", "cached_key", jnp.zeros,
+                                       cache_shape, self.dtype)
+            cached_value = self.variable("cache", "cached_value", jnp.zeros,
+                                         cache_shape, self.dtype)
+            pos = jnp.asarray(positions, jnp.int32)
+
+            def scatter(cache, new, start):
+                return jax.lax.dynamic_update_slice(cache, new, (start, 0, 0))
+
+            cached_key.value = jax.vmap(scatter)(
+                cached_key.value, k.astype(self.dtype), pos)
+            cached_value.value = jax.vmap(scatter)(
+                cached_value.value, v.astype(self.dtype), pos)
+            q_pos = pos[:, None] + jnp.arange(new_tokens, dtype=jnp.int32)
+            o = cached_attention(
+                q.transpose(0, 2, 1, 3),
+                cached_key.value.transpose(0, 2, 1, 3),
+                cached_value.value.transpose(0, 2, 1, 3), q_pos)
+            o = o.transpose(0, 2, 1, 3)
+            return dense(features=d_model, axis=(-2, -1), name="out")(o)
+
         # (batch, seq, heads, head_dim) -> (batch, heads, seq, head_dim)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
@@ -119,14 +188,17 @@ class TransformerLayer(nn.Module):
     dtype: Dtype = jnp.bfloat16
     attention_fn: Optional[Callable] = None
     fused_qkv: bool = False
+    decode: bool = False
+    max_cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         ln = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
         x = x + SelfAttention(
             num_heads=self.num_heads, causal=self.causal, dtype=self.dtype,
             attention_fn=self.attention_fn, fused_qkv=self.fused_qkv,
-            name="attention")(ln()(x))
+            decode=self.decode, max_cache_len=self.max_cache_len,
+            name="attention")(ln()(x), positions=positions)
         x = x + Mlp(d_ff=self.d_ff, dtype=self.dtype, name="mlp")(ln()(x))
         return x
 
@@ -151,10 +223,11 @@ class Transformer(nn.Module):
     remat: bool = False
     attention_fn: Optional[Callable] = None
     fused_qkv: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True, pos_offset=0,
-                 output: str = "logits"):
+                 output: str = "logits", positions=None):
         """``pos_offset`` is the global position of the first token — under
         sequence parallelism each device passes its shard's offset (e.g.
         ``lax.axis_index(axis) * seq_local``) so position embeddings stay
@@ -183,6 +256,32 @@ class Transformer(nn.Module):
         pos_embed = self.param(
             "pos_embed", nn.initializers.normal(0.02),
             (self.max_seq, self.d_model), jnp.float32)
+
+        if self.decode:
+            # serving decode: each batch row sits at its own absolute
+            # position (continuous batching mixes requests of different
+            # lengths in one step). Gather per-row position embeddings
+            # and thread ``positions`` to every layer's KV cache.
+            if positions is None:
+                raise ValueError("decode=True requires per-row positions")
+            pos_idx = (jnp.asarray(positions, jnp.int32)[:, None]
+                       + jnp.arange(seq, dtype=jnp.int32)[None, :])
+            pos_idx = jnp.minimum(pos_idx, self.max_seq - 1)
+            pos_rows = jnp.take(pos_embed, pos_idx, axis=0)
+            x = embed(token_ids) + pos_rows.astype(self.dtype)
+            for i in range(self.num_layers):
+                x = TransformerLayer(
+                    num_heads=self.num_heads, d_ff=self.d_ff,
+                    causal=self.causal, dtype=self.dtype,
+                    attention_fn=self.attention_fn,
+                    fused_qkv=self.fused_qkv, decode=True,
+                    max_cache_len=self.max_seq,
+                    name=f"layer_{i}")(x, positions=positions)
+            x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                             name="final_norm")(x)
+            if output == "hidden":
+                return x
+            return embed.attend(x).astype(jnp.float32)
 
         if isinstance(pos_offset, int):
             # static offset: check bounds eagerly — dynamic_slice would

@@ -221,6 +221,18 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
          help="Maximum supervised relaunches before giving up "
               "(default 3; only with --supervise).")
 
+    serving = parser.add_argument_group("online serving")
+    _add(serving, "--serve", dest="serve", action="store_true",
+         help="Launch each slot as a continuous-batching inference "
+              "replica instead of a training worker (docs/inference.md). "
+              "With no command, runs the built-in demo worker "
+              "(python -m horovod_tpu.serve); with a command, the "
+              "command is expected to call hvd.serve()/run_kv_replica. "
+              "Replicas pull from the rendezvous-KV request queue and "
+              "register heartbeats the dispatcher uses to redistribute "
+              "work from dead replicas. HOROVOD_SERVE_* env knobs set "
+              "the batching policy.")
+
     stall = parser.add_argument_group("stall check")
     _add(stall, "--no-stall-check", dest="no_stall_check",
          action="store_true", help="Disable the stall inspector.")
@@ -425,6 +437,10 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         lineage = flight_recorder.load_restart_lineage(args.postmortem)
         print(flight_recorder.format_postmortem(dumps, lineage=lineage))
         return 0
+    if getattr(args, "serve", False) and not command:
+        # the serving plane's default worker: one KV-queue replica per
+        # slot, identical random-weight demo model on every rank
+        command = [sys.executable, "-m", "horovod_tpu.serve"]
     if not command:
         sys.stderr.write("tpurun: no command given\n")
         return 2

@@ -1,0 +1,27 @@
+"""Online inference plane: continuous batching on the elastic runtime.
+
+Layout (docs/inference.md is the full architecture doc):
+
+* ``api``      — ``hvd.serve()``, :class:`ServePolicy`, ``serve_state()``
+* ``queue``    — shared request queue (in-process + rendezvous-KV)
+* ``batcher``  — iteration-level admission/retire scheduling
+* ``kv_cache`` — per-slot KV cache + bucketed serving program caches
+* ``replica``  — the per-replica loop; ``run_kv_replica`` for fleets
+* ``__main__`` — the ``tpurun --serve`` demo worker
+"""
+
+from horovod_tpu.serve.api import (ServeHandle, ServePolicy, serve,
+                                   serve_state)
+from horovod_tpu.serve.batcher import ContinuousBatcher
+from horovod_tpu.serve.kv_cache import DecodeEngine, prompt_bucket
+from horovod_tpu.serve.queue import (Completion, KVQueueFrontend,
+                                     KVQueueReplica, QueueFull, Request,
+                                     RequestQueue)
+from horovod_tpu.serve.replica import Replica, run_kv_replica
+
+__all__ = [
+    "Completion", "ContinuousBatcher", "DecodeEngine", "KVQueueFrontend",
+    "KVQueueReplica", "QueueFull", "Replica", "Request", "RequestQueue",
+    "ServeHandle", "ServePolicy", "prompt_bucket", "run_kv_replica",
+    "serve", "serve_state",
+]

@@ -1,0 +1,221 @@
+"""``hvd.serve()`` — the public face of the serving plane.
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.transformer import GPT2Small
+
+    handle = hvd.serve(model, params, replicas=2, max_new_tokens=32)
+    uid = handle.submit([12, 7, 99])
+    out = handle.result(uid, timeout=30.0)   # Completion(tokens=...)
+    handle.close()
+
+In-process mode (above) runs ``replicas`` replica threads — each with
+its own :class:`~horovod_tpu.serve.kv_cache.DecodeEngine` (own cache,
+own program set) — against one shared in-memory
+:class:`~horovod_tpu.serve.queue.RequestQueue`. Cross-process fleets
+(``tpurun --serve``) run :func:`~horovod_tpu.serve.replica.
+run_kv_replica` per rank against the rendezvous KV queue instead; the
+policy/metrics/guard machinery is identical.
+
+Every policy knob has a ``HOROVOD_SERVE_*`` env default
+(:meth:`ServePolicy.from_env`; docs/inference.md has the table) and a
+keyword override on :func:`serve`.
+
+``serve_state()`` is the ``/serve`` route of the metrics server: a
+JSON snapshot of every live handle's replicas, queue, and program
+caches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import List, Optional
+
+from horovod_tpu.analysis import witness
+from horovod_tpu.integrity.guards import StepGuard
+from horovod_tpu.serve.kv_cache import DecodeEngine
+from horovod_tpu.serve.queue import Completion, RequestQueue
+from horovod_tpu.serve.replica import Replica, _LocalTransport
+from horovod_tpu.utils.env import _get_bool, _get_float, _get_int
+
+HOROVOD_SERVE_MAX_BATCH_TOKENS = "HOROVOD_SERVE_MAX_BATCH_TOKENS"
+HOROVOD_SERVE_ADMISSION_MS = "HOROVOD_SERVE_ADMISSION_MS"
+HOROVOD_SERVE_QUEUE_CAPACITY = "HOROVOD_SERVE_QUEUE_CAPACITY"
+HOROVOD_SERVE_DECODE_BLOCK = "HOROVOD_SERVE_DECODE_BLOCK"
+HOROVOD_SERVE_SLOTS = "HOROVOD_SERVE_SLOTS"
+HOROVOD_SERVE_MAX_NEW_TOKENS = "HOROVOD_SERVE_MAX_NEW_TOKENS"
+HOROVOD_SERVE_QUARANTINE = "HOROVOD_SERVE_QUARANTINE"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePolicy:
+    """Continuous-batching policy; docs/inference.md explains each knob
+    and batcher.py the precedence (budget > slots > deadline > block)."""
+
+    max_batch_tokens: int = 4096
+    admission_ms: float = 50.0
+    queue_capacity: int = 1024
+    decode_block: int = 8
+    slots: int = 8
+    max_new_tokens: int = 64
+    quarantine: bool = True
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServePolicy":
+        base = {
+            "max_batch_tokens": _get_int(HOROVOD_SERVE_MAX_BATCH_TOKENS,
+                                         cls.max_batch_tokens),
+            "admission_ms": _get_float(HOROVOD_SERVE_ADMISSION_MS,
+                                       cls.admission_ms),
+            "queue_capacity": _get_int(HOROVOD_SERVE_QUEUE_CAPACITY,
+                                       cls.queue_capacity),
+            "decode_block": _get_int(HOROVOD_SERVE_DECODE_BLOCK,
+                                     cls.decode_block),
+            "slots": _get_int(HOROVOD_SERVE_SLOTS, cls.slots),
+            "max_new_tokens": _get_int(HOROVOD_SERVE_MAX_NEW_TOKENS,
+                                       cls.max_new_tokens),
+            "quarantine": _get_bool(HOROVOD_SERVE_QUARANTINE,
+                                    cls.quarantine),
+        }
+        unknown = set(overrides) - set(base)
+        if unknown:
+            raise TypeError(f"unknown serve policy knob(s): "
+                            f"{sorted(unknown)}")
+        base.update(overrides)
+        return cls(**base)
+
+
+def _serve_guard(rank: int) -> StepGuard:
+    """The serving integrity guard watches per-step max-|logit|, which
+    legitimately swings with the prompt mix — unlike the allreduced loss
+    the training default (sigma=6, warmup=5) was tuned for. Serving
+    relaxes to sigma=12 / warmup=32 so healthy variation only ever costs
+    a skipped observation, while non-finite values and persistent
+    divergence still quarantine. HOROVOD_INTEGRITY_SPIKE_SIGMA
+    overrides the sigma here too."""
+    from horovod_tpu.integrity.guards import HOROVOD_INTEGRITY_SPIKE_SIGMA
+
+    return StepGuard(sigma=_get_float(HOROVOD_INTEGRITY_SPIKE_SIGMA, 12.0),
+                     warmup=32, decay=0.98, name=f"serve_r{rank}")
+
+
+# live handles, for serve_state() / the /serve route
+_state_lock = witness.make_lock("serve_api._state_lock")
+_handles: List["ServeHandle"] = []   # guarded-by: _state_lock
+
+
+class ServeHandle:
+    """A running in-process replica set + its shared queue."""
+
+    def __init__(self, replicas: List[Replica], queue: RequestQueue,
+                 policy: ServePolicy, tokenizer=None):
+        self._replicas = replicas
+        self._queue = queue
+        self._policy = policy
+        self._tokenizer = tokenizer
+        self._threads: List[threading.Thread] = []
+        self._closed = False
+        self.started_s = time.monotonic()
+        for replica in replicas:
+            t = threading.Thread(target=replica.run, daemon=True,
+                                 name=replica.name)
+            self._threads.append(t)
+            t.start()
+        with _state_lock:
+            _handles.append(self)
+
+    # -- request API -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None) -> str:
+        """Enqueue a prompt (token-id list, or text when a tokenizer was
+        given); returns the request id."""
+        if self._closed:
+            raise RuntimeError(
+                "serve handle is closed; nothing would ever complete "
+                "this request")
+        if self._tokenizer is not None and isinstance(prompt, str):
+            prompt = list(self._tokenizer.encode(prompt))
+        return self._queue.submit(
+            list(prompt),
+            max_new_tokens=(self._policy.max_new_tokens
+                            if max_new_tokens is None else max_new_tokens))
+
+    def result(self, uid: str, timeout: Optional[float] = None
+               ) -> Completion:
+        return self._queue.result(uid, timeout=timeout)
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = 60.0) -> Completion:
+        return self.result(self.submit(prompt, max_new_tokens),
+                           timeout=timeout)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def policy(self) -> ServePolicy:
+        return self._policy
+
+    def queue_depth(self) -> int:
+        return self._queue.depth()
+
+    def compiles_total(self) -> int:
+        return sum(r.engine.compiles_total() for r in self._replicas)
+
+    def stats(self) -> dict:
+        return {
+            "policy": dataclasses.asdict(self._policy),
+            "uptime_s": round(time.monotonic() - self.started_s, 3),
+            "queue": self._queue.stats(),
+            "replicas": [r.stats() for r in self._replicas],
+        }
+
+    def close(self, timeout: float = 10.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for replica in self._replicas:
+            replica.stop()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        with _state_lock:
+            if self in _handles:
+                _handles.remove(self)
+
+    def __enter__(self) -> "ServeHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve(model, params, tokenizer=None, *, replicas: int = 1,
+          policy: Optional[ServePolicy] = None, **overrides) -> ServeHandle:
+    """Start an in-process continuous-batching replica set over
+    ``model``/``params`` and return its :class:`ServeHandle`.
+
+    ``model`` must be a causal :class:`~horovod_tpu.models.transformer.
+    Transformer` (or clone-compatible); ``params`` its trained params
+    pytree. ``**overrides`` are :class:`ServePolicy` fields; anything
+    not overridden comes from ``HOROVOD_SERVE_*`` env knobs.
+    """
+    if policy is None:
+        policy = ServePolicy.from_env(**overrides)
+    elif overrides:
+        policy = dataclasses.replace(policy, **overrides)
+    queue = RequestQueue(capacity=policy.queue_capacity)
+    fleet: List[Replica] = []
+    for rank in range(replicas):
+        engine = DecodeEngine(model, params, num_slots=policy.slots,
+                              name=f"r{rank}")
+        guard = _serve_guard(rank) if policy.quarantine else None
+        fleet.append(Replica(engine, _LocalTransport(queue, rank), policy,
+                             rank=rank, guard=guard))
+    return ServeHandle(fleet, queue, policy, tokenizer=tokenizer)
+
+
+def serve_state() -> dict:
+    """JSON-ready snapshot of every live handle — the ``/serve`` route
+    on the metrics server (docs/metrics.md)."""
+    with _state_lock:
+        handles = list(_handles)
+    return {"handles": [h.stats() for h in handles],
+            "count": len(handles)}

@@ -1,0 +1,177 @@
+"""Iteration-level continuous batcher (Orca-style scheduling).
+
+One instance per replica, owned by the replica loop thread — all state
+below is ``# guarded-by: <replica-thread>``. The batcher is pure
+scheduling: it never touches jax, so the admission policy is unit-
+testable with a fake clock (tests/test_serve.py's policy matrix).
+
+Admission policy, in priority order:
+
+1. **Token budget is a hard cap.** A candidate is admitted only if the
+   committed token total — every active slot's ``prompt_len +
+   max_new_tokens`` plus the candidate's — stays within
+   ``HOROVOD_SERVE_MAX_BATCH_TOKENS``. Committed (worst-case) rather
+   than current lengths, so an admitted request can never be evicted
+   mid-generation by later admissions. The admission deadline never
+   overrides the budget.
+2. **Slots.** At most ``HOROVOD_SERVE_SLOTS`` concurrent requests (one
+   KV-cache row each).
+3. **Deadline beats the decode block.** Between admission checks the
+   replica decodes ``HOROVOD_SERVE_DECODE_BLOCK`` uninterrupted steps
+   (admission means a prefill, i.e. a latency bubble for running
+   requests — batching those bubbles amortizes them). But a waiting
+   request older than ``HOROVOD_SERVE_ADMISSION_MS`` pulls the check
+   forward to the next step boundary: the block length bounds decode
+   batching, the deadline bounds queueing delay, and the deadline wins.
+
+FIFO order: requests are admitted in arrival order, and a budget-blocked
+head does not let younger requests jump it (head-of-line blocking is the
+price of no-starvation; the budget check is against the queue head).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.serve.queue import Request
+
+
+@dataclasses.dataclass
+class ActiveRequest:
+    """One occupied KV-cache slot."""
+
+    slot: int
+    request: Request
+    prompt_len: int
+    position: int            # absolute index the NEXT token writes at
+    generated: List[int] = dataclasses.field(default_factory=list)
+    first_token_s: float = 0.0
+    admitted_s: float = 0.0
+
+    @property
+    def committed_tokens(self) -> int:
+        return self.prompt_len + self.request.max_new_tokens
+
+    @property
+    def done(self) -> bool:
+        return len(self.generated) >= self.request.max_new_tokens
+
+
+class ContinuousBatcher:
+    """Slot assignment + admission timing for one replica."""
+
+    def __init__(self, num_slots: int, max_batch_tokens: int,
+                 admission_ms: float, decode_block: int):
+        self.num_slots = num_slots
+        self.max_batch_tokens = max_batch_tokens
+        self.admission_s = admission_ms / 1000.0
+        self.decode_block = max(1, decode_block)
+        # guarded-by: <replica-thread>
+        self._waiting: deque = deque()   # (Request, offered_monotonic)
+        self._active: Dict[int, ActiveRequest] = {}
+        self._free: List[int] = sorted(range(num_slots), reverse=True)
+        self._steps_since_admission = 0
+
+    # -- introspection -----------------------------------------------------
+    def waiting(self) -> int:
+        return len(self._waiting)
+
+    def active(self) -> List[ActiveRequest]:
+        return list(self._active.values())
+
+    def occupancy(self) -> int:
+        return len(self._active)
+
+    def committed_tokens(self) -> int:
+        return sum(a.committed_tokens for a in self._active.values())
+
+    def oldest_wait_s(self, now: Optional[float] = None) -> float:
+        if not self._waiting:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        return now - self._waiting[0][1]
+
+    # -- scheduling --------------------------------------------------------
+    def offer(self, request: Request, now: Optional[float] = None) -> None:
+        self._waiting.append((request,
+                              time.monotonic() if now is None else now))
+
+    def note_step(self) -> None:
+        self._steps_since_admission += 1
+
+    def admission_due(self, now: Optional[float] = None) -> bool:
+        """Check admission this iteration? True at every decode-block
+        boundary, immediately when the replica is idle, and early when
+        the queue head has waited past the admission deadline."""
+        if not self._waiting:
+            return False
+        if not self._active:
+            return True
+        if self._steps_since_admission >= self.decode_block:
+            return True
+        return self.oldest_wait_s(now) >= self.admission_s
+
+    def admit(self, now: Optional[float] = None) -> List[ActiveRequest]:
+        """Admit FIFO from the waiting line while slots and the token
+        budget allow; resets the decode-block counter."""
+        now = time.monotonic() if now is None else now
+        admitted: List[ActiveRequest] = []
+        budget = self.committed_tokens()
+        while self._waiting and self._free:
+            req, _ = self._waiting[0]
+            cost = len(req.prompt) + req.max_new_tokens
+            if budget + cost > self.max_batch_tokens:
+                break   # hard cap — the deadline never overrides it
+            self._waiting.popleft()
+            slot = self._free.pop()
+            active = ActiveRequest(slot=slot, request=req,
+                                   prompt_len=len(req.prompt),
+                                   position=len(req.prompt),
+                                   admitted_s=now)
+            self._active[slot] = active
+            admitted.append(active)
+            budget += cost
+        self._steps_since_admission = 0
+        return admitted
+
+    def retire_done(self) -> List[ActiveRequest]:
+        """Free the slots of finished requests (iteration-level retire:
+        called after every decode step, not at batch boundaries)."""
+        done = [a for a in self._active.values() if a.done]
+        for a in done:
+            del self._active[a.slot]
+            self._free.append(a.slot)
+        self._free.sort(reverse=True)
+        return done
+
+    def evict_all(self) -> List[Request]:
+        """Drop every active request (quarantine / worker-loss path) and
+        return them for requeueing — nothing is lost, the generated
+        prefix is (tokens are regenerated deterministically on replay)."""
+        evicted = [a.request for a in
+                   sorted(self._active.values(), key=lambda a: a.slot)]
+        self._active.clear()
+        self._free = sorted(range(self.num_slots), reverse=True)
+        return evicted
+
+    def drain_waiting(self) -> List[Request]:
+        out = [req for req, _ in self._waiting]
+        self._waiting.clear()
+        return out
+
+    def batch_rows(self) -> Tuple[List[int], List[int], List[int]]:
+        """(slots, token_ids, positions) for the next decode step: each
+        active row's last generated token (or last prompt token right
+        after prefill) at its current position."""
+        slots, tokens, positions = [], [], []
+        for a in sorted(self._active.values(), key=lambda a: a.slot):
+            if a.done:
+                continue
+            tokens.append(a.generated[-1] if a.generated
+                          else a.request.prompt[-1])
+            positions.append(a.position)
+            slots.append(a.slot)
+        return slots, tokens, positions

@@ -1,0 +1,194 @@
+"""Per-slot KV-cache management + the serving program caches.
+
+:class:`DecodeEngine` owns everything jax about one replica:
+
+* the decode clone of the user's model (``model.clone(decode=True)`` —
+  same params, plus a ``cache`` variable collection of
+  ``(slots, max_seq, heads, head_dim)`` key/value tensors per layer);
+* ONE jitted decode program over ALL slots every step — the shape never
+  changes (inactive rows run masked garbage at position 0, overwritten
+  by the next prefill), so steady-state decode never recompiles;
+* one jitted prefill program PER PROMPT-LENGTH BUCKET, batch 1, which
+  writes the prompt's KV into a fresh single-row cache and scatters it
+  into the requested slot at a traced index. Bucketing reuses the
+  runtime's size-bucket policy (``fusion_buffer.bucket_elems``: identity
+  up to the quantum, then power-of-two multiples), floored at the
+  quantum so short prompts share one program — the bucket set is
+  O(log(max_seq)) and after one request per bucket the program cache is
+  warm: zero steady-state compiles.
+
+Prefill padding is safe without length bookkeeping: padded positions'
+garbage KV sits at positions ``>= prompt_len``, which
+``models.transformer.cached_attention`` masks for every query that has
+not reached them — and decode overwrites each one before its query
+arrives. Slot reuse is safe the same way (stale rows of the previous
+occupant are never attendable); tests/test_serve.py pins both down
+against the uncached ``apply``.
+
+Sampling is greedy (argmax in-graph; only the winning token ids leave
+the device each step, plus one max-|logit| scalar per slot for the
+integrity guard).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.analysis import witness
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.runtime.fusion_buffer import bucket_elems
+
+# prompt-length bucket quantum (tokens). Not a knob: the policy is the
+# runtime's, only the unit differs (tokens, not bytes).
+PREFILL_BUCKET_QUANTUM = 16
+
+_COMPILES = _metrics().counter(
+    "horovod_serve_compiles_total",
+    "Serving programs compiled, by kind (steady state adds none).",
+    labelnames=("program",))
+
+
+def prompt_bucket(prompt_len: int, max_seq: int,
+                  quantum: int = PREFILL_BUCKET_QUANTUM) -> int:
+    """Padded prompt length: the fusion-buffer size-bucket policy in
+    token units, floored at the quantum (identity below the quantum
+    would mean one compile per distinct short-prompt length — right for
+    fusion cache keys, wrong for programs)."""
+    return min(max_seq, bucket_elems(max(prompt_len, quantum), 1, quantum))
+
+
+class DecodeEngine:
+    """Model programs + the slot cache for one replica."""
+
+    def __init__(self, model, params, num_slots: int, name: str = "r0"):
+        if not getattr(model, "causal", True):
+            raise ValueError("hvd.serve() needs a causal (decoder) model")
+        self.name = name
+        self.num_slots = int(num_slots)
+        self.max_seq = int(model.max_seq)
+        self.vocab_size = int(model.vocab_size)
+        self._params = params
+        self._model = model.clone(decode=True, remat=False,
+                                  attention_fn=None)
+        self._cache = self._allocate_cache()
+        self._prefill_fns: Dict[int, object] = {}  # guarded-by: <replica-thread>
+        self._decode_fn = jax.jit(self._decode_impl)
+        self._decode_compiled = False
+        self._lock = witness.make_lock("DecodeEngine._lock")
+        self._compiles: Dict[str, int] = {}      # guarded-by: _lock
+        self.decode_steps = 0
+        self.step_ms_ewma = 0.0
+
+    # -- cache -------------------------------------------------------------
+    def _allocate_cache(self):
+        """Zero cache pytree with the decode program's shapes — derived
+        via ``eval_shape`` so allocation itself compiles nothing."""
+        tokens = jnp.zeros((self.num_slots, 1), jnp.int32)
+        pos = jnp.zeros((self.num_slots,), jnp.int32)
+        _, shapes = jax.eval_shape(
+            lambda p, t, q: self._model.apply(
+                {"params": p}, t, positions=q, train=False,
+                mutable=["cache"]),
+            self._params, tokens, pos)
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            shapes["cache"])
+
+    def cache_bytes(self) -> int:
+        return sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                   for x in jax.tree.leaves(self._cache))
+
+    # -- programs ----------------------------------------------------------
+    def _note_compile(self, program: str) -> None:
+        _COMPILES.labels(program=program).inc()
+        with self._lock:
+            self._compiles[program] = self._compiles.get(program, 0) + 1
+
+    def compiles_total(self) -> int:
+        with self._lock:
+            return sum(self._compiles.values())
+
+    def _prefill_impl(self, params, cache, tokens, prompt_len, slot):
+        # batch-1 run over the padded prompt builds a fresh (1, max_seq)
+        # cache (flax creates the zero cache inside the traced apply)...
+        logits, mutated = self._model.apply(
+            {"params": params}, tokens,
+            positions=jnp.zeros((1,), jnp.int32), train=False,
+            mutable=["cache"])
+        # ...scattered into the slot row at a traced index, so every
+        # prompt of this bucket reuses one program regardless of slot
+        cache = jax.tree.map(
+            lambda big, one: jax.lax.dynamic_update_index_in_dim(
+                big, one[0], slot, axis=0), cache, mutated["cache"])
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], prompt_len - 1, axis=0, keepdims=False)
+        return cache, jnp.argmax(last).astype(jnp.int32), \
+            jnp.max(jnp.abs(last))
+
+    def _decode_impl(self, params, cache, tokens, positions):
+        logits, mutated = self._model.apply(
+            {"params": params, "cache": cache}, tokens,
+            positions=positions, train=False, mutable=["cache"])
+        step_logits = logits[:, 0, :]
+        return (mutated["cache"],
+                jnp.argmax(step_logits, axis=-1).astype(jnp.int32),
+                jnp.max(jnp.abs(step_logits), axis=-1))
+
+    # -- serving ops -------------------------------------------------------
+    def prefill(self, slot: int, prompt: List[int]) -> Tuple[int, float]:
+        """Run the prompt through the bucketed prefill program, filling
+        ``slot``'s cache rows. Returns (first generated token id,
+        max |logit|) — the first token comes from prefill itself."""
+        bucket = prompt_bucket(len(prompt), self.max_seq)
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_impl)
+            self._prefill_fns[bucket] = fn
+            self._note_compile(f"prefill_{bucket}")
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        self._cache, token, max_abs = fn(
+            self._params, self._cache, jnp.asarray(padded),
+            jnp.int32(len(prompt)), jnp.int32(slot))
+        return int(token), float(max_abs)
+
+    def decode(self, slots: List[int], tokens: List[int],
+               positions: List[int]) -> Tuple[List[int], List[float]]:
+        """One decode step over ALL cache rows (fixed shape — the one
+        compiled decode program). Active rows get their real token and
+        position; inactive rows run token 0 at position 0, whose cache
+        write lands where the next prefill overwrites it."""
+        if not self._decode_compiled:
+            self._decode_compiled = True
+            self._note_compile("decode")
+        step_tokens = np.zeros((self.num_slots, 1), np.int32)
+        step_pos = np.zeros((self.num_slots,), np.int32)
+        for s, t, p in zip(slots, tokens, positions):
+            step_tokens[s, 0] = t
+            step_pos[s] = min(p, self.max_seq - 1)
+        start = time.monotonic()
+        self._cache, ids, max_abs = self._decode_fn(
+            self._params, self._cache, jnp.asarray(step_tokens),
+            jnp.asarray(step_pos))
+        ids = np.asarray(ids)
+        max_abs = np.asarray(max_abs)
+        ms = (time.monotonic() - start) * 1000.0
+        self.decode_steps += 1
+        self.step_ms_ewma = (ms if self.decode_steps == 1
+                             else 0.9 * self.step_ms_ewma + 0.1 * ms)
+        return ([int(ids[s]) for s in slots],
+                [float(max_abs[s]) for s in slots])
+
+    def stats(self) -> dict:
+        with self._lock:
+            compiles = dict(self._compiles)
+        return {"compiles": compiles,
+                "compiles_total": sum(compiles.values()),
+                "decode_steps": self.decode_steps,
+                "decode_step_ms_ewma": round(self.step_ms_ewma, 3),
+                "cache_bytes": self.cache_bytes(),
+                "slots": self.num_slots}

@@ -1,0 +1,319 @@
+"""Shared request queue for the serving plane.
+
+Two transports behind one contract:
+
+* :class:`RequestQueue` — in-memory, single-controller. ``hvd.serve()``
+  threads (replicas) and caller threads (submitters) share it inside one
+  process; it is also the reference semantics the unit tests pin down.
+* :class:`KVQueueFrontend` / :class:`KVQueueReplica` — the cross-process
+  transport over the rendezvous HTTP KV store (run/rendezvous.py), used
+  by ``tpurun --serve`` worker fleets and the chaos matrix. The store
+  has no atomic claim op, so the frontend is the single dispatcher: it
+  round-robins requests into per-rank scopes, watches per-rank
+  heartbeat keys, and re-dispatches the un-answered requests of a dead
+  replica to survivors (responses are deduplicated by request id, so a
+  reply that raced the death detection is harmless).
+
+The zero-lost-requests invariant both transports uphold: a request
+leaves the system only by completing. Pulling moves it to an in-flight
+set tagged with the puller's rank; worker loss moves that rank's
+in-flight requests back to the FRONT of the waiting line
+(:meth:`RequestQueue.requeue_worker`), oldest first, so a re-dispatched
+request does not also lose its queue position.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.analysis import witness
+
+# rendezvous scopes of the cross-process transport
+REQ_SCOPE = "serve.req.{rank}"   # per-replica inbox: key=uid, val=request
+RESP_SCOPE = "serve.resp"        # key=uid, val=completion
+HB_SCOPE = "serve.hb"            # key=str(rank), TTL-listed for liveness
+CTL_SCOPE = "serve.ctl"          # "stop" key drains the fleet
+
+# a replica heartbeats ~4x faster than the frontend declares it dead
+HEARTBEAT_SECONDS = 0.5
+STALE_SECONDS = 2.0
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: the queue is at HOROVOD_SERVE_QUEUE_CAPACITY."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``submitted_s`` is the submitter's local
+    monotonic clock (latency accounting happens where the clock lives)."""
+
+    uid: str
+    prompt: List[int]
+    max_new_tokens: int
+    submitted_s: float = 0.0
+
+    def to_json(self) -> bytes:
+        return json.dumps({"uid": self.uid, "prompt": list(self.prompt),
+                           "max_new_tokens": self.max_new_tokens}).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Request":
+        d = json.loads(raw)
+        return cls(uid=d["uid"], prompt=[int(t) for t in d["prompt"]],
+                   max_new_tokens=int(d["max_new_tokens"]))
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request: generated ids + where/how it ran."""
+
+    uid: str
+    tokens: List[int]
+    prompt_len: int
+    rank: int
+    ttft_s: float = 0.0      # submit -> first generated token
+    latency_s: float = 0.0   # submit -> completion
+    finish: str = "length"
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Completion":
+        d = json.loads(raw)
+        return cls(uid=d["uid"], tokens=[int(t) for t in d["tokens"]],
+                   prompt_len=int(d["prompt_len"]), rank=int(d["rank"]),
+                   ttft_s=float(d.get("ttft_s", 0.0)),
+                   latency_s=float(d.get("latency_s", 0.0)),
+                   finish=d.get("finish", "length"))
+
+
+class RequestQueue:
+    """In-process shared queue: waiting deque + per-rank in-flight map +
+    completed results, one lock. No call blocks under the lock — waiters
+    poll (:meth:`result`) with short sleeps outside it."""
+
+    def __init__(self, capacity: int = 1024):
+        self._lock = witness.make_lock("RequestQueue._lock")
+        self._capacity = capacity
+        self._waiting: deque = deque()           # guarded-by: _lock
+        self._inflight: Dict[str, Tuple[int, Request]] = {}  # guarded-by: _lock
+        self._results: Dict[str, Completion] = {}  # guarded-by: _lock
+        self._submitted = 0                      # guarded-by: _lock
+        self._requeued = 0                       # guarded-by: _lock
+
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               uid: Optional[str] = None) -> str:
+        req = Request(uid=uid or uuid.uuid4().hex, prompt=list(prompt),
+                      max_new_tokens=int(max_new_tokens),
+                      submitted_s=time.monotonic())
+        with self._lock:
+            if len(self._waiting) >= self._capacity:
+                raise QueueFull(
+                    f"serve queue at capacity ({self._capacity})")
+            self._waiting.append(req)
+            self._submitted += 1
+        return req.uid
+
+    def pull(self, rank: int, max_n: int) -> List[Request]:
+        """Hand up to ``max_n`` waiting requests to replica ``rank``;
+        they stay in-flight (charged to that rank) until completed or
+        requeued."""
+        out: List[Request] = []
+        with self._lock:
+            while self._waiting and len(out) < max_n:
+                req = self._waiting.popleft()
+                self._inflight[req.uid] = (rank, req)
+                out.append(req)
+        return out
+
+    def complete(self, completion: Completion) -> None:
+        with self._lock:
+            self._inflight.pop(completion.uid, None)
+            # first writer wins: a requeued duplicate that also finished
+            # must not overwrite the reply the caller already saw
+            self._results.setdefault(completion.uid, completion)
+
+    def requeue_worker(self, rank: int) -> int:
+        """Return every request in-flight on ``rank`` to the FRONT of
+        the waiting line (oldest first). The no-request-lost half of
+        worker loss; called by the serve loop on ``WorkersDownError``,
+        quarantine, or replica death."""
+        with self._lock:
+            stranded = [(uid, req) for uid, (r, req)
+                        in self._inflight.items() if r == rank]
+            for uid, req in sorted(stranded,
+                                   key=lambda kv: kv[1].submitted_s,
+                                   reverse=True):
+                del self._inflight[uid]
+                self._waiting.appendleft(req)
+            self._requeued += len(stranded)
+            return len(stranded)
+
+    def result(self, uid: str, timeout: Optional[float] = None
+               ) -> Completion:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                done = self._results.get(uid)
+            if done is not None:
+                return done
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(f"request {uid} not completed "
+                                   f"within {timeout}s")
+            time.sleep(0.002)
+
+    def try_result(self, uid: str) -> Optional[Completion]:
+        with self._lock:
+            return self._results.get(uid)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"waiting": len(self._waiting),
+                    "inflight": len(self._inflight),
+                    "completed": len(self._results),
+                    "submitted": self._submitted,
+                    "requeued": self._requeued}
+
+
+class KVQueueReplica:
+    """Replica-side view of the KV transport: poll the per-rank inbox,
+    publish completions, heartbeat, honor the stop key. Single-owner
+    (the replica loop thread) — no lock needed."""
+
+    def __init__(self, client, rank: int):
+        self._client = client            # KVStoreClient, any scope
+        self._rank = rank
+        self._scope = REQ_SCOPE.format(rank=rank)
+        self._taken: set = set()         # guarded-by: <replica-thread>
+
+    def heartbeat(self) -> None:
+        self._client.set(str(self._rank), b"1", scope=HB_SCOPE)
+
+    def poll(self, max_n: int) -> List[Request]:
+        out: List[Request] = []
+        try:
+            keys = self._client.keys(scope=self._scope)
+        except Exception:
+            return out
+        for key in keys:
+            if key in self._taken or len(out) >= max_n:
+                continue
+            try:
+                raw = self._client.get(key, scope=self._scope, wait=False)
+            except KeyError:
+                continue
+            self._taken.add(key)
+            req = Request.from_json(raw)
+            req.submitted_s = time.monotonic()  # replica-local clock
+            out.append(req)
+        return out
+
+    def complete(self, completion: Completion) -> None:
+        self._client.set(completion.uid, completion.to_json(),
+                         scope=RESP_SCOPE)
+        try:  # shrink the inbox listing; liveness only, never correctness
+            self._client.finish(completion.uid, scope=self._scope)
+        except Exception:
+            pass
+
+    def stopped(self) -> bool:
+        try:
+            self._client.get("stop", scope=CTL_SCOPE, wait=False)
+            return True
+        except Exception:
+            return False
+
+
+class KVQueueFrontend:
+    """Dispatcher side of the KV transport (runs in the load generator /
+    ``hvd.serve`` controller process). Single-owner thread."""
+
+    def __init__(self, client, stale_seconds: float = STALE_SECONDS):
+        self._client = client
+        self._stale = stale_seconds
+        self._rr = itertools.count()
+        # guarded-by: <frontend-thread>
+        self._assigned: Dict[str, Tuple[int, Request]] = {}
+        self._done: Dict[str, Completion] = {}
+        self.requeued = 0
+        self.dead_ranks: set = set()
+
+    def live_replicas(self) -> List[int]:
+        try:
+            keys = self._client.keys(scope=HB_SCOPE, ttl=self._stale)
+        except Exception:
+            return []
+        return sorted(int(k) for k in keys if k.isdigit())
+
+    def wait_for_replicas(self, n: int, timeout: float = 60.0) -> List[int]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            live = self.live_replicas()
+            if len(live) >= n:
+                return live
+            time.sleep(0.1)
+        raise TimeoutError(f"{n} serve replicas not up within {timeout}s")
+
+    def submit(self, request: Request,
+               rank: Optional[int] = None) -> int:
+        """Dispatch to ``rank`` (or round-robin over live replicas)."""
+        if rank is None:
+            live = self.live_replicas()
+            if not live:
+                raise RuntimeError("no live serve replicas")
+            rank = live[next(self._rr) % len(live)]
+        self._client.set(request.uid, request.to_json(),
+                         scope=REQ_SCOPE.format(rank=rank))
+        self._assigned[request.uid] = (rank, request)
+        return rank
+
+    def _redispatch_dead(self) -> None:
+        live = set(self.live_replicas())
+        if not live:
+            return
+        for uid, (rank, req) in list(self._assigned.items()):
+            if rank in live or uid in self._done:
+                continue
+            self.dead_ranks.add(rank)
+            self.requeued += 1
+            self.submit(req)
+
+    def poll_responses(self) -> List[Completion]:
+        """Drain newly-published completions; re-dispatches the pending
+        requests of any replica whose heartbeat went stale."""
+        fresh: List[Completion] = []
+        try:
+            keys = self._client.keys(scope=RESP_SCOPE)
+        except Exception:
+            keys = []
+        for key in keys:
+            if key in self._done:
+                continue
+            try:
+                raw = self._client.get(key, scope=RESP_SCOPE, wait=False)
+            except KeyError:
+                continue
+            done = Completion.from_json(raw)
+            self._done[key] = done   # dedup: first reply wins
+            fresh.append(done)
+        self._redispatch_dead()
+        return fresh
+
+    def pending(self) -> int:
+        return len([u for u in self._assigned if u not in self._done])
+
+    def stop_fleet(self) -> None:
+        self._client.set("stop", b"1", scope=CTL_SCOPE)

@@ -1,0 +1,341 @@
+"""The per-replica serving loop: pull → admit/prefill → decode → retire.
+
+One :class:`Replica` drives one :class:`~horovod_tpu.serve.kv_cache.
+DecodeEngine` and one :class:`~horovod_tpu.serve.batcher.
+ContinuousBatcher` on a single thread. The loop each iteration:
+
+1. pulls new requests from the shared queue (in-process or KV-backed,
+   behind a small transport adapter) into the batcher's waiting line;
+2. when admission is due (decode-block boundary, idle replica, or the
+   admission deadline — batcher.py has the policy), prefills admitted
+   prompts through the bucketed prefill programs; the first generated
+   token falls out of prefill, so TTFT is measured here;
+3. runs ONE fixed-shape decode step over all slots and retires finished
+   rows iteration-level (a retiring request frees its slot for the very
+   next admission check, not a batch boundary).
+
+Reliability wiring (the serve plane rides the existing stack):
+
+* ``fault_inject.maybe_inject`` fires per DECODE step (the serving
+  analogue of the training step counter), so the chaos matrix can kill
+  a replica mid-generation;
+* a PR-10 :class:`~horovod_tpu.integrity.guards.StepGuard` watches the
+  per-step max-|logit|; a non-finite value (or an exhausted guard)
+  QUARANTINES the replica — it returns every pulled request to the
+  queue, stops heartbeating so the dispatcher reassigns, and parks,
+  rather than serving garbage;
+* a :class:`~horovod_tpu.exceptions.WorkersDownError` escaping the step
+  (a model whose forward uses collectives under elastic) requeues the
+  in-flight work the same way before re-raising to the elastic driver.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import List, Optional
+
+from horovod_tpu import flight_recorder
+from horovod_tpu.elastic import fault_inject
+from horovod_tpu.exceptions import NumericalError, WorkersDownError
+from horovod_tpu.metrics import COUNT_BUCKETS, registry as _metrics
+from horovod_tpu.serve.batcher import ContinuousBatcher
+from horovod_tpu.serve.kv_cache import DecodeEngine
+from horovod_tpu.serve.queue import (Completion, KVQueueReplica,
+                                     RequestQueue, HEARTBEAT_SECONDS)
+from horovod_tpu.utils import logging as log
+
+_IDLE_SLEEP_SECONDS = 0.002
+
+_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+_REQUESTS = _metrics().counter(
+    "horovod_serve_requests_total",
+    "Serving requests, by outcome (completed/requeued).",
+    labelnames=("outcome",))
+_TOKENS = _metrics().counter(
+    "horovod_serve_tokens_total",
+    "Tokens processed by the serving plane, by kind (prefill/decode).",
+    labelnames=("kind",))
+_OCCUPANCY = _metrics().gauge(
+    "horovod_serve_batch_occupancy",
+    "Active requests in the continuous batch, per replica.",
+    labelnames=("replica",))
+_QUEUE_DEPTH = _metrics().gauge(
+    "horovod_serve_queue_depth",
+    "Requests waiting for admission (queue + batcher), per replica.",
+    labelnames=("replica",))
+_OCCUPANCY_HIST = _metrics().histogram(
+    "horovod_serve_batch_occupancy_steps",
+    "Batch occupancy observed at each decode step.",
+    buckets=COUNT_BUCKETS)
+_LATENCY = _metrics().histogram(
+    "horovod_serve_latency_seconds",
+    "Request latency by phase: ttft (submit to first token) and total.",
+    buckets=_LATENCY_BUCKETS, labelnames=("phase",))
+_QUARANTINED = _metrics().counter(
+    "horovod_serve_quarantined_total",
+    "Replicas quarantined by the serving integrity guard.")
+
+
+class _LocalTransport:
+    """In-process adapter over the shared :class:`RequestQueue`."""
+
+    def __init__(self, queue: RequestQueue, rank: int):
+        self._queue = queue
+        self._rank = rank
+
+    def pull(self, max_n):
+        return self._queue.pull(self._rank, max_n)
+
+    def complete(self, completion):
+        self._queue.complete(completion)
+
+    def requeue_all(self) -> int:
+        return self._queue.requeue_worker(self._rank)
+
+    def heartbeat(self):
+        pass
+
+    def stopped(self) -> bool:
+        return False
+
+    def depth(self) -> int:
+        return self._queue.depth()
+
+
+class _KVTransport:
+    """Cross-process adapter over the rendezvous-KV queue. Requeueing is
+    the DISPATCHER's job in this transport (it owns assignment): on
+    quarantine the replica just goes silent — its heartbeat lapses and
+    the frontend redistributes everything unanswered.
+
+    The serve loop spins at millisecond cadence; every KV op is an HTTP
+    round trip, so the inbox poll and the stop-key check are throttled —
+    an idle replica costs the rendezvous server ~60 requests/s, not
+    ~1500."""
+
+    _POLL_SECONDS = 0.02
+    _STOP_CHECK_SECONDS = 0.25
+
+    def __init__(self, kv: KVQueueReplica):
+        self._kv = kv
+        self._last_beat = 0.0
+        self._last_poll = 0.0
+        self._last_stop_check = 0.0
+        self._stopped = False
+        self.silent = False
+
+    def pull(self, max_n):
+        now = time.monotonic()
+        if now - self._last_poll < self._POLL_SECONDS:
+            return []
+        self._last_poll = now
+        return self._kv.poll(max_n)
+
+    def complete(self, completion):
+        self._kv.complete(completion)
+
+    def requeue_all(self) -> int:
+        self.silent = True
+        return 0
+
+    def heartbeat(self):
+        now = time.monotonic()
+        if not self.silent and now - self._last_beat >= HEARTBEAT_SECONDS:
+            self._last_beat = now
+            try:
+                self._kv.heartbeat()
+            except Exception as exc:
+                log.warning("serve: heartbeat failed: %s", exc)
+
+    def stopped(self) -> bool:
+        if self._stopped:
+            return True
+        now = time.monotonic()
+        if now - self._last_stop_check < self._STOP_CHECK_SECONDS:
+            return False
+        self._last_stop_check = now
+        self._stopped = self._kv.stopped()
+        return self._stopped
+
+    def depth(self) -> int:
+        return 0
+
+
+class Replica:
+    """One serving replica; ``run()`` is the loop, single thread."""
+
+    def __init__(self, engine: DecodeEngine, transport, policy, rank: int = 0,
+                 name: Optional[str] = None, guard=None):
+        self.engine = engine
+        self.transport = transport
+        self.policy = policy
+        self.rank = rank
+        self.name = name or f"serve-r{rank}"
+        self.batcher = ContinuousBatcher(
+            num_slots=engine.num_slots,
+            max_batch_tokens=policy.max_batch_tokens,
+            admission_ms=policy.admission_ms,
+            decode_block=policy.decode_block)
+        self.guard = guard
+        self.quarantined = False
+        self.completed = 0
+        self.decode_iterations = 0
+        self.occupancy_sum = 0
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _finish(self, active, now: float) -> None:
+        req = active.request
+        completion = Completion(
+            uid=req.uid, tokens=list(active.generated),
+            prompt_len=active.prompt_len, rank=self.rank,
+            ttft_s=active.first_token_s - req.submitted_s,
+            latency_s=now - req.submitted_s, finish="length")
+        self.transport.complete(completion)
+        self.completed += 1
+        _REQUESTS.labels(outcome="completed").inc()
+        _LATENCY.labels(phase="total").observe(completion.latency_s)
+
+    def _quarantine(self, reason: str) -> None:
+        """Integrity trip: never serve garbage. Active + waiting work
+        goes back to the queue (in-process) or to the dispatcher's
+        death-detection (KV: the heartbeat just stops); the replica
+        parks until the fleet is stopped."""
+        self.quarantined = True
+        _QUARANTINED.inc()
+        evicted = len(self.batcher.evict_all())
+        evicted += len(self.batcher.drain_waiting())
+        requeued = self.transport.requeue_all()
+        _REQUESTS.labels(outcome="requeued").inc(max(evicted, requeued))
+        flight_recorder.emit("serve_quarantine", replica=self.name,
+                             rank=self.rank, reason=reason,
+                             evicted=evicted)
+        log.error("serve: replica %s QUARANTINED (%s); %d request(s) "
+                  "returned for redistribution", self.name, reason,
+                  max(evicted, requeued))
+
+    def _guard_ok(self, max_abs: float) -> bool:
+        """Non-finite logits always quarantine; the spike guard's EWMA
+        feeds the same decision once its skip budget is spent."""
+        if not math.isfinite(max_abs):
+            return False
+        if self.guard is not None:
+            try:
+                self.guard.observe(max_abs)
+            except NumericalError:
+                return False
+        return True
+
+    # -- the loop ----------------------------------------------------------
+    def run(self) -> None:
+        flight_recorder.emit("serve_replica_start", replica=self.name,
+                             rank=self.rank, slots=self.engine.num_slots)
+        while not self._stop.is_set():
+            self.transport.heartbeat()
+            if self.transport.stopped():
+                break
+            if self.quarantined:
+                time.sleep(0.05)
+                continue
+            try:
+                self._iterate()
+            except WorkersDownError:
+                # elastic membership change mid-step: nothing is lost —
+                # the pulled work returns to the queue before the
+                # elastic driver re-forms us
+                requeued = self.transport.requeue_all()
+                requeued += len(self.batcher.evict_all())
+                requeued += len(self.batcher.drain_waiting())
+                flight_recorder.emit("serve_requeue", replica=self.name,
+                                     rank=self.rank, requeued=requeued)
+                raise
+        flight_recorder.emit("serve_replica_stop", replica=self.name,
+                             rank=self.rank, completed=self.completed)
+
+    def _iterate(self) -> None:
+        now = time.monotonic()
+        free = self.engine.num_slots - self.batcher.occupancy()
+        if free > 0 or self.batcher.waiting() == 0:
+            for req in self.transport.pull(max(free, 1)):
+                self.batcher.offer(req, now)
+        _QUEUE_DEPTH.labels(replica=self.name).set(
+            self.batcher.waiting() + self.transport.depth())
+
+        if self.batcher.admission_due(now):
+            for active in self.batcher.admit(now):
+                token, max_abs = self.engine.prefill(
+                    active.slot, active.request.prompt)
+                if not self._guard_ok(max_abs):
+                    self._quarantine("non-finite prefill logits")
+                    return
+                active.generated.append(token)
+                active.first_token_s = time.monotonic()
+                _TOKENS.labels(kind="prefill").inc(active.prompt_len)
+                _LATENCY.labels(phase="ttft").observe(
+                    active.first_token_s - active.request.submitted_s)
+            for done in self.batcher.retire_done():  # max_new_tokens == 1
+                self._finish(done, time.monotonic())
+
+        slots, tokens, positions = self.batcher.batch_rows()
+        if not slots:
+            _OCCUPANCY.labels(replica=self.name).set(0)
+            time.sleep(_IDLE_SLEEP_SECONDS)
+            return
+
+        # the serving step counter: chaos kills aim at decode step N
+        self.decode_iterations += 1
+        fault_inject.maybe_inject(self.decode_iterations)
+        ids, max_abs = self.engine.decode(slots, tokens, positions)
+        if not all(self._guard_ok(m) for m in max_abs):
+            self._quarantine("non-finite decode logits")
+            return
+        by_slot = {a.slot: a for a in self.batcher.active()}
+        for slot, token in zip(slots, ids):
+            active = by_slot[slot]
+            active.generated.append(token)
+            active.position += 1
+        occupancy = len(slots)
+        self.occupancy_sum += occupancy
+        _TOKENS.labels(kind="decode").inc(occupancy)
+        _OCCUPANCY.labels(replica=self.name).set(occupancy)
+        _OCCUPANCY_HIST.observe(occupancy)
+        self.batcher.note_step()
+        now = time.monotonic()
+        for done in self.batcher.retire_done():
+            self._finish(done, now)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        steps = max(self.engine.decode_steps, 1)
+        return {"name": self.name, "rank": self.rank,
+                "quarantined": self.quarantined,
+                "completed": self.completed,
+                "active": self.batcher.occupancy(),
+                "waiting": self.batcher.waiting(),
+                "decode_steps": self.engine.decode_steps,
+                "avg_occupancy": round(self.occupancy_sum / steps, 3),
+                "engine": self.engine.stats()}
+
+
+def run_kv_replica(model, params, policy, rank: int, addr: str, port: int,
+                   guard=None) -> Replica:
+    """Blocking entrypoint for a cross-process replica (``tpurun
+    --serve`` workers, the chaos matrix): serve from the rendezvous KV
+    queue until the frontend publishes the stop key."""
+    from horovod_tpu.run.rendezvous import KVStoreClient
+
+    client = KVStoreClient(addr, port, scope="serve", timeout=10.0)
+    engine = DecodeEngine(model, params, num_slots=policy.slots,
+                          name=f"r{rank}")
+    transport = _KVTransport(KVQueueReplica(client, rank))
+    replica = Replica(engine, transport, policy, rank=rank, guard=guard)
+    transport.heartbeat()
+    replica.run()
+    return replica

@@ -106,6 +106,11 @@ ENV_DIRECT_KNOBS = (
     "HOROVOD_INTEGRITY", "HOROVOD_INTEGRITY_INTERVAL",
     "HOROVOD_INTEGRITY_SPIKE_SIGMA", "HOROVOD_INTEGRITY_SKIP_STEPS",
     "HOROVOD_INTEGRITY_QUARANTINE", "HOROVOD_ROLLBACK_BUDGET",
+    # online serving plane (serve/; docs/inference.md)
+    "HOROVOD_SERVE_MAX_BATCH_TOKENS", "HOROVOD_SERVE_ADMISSION_MS",
+    "HOROVOD_SERVE_QUEUE_CAPACITY", "HOROVOD_SERVE_DECODE_BLOCK",
+    "HOROVOD_SERVE_SLOTS", "HOROVOD_SERVE_MAX_NEW_TOKENS",
+    "HOROVOD_SERVE_QUARANTINE",
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379

@@ -186,3 +186,23 @@ def test_bench_compare_usage_errors(bench_compare, tmp_path):
     bad.write_text("not json")
     good = _artifact(tmp_path / "good.json", [_BASE_ROW])
     assert bench_compare.main([str(bad), good]) == 2
+
+
+def test_serve_suite_tiny(bench, capsys):
+    """PR 11 acceptance shape: ``bench.py --serve --tiny`` sustains
+    Poisson traffic on 2 in-process replicas with batch occupancy > 1,
+    compiles NOTHING after the per-bucket warmup, and reports the
+    serving headline as one JSON line."""
+    result = bench.serve_main(tiny=True)
+    assert result["tiny"] is True
+    assert result["unit"] == "tokens/sec/chip"
+    assert result["value"] > 0
+    assert result["replicas"] == 2
+    assert result["requests"] == 16
+    assert result["avg_batch_occupancy"] > 1.0
+    assert result["steady_state_compiles"] == 0
+    assert result["warmup_compiles"] > 0
+    assert result["p99_latency_ms"] >= result["p50_latency_ms"] > 0
+    assert result["p99_ttft_ms"] >= result["p50_ttft_ms"] > 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["value"] == result["value"]

@@ -1,0 +1,478 @@
+"""Unit coverage for the serving plane (serve/; docs/inference.md).
+
+Four pinned-down contracts:
+
+* the continuous batcher's admission policy matrix — token budget as a
+  hard cap, slots, deadline-beats-decode-block — on a fake clock (the
+  batcher never touches jax, so this is pure scheduling);
+* the shared request queue's zero-lost invariant: worker loss returns
+  in-flight requests to the FRONT of the line, oldest first, and the
+  first completion writer wins;
+* the KV-cache engine: prefill + per-token decode must be
+  token-for-token identical to greedy generation through the uncached
+  ``apply`` (padded prefill garbage and stale slot-reuse rows are
+  unreachable by construction), with zero steady-state compiles;
+* replica integrity: a NaN logit quarantines the replica and requeues
+  its work; ``WorkersDownError`` requeues and re-raises.
+
+The multiprocess half (kill-a-replica-under-load) lives in
+tests/test_serve_multiprocess.py.
+"""
+
+import math
+import time
+
+import pytest
+
+from horovod_tpu.exceptions import WorkersDownError
+from horovod_tpu.serve.batcher import ContinuousBatcher
+from horovod_tpu.serve.queue import (KVQueueFrontend, KVQueueReplica,
+                                     QueueFull, Completion, Request,
+                                     RequestQueue)
+
+
+def _req(uid, prompt_len=8, max_new=4):
+    return Request(uid=uid, prompt=list(range(1, prompt_len + 1)),
+                   max_new_tokens=max_new, submitted_s=0.0)
+
+
+# ---------------------------------------------------------------- batcher
+
+class TestBatcherPolicy:
+    def _batcher(self, slots=4, budget=10_000, admission_ms=50.0,
+                 block=8):
+        return ContinuousBatcher(num_slots=slots, max_batch_tokens=budget,
+                                 admission_ms=admission_ms,
+                                 decode_block=block)
+
+    def test_idle_replica_admits_immediately(self):
+        b = self._batcher()
+        assert not b.admission_due(0.0)          # nothing waiting
+        b.offer(_req("a"), now=0.0)
+        assert b.admission_due(0.0)              # idle: no block to honor
+        assert [a.request.uid for a in b.admit(0.0)] == ["a"]
+        assert b.occupancy() == 1 and b.waiting() == 0
+
+    def test_token_budget_is_a_hard_cap(self):
+        # each request commits prompt(8) + max_new(4) = 12 tokens
+        b = self._batcher(budget=25, admission_ms=50.0)
+        for uid in ("a", "b", "c"):
+            b.offer(_req(uid), now=0.0)
+        admitted = b.admit(0.0)
+        assert [a.request.uid for a in admitted] == ["a", "b"]
+        assert b.committed_tokens() == 24
+        # the deadline fires but must NOT override the budget
+        assert b.admission_due(9.0)
+        assert b.admit(9.0) == []
+        # a retired request frees budget; the head then admits
+        b.active()[0].generated.extend([1, 2, 3, 4])
+        assert [a.request.uid for a in b.retire_done()] == ["a"]
+        assert [a.request.uid for a in b.admit(9.0)] == ["c"]
+
+    def test_budget_blocked_head_blocks_younger(self):
+        # FIFO no-starvation: the big head does not let the small
+        # request behind it jump the line
+        b = self._batcher(budget=20)
+        b.offer(_req("big", prompt_len=30, max_new=4), now=0.0)
+        b.offer(_req("small", prompt_len=2, max_new=4), now=0.0)
+        assert b.admit(0.0) == []
+        assert b.waiting() == 2
+
+    def test_deadline_beats_decode_block(self):
+        b = self._batcher(admission_ms=50.0, block=1000)
+        b.offer(_req("a"), now=0.0)
+        b.admit(0.0)
+        b.offer(_req("b"), now=1.0)
+        assert not b.admission_due(1.04)     # young + mid-block
+        assert b.admission_due(1.051)        # deadline pulls it forward
+
+    def test_decode_block_boundary(self):
+        b = self._batcher(slots=1, admission_ms=1e9, block=3)
+        b.offer(_req("a", max_new=100), now=0.0)
+        b.admit(0.0)
+        b.offer(_req("b"), now=0.0)
+        for _ in range(2):
+            assert not b.admission_due(0.0)
+            b.note_step()
+        b.note_step()
+        assert b.admission_due(0.0)
+        b.admit(0.0)                         # slot full: admits nothing,
+        assert b.occupancy() == 1            # but resets the block count
+        assert not b.admission_due(0.0)
+
+    def test_slots_cap(self):
+        b = self._batcher(slots=2)
+        for uid in ("a", "b", "c"):
+            b.offer(_req(uid), now=0.0)
+        assert len(b.admit(0.0)) == 2
+        assert b.waiting() == 1
+
+    def test_batch_rows_retire_evict_drain(self):
+        b = self._batcher(slots=2)
+        b.offer(_req("a", prompt_len=3, max_new=2), now=0.0)
+        b.offer(_req("b", prompt_len=5, max_new=9), now=0.0)
+        b.offer(_req("c"), now=0.0)
+        b.admit(0.0)
+        # right after prefill-less admit: last prompt token, position
+        # = prompt_len (where the next token writes)
+        slots, tokens, positions = b.batch_rows()
+        assert slots == [0, 1] and tokens == [3, 5] and positions == [3, 5]
+        a = b.active()[0]
+        a.generated.extend([7, 8])
+        a.position += 2
+        assert [d.request.uid for d in b.retire_done()] == ["a"]
+        slots, tokens, positions = b.batch_rows()
+        assert slots == [1] and tokens == [5]
+        assert [r.uid for r in b.evict_all()] == ["b"]
+        assert [r.uid for r in b.drain_waiting()] == ["c"]
+        assert b.occupancy() == 0 and b.waiting() == 0
+        assert len(b.admit(0.0)) == 0        # everything really drained
+
+
+# ------------------------------------------------------------------ queue
+
+class TestRequestQueue:
+    def test_submit_pull_complete_result(self):
+        q = RequestQueue()
+        uid = q.submit([1, 2, 3], max_new_tokens=4)
+        assert q.try_result(uid) is None
+        (req,) = q.pull(rank=0, max_n=8)
+        assert req.uid == uid and q.depth() == 0
+        q.complete(Completion(uid=uid, tokens=[9], prompt_len=3, rank=0))
+        assert q.result(uid, timeout=1.0).tokens == [9]
+        assert q.stats()["inflight"] == 0
+
+    def test_requeue_worker_front_oldest_first(self):
+        q = RequestQueue()
+        uids = [q.submit([i], max_new_tokens=1) for i in range(3)]
+        later = q.submit([9], max_new_tokens=1)
+        pulled = q.pull(rank=0, max_n=3)
+        assert [r.uid for r in pulled] == uids
+        assert q.requeue_worker(0) == 3
+        # stranded requests go back to the FRONT, oldest first — ahead
+        # of the younger request that was never pulled
+        assert [r.uid for r in q.pull(rank=1, max_n=10)] == uids + [later]
+        assert q.requeue_worker(0) == 0
+        assert q.stats()["requeued"] == 3
+
+    def test_first_completion_wins(self):
+        q = RequestQueue()
+        uid = q.submit([1], max_new_tokens=1)
+        q.pull(rank=0, max_n=1)
+        q.complete(Completion(uid=uid, tokens=[1], prompt_len=1, rank=0))
+        q.complete(Completion(uid=uid, tokens=[2], prompt_len=1, rank=1))
+        assert q.result(uid).rank == 0       # duplicate reply discarded
+
+    def test_capacity_and_timeout(self):
+        q = RequestQueue(capacity=1)
+        q.submit([1], max_new_tokens=1)
+        with pytest.raises(QueueFull):
+            q.submit([2], max_new_tokens=1)
+        with pytest.raises(TimeoutError):
+            q.result("nope", timeout=0.05)
+
+
+# ---------------------------------------------------------- prompt buckets
+
+def test_prompt_bucket_policy():
+    from horovod_tpu.serve.kv_cache import prompt_bucket
+
+    # floored at the quantum: every short prompt shares ONE program
+    assert prompt_bucket(1, 128) == 16
+    assert prompt_bucket(16, 128) == 16
+    assert prompt_bucket(17, 128) > 16
+    for length in range(1, 129):
+        b = prompt_bucket(length, 128)
+        assert length <= b <= 128 or b == 128
+    # O(log(max_seq)) distinct buckets → bounded warmup compiles
+    assert len({prompt_bucket(n, 1024) for n in range(1, 1025)}) <= 8
+
+
+# ------------------------------------------------------------------ engine
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import Transformer
+
+    model = Transformer(vocab_size=61, d_model=32, num_layers=2,
+                        num_heads=2, d_ff=64, max_seq=48, causal=True,
+                        dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    return model, params
+
+
+def _uncached_greedy(model, params, prompt, n):
+    """Reference: full (cache-free) forward per token, greedy argmax."""
+    import jax.numpy as jnp
+
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = model.apply({"params": params},
+                             jnp.asarray([toks], jnp.int32), train=False)
+        out.append(int(jnp.argmax(logits[0, len(toks) - 1])))
+        toks.append(out[-1])
+    return out
+
+
+def test_prefill_decode_parity_and_isolation(tiny_lm):
+    """Two concurrent slots (different prompt buckets) each generate
+    token-for-token what the uncached apply generates — proving the
+    cache path, the padded prefill, AND cross-slot isolation at once."""
+    from horovod_tpu.serve.kv_cache import DecodeEngine
+
+    model, params = tiny_lm
+    eng = DecodeEngine(model, params, num_slots=3)
+    prompts = {0: [5, 4, 3, 2, 1], 2: list(range(1, 18))}
+    gen, pos = {}, {}
+    for slot, p in prompts.items():
+        token, max_abs = eng.prefill(slot, p)
+        assert math.isfinite(max_abs)
+        gen[slot] = [token]
+        pos[slot] = len(p)
+    for _ in range(5):
+        slots = sorted(prompts)
+        ids, max_abs = eng.decode(slots, [gen[s][-1] for s in slots],
+                                  [pos[s] for s in slots])
+        assert all(math.isfinite(m) for m in max_abs)
+        for s, t in zip(slots, ids):
+            gen[s].append(t)
+            pos[s] += 1
+    for slot, p in prompts.items():
+        assert gen[slot] == _uncached_greedy(model, params, p, 6), slot
+
+
+def test_slot_reuse_no_stale_leak(tiny_lm):
+    """A short prompt re-using the slot a LONGER request just vacated
+    must generate exactly what it generates in a fresh engine — the
+    previous occupant's stale rows beyond the new prompt are never
+    attendable."""
+    from horovod_tpu.serve.kv_cache import DecodeEngine
+
+    model, params = tiny_lm
+
+    def run(eng, slot, prompt, n):
+        token, _ = eng.prefill(slot, prompt)
+        out, p = [token], len(prompt)
+        for _ in range(n - 1):
+            (t,), _ = eng.decode([slot], [out[-1]], [p])
+            out.append(t)
+            p += 1
+        return out
+
+    used = DecodeEngine(model, params, num_slots=2)
+    run(used, 1, list(range(1, 31)), 8)      # long occupant fills rows
+    fresh = DecodeEngine(model, params, num_slots=2)
+    short = [9, 8, 7, 6]
+    assert run(used, 1, short, 6) == run(fresh, 1, short, 6)
+
+
+def test_zero_steady_state_compiles(tiny_lm):
+    from horovod_tpu.serve.kv_cache import DecodeEngine
+
+    model, params = tiny_lm
+    eng = DecodeEngine(model, params, num_slots=2)
+    eng.prefill(0, [1, 2, 3])
+    eng.decode([0], [1], [3])
+    eng.prefill(1, [4, 5])                   # same bucket: no new program
+    warm = eng.compiles_total()
+    assert warm == 2                          # one prefill bucket + decode
+    for step in range(5):
+        eng.prefill(step % 2, [7, 8, 9])
+        eng.decode([0, 1], [1, 2], [4, 5])
+    assert eng.compiles_total() == warm
+    assert eng.prefill(0, list(range(1, 20)))  # new bucket DOES compile
+    assert eng.compiles_total() == warm + 1
+    assert eng.stats()["decode_steps"] == 6
+
+
+def test_noncausal_model_rejected(tiny_lm):
+    from horovod_tpu.serve.kv_cache import DecodeEngine
+
+    model, params = tiny_lm
+    with pytest.raises(ValueError, match="causal"):
+        DecodeEngine(model.clone(causal=False), params, num_slots=1)
+
+
+# ----------------------------------------------------- replica integrity
+
+class _FakeEngine:
+    """Minimal engine double for the replica loop (no jax)."""
+
+    def __init__(self, num_slots=2, prefill_abs=1.0, decode_abs=1.0,
+                 decode_exc=None):
+        self.num_slots = num_slots
+        self.max_seq = 64
+        self.decode_steps = 0
+        self._prefill_abs = prefill_abs
+        self._decode_abs = decode_abs
+        self._decode_exc = decode_exc
+
+    def prefill(self, slot, prompt):
+        return 1, self._prefill_abs
+
+    def decode(self, slots, tokens, positions):
+        if self._decode_exc is not None:
+            raise self._decode_exc
+        self.decode_steps += 1
+        return [2] * len(slots), [self._decode_abs] * len(slots)
+
+    def compiles_total(self):
+        return 0
+
+    def stats(self):
+        return {"decode_steps": self.decode_steps}
+
+
+def _replica(engine, queue, rank=0):
+    from horovod_tpu.serve.api import ServePolicy
+    from horovod_tpu.serve.replica import Replica, _LocalTransport
+
+    return Replica(engine, _LocalTransport(queue, rank),
+                   ServePolicy(slots=engine.num_slots, max_new_tokens=4,
+                               admission_ms=1.0, decode_block=2),
+                   rank=rank)
+
+
+def test_nan_prefill_quarantines_and_requeues():
+    q = RequestQueue()
+    rep = _replica(_FakeEngine(prefill_abs=float("nan")), q)
+    q.submit([1, 2], max_new_tokens=4)
+    rep._iterate()
+    assert rep.quarantined
+    # zero lost: the request is back in line for another replica
+    assert q.depth() == 1 and q.stats()["requeued"] == 1
+
+def test_nan_decode_quarantines_and_requeues():
+    q = RequestQueue()
+    rep = _replica(_FakeEngine(decode_abs=float("inf")), q)
+    q.submit([1, 2], max_new_tokens=4)
+    rep._iterate()
+    assert rep.quarantined
+    assert q.depth() == 1 and q.stats()["requeued"] == 1
+
+
+def test_workers_down_requeues_and_reraises():
+    q = RequestQueue()
+    rep = _replica(_FakeEngine(decode_exc=WorkersDownError("reform")), q)
+    q.submit([1, 2], max_new_tokens=4)
+    with pytest.raises(WorkersDownError):
+        rep.run()
+    assert not rep.quarantined               # elastic, not integrity
+    assert q.depth() == 1 and q.stats()["requeued"] == 1
+
+
+def test_healthy_replica_completes():
+    q = RequestQueue()
+    rep = _replica(_FakeEngine(), q)
+    uid = q.submit([1, 2], max_new_tokens=3)
+    for _ in range(4):
+        rep._iterate()
+    done = q.result(uid, timeout=1.0)
+    assert done.tokens == [1, 2, 2] and done.rank == 0
+    assert not rep.quarantined and rep.completed == 1
+
+
+# ----------------------------------------------------------- policy / api
+
+def test_policy_from_env_and_overrides(monkeypatch):
+    from horovod_tpu.serve.api import ServePolicy
+
+    monkeypatch.setenv("HOROVOD_SERVE_SLOTS", "3")
+    monkeypatch.setenv("HOROVOD_SERVE_ADMISSION_MS", "12.5")
+    p = ServePolicy.from_env(max_new_tokens=7)
+    assert p.slots == 3 and p.admission_ms == 12.5
+    assert p.max_new_tokens == 7
+    with pytest.raises(TypeError, match="unknown serve policy knob"):
+        ServePolicy.from_env(slotz=3)
+
+
+class _Tokenizer:
+    def encode(self, text):
+        return [ord(c) % 50 + 1 for c in text]
+
+
+def test_serve_end_to_end_in_process(tiny_lm):
+    import horovod_tpu as hvd
+    from horovod_tpu.serve import serve_state
+
+    model, params = tiny_lm
+    with hvd.serve(model, params, tokenizer=_Tokenizer(), replicas=2,
+                   slots=2, max_new_tokens=5, admission_ms=5.0,
+                   decode_block=2) as handle:
+        assert serve_state()["count"] == 1   # the /serve route sees us
+        uids = [handle.submit([1 + i, 2, 3]) for i in range(6)]
+        uids.append(handle.submit("hi"))     # tokenizer path
+        outs = [handle.result(u, timeout=120.0) for u in uids]
+        assert all(len(o.tokens) == 5 for o in outs)
+        assert all(0 <= t < model.vocab_size
+                   for o in outs for t in o.tokens)
+        assert all(o.latency_s >= o.ttft_s >= 0.0 for o in outs)
+        # parity with the uncached reference through the full stack
+        assert outs[0].tokens == _uncached_greedy(
+            model, params, [1, 2, 3], 5)
+        # per replica: one prompt bucket + the decode program, at most
+        assert handle.compiles_total() <= 4
+        stats = handle.stats()
+        assert stats["queue"]["completed"] == 7
+    assert serve_state()["count"] == 0
+
+
+# --------------------------------------------------------- KV transport
+
+def test_kv_frontend_redispatches_dead_replica():
+    """Single-process version of the chaos cell's queue semantics: a
+    replica that pulled work and went silent is declared dead after the
+    stale window and its request re-dispatched to a live replica; the
+    late duplicate reply (if any) is deduplicated first-wins."""
+    from horovod_tpu.run.rendezvous import KVStoreClient, RendezvousServer
+
+    server = RendezvousServer(host="127.0.0.1")
+    port = server.start()
+    try:
+        def client():
+            return KVStoreClient("127.0.0.1", port, scope="serve",
+                                 timeout=5.0)
+
+        front = KVQueueFrontend(client(), stale_seconds=0.4)
+        dead = KVQueueReplica(client(), rank=1)
+        live = KVQueueReplica(client(), rank=2)
+        dead.heartbeat()
+        live.heartbeat()
+        assert front.wait_for_replicas(2, timeout=5.0) == [1, 2]
+
+        req = Request(uid="r1", prompt=[1, 2, 3], max_new_tokens=2)
+        assert front.submit(req, rank=1) == 1
+        (got,) = dead.poll(4)
+        assert got.uid == "r1"               # pulled... then rank 1 dies
+        deadline = time.monotonic() + 5.0
+        while 1 in front.live_replicas() and time.monotonic() < deadline:
+            live.heartbeat()
+            time.sleep(0.05)
+        assert front.live_replicas() == [2]
+        assert front.poll_responses() == []  # triggers the re-dispatch
+        assert front.requeued == 1 and front.dead_ranks == {1}
+        (redis,) = live.poll(4)
+        assert redis.uid == "r1"
+        live.complete(Completion(uid="r1", tokens=[5, 6], prompt_len=3,
+                                 rank=2))
+        deadline = time.monotonic() + 5.0
+        while front.pending() and time.monotonic() < deadline:
+            front.poll_responses()
+            time.sleep(0.02)
+        assert front.pending() == 0
+        assert front._done["r1"].rank == 2
+        # a zombie reply from the dead rank arrives late: first wins
+        dead.complete(Completion(uid="r1", tokens=[9, 9], prompt_len=3,
+                                 rank=1))
+        assert front.poll_responses() == []
+        assert front._done["r1"].rank == 2
+        front.stop_fleet()
+        assert dead.stopped() and live.stopped()
+    finally:
+        server.stop()

@@ -1,0 +1,48 @@
+"""Serving-plane acceptance with real worker processes (ISSUE 11).
+
+Repeats the chaos matrix's ``serve_kill_replica`` cell fast-tier: three
+replica processes serve a KV-queue fleet, rank 2 is killed at its 5th
+decode step mid-generation, and the cell passes only if the survivors
+absorb the traffic with ZERO lost requests, the dead replica's
+in-flight work was really redistributed, and the merged flight-recorder
+postmortem names the dead rank.
+
+Unlike the training cells this needs no native transport — the serving
+plane rides the rendezvous HTTP KV store alone — so the cell runs (and
+the invariant holds) on any host that can spawn processes.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.chaos_matrix import SCENARIOS, run_scenario  # noqa: E402
+
+
+def test_serve_kill_replica_cell():
+    result = run_scenario("serve_kill_replica",
+                          SCENARIOS["serve_kill_replica"])
+    assert result["ok"], json.dumps(result, indent=2)
+
+    frontend = result["results"][0]
+    assert frontend["zero_lost"]
+    assert frontend["completed"] == frontend["submitted"]
+    # the kill landed mid-generation: work really moved, and the victim
+    # (16 tokens per request, dead at decode step 5) completed nothing
+    assert frontend["requeued"] > 0
+    # the victim is declared dead; a survivor may ALSO appear here
+    # transiently (its first prefill compile can outlast the heartbeat
+    # stale window) — that only causes a deduplicated re-dispatch
+    assert 2 in frontend["dead_ranks"]
+    assert 2 not in frontend["served_by"]
+    assert len(frontend["served_by"]) >= 1
+    assert result["exit_codes"][2] == 21      # the injected exit code
+    # postmortem culprit attribution (require_culprit already enforced
+    # inside run_scenario; pin the cell's config against drift too)
+    spec = SCENARIOS["serve_kill_replica"]
+    assert spec["require_culprit"] == 2
+    assert spec["require_true"] == ["zero_lost", "requeued"]

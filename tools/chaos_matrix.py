@@ -53,6 +53,17 @@ Numerical-integrity scenarios (ISSUE 10; docs/integrity.md):
   skips that step in lockstep (one retry, nothing applied or
   committed) and training converges to the exact final weights.
 
+Serving-plane scenario (ISSUE 11; docs/inference.md):
+
+* ``serve_kill_replica`` — rank 0 drives Poisson-ish load through a
+  :class:`KVQueueFrontend` at three serving replicas; rank 2 is killed
+  at its 5th decode step, mid-generation. The survivors absorb the
+  traffic (the frontend re-dispatches on the lapsed heartbeat), every
+  submitted request completes (``zero_lost``), the redistribution
+  really happened (``requeued`` nonzero), and the postmortem names the
+  dead rank. Needs no native transport — the serving plane rides the
+  rendezvous KV store alone.
+
 Usage: python tools/chaos_matrix.py [--only NAME] [--json PATH]
 """
 
@@ -164,6 +175,22 @@ SCENARIOS = {
         "require_true": ["steps_ok", "moments_nonzero",
                          "moments_uniform", "replica_restored"],
         "ckpt_verify": "manifest",
+        "timeout": 240,
+    },
+    "serve_kill_replica": {
+        "world": 4,   # rank 0 = frontend/loadgen, ranks 1-3 = replicas
+        "worker": "serve_chaos_worker.py",
+        "env": {
+            "HOROVOD_FAULT_INJECT": "kill:rank=2:step=5:code=21",
+            "HOROVOD_SERVE_SLOTS": "4",
+            "HOROVOD_SERVE_MAX_NEW_TOKENS": "16",
+            "HOROVOD_SERVE_DECODE_BLOCK": "4",
+            "HOROVOD_SERVE_ADMISSION_MS": "10",
+        },
+        "expected_exit": {2: 21},
+        "check_w": False,
+        "require_true": ["zero_lost", "requeued"],
+        "require_culprit": 2,
         "timeout": 240,
     },
     "integrity_bitflip_rollback": {

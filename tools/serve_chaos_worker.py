@@ -1,0 +1,138 @@
+"""Worker script for the serving-plane chaos cell
+(``serve_kill_replica`` in tools/chaos_matrix.py).
+
+Rank 0 is the FRONTEND/load generator: it drives a
+:class:`~horovod_tpu.serve.queue.KVQueueFrontend` against the matrix's
+rendezvous store, submits ``CHAOS_SERVE_REQUESTS`` generation requests
+round-robin across the replica fleet, and keeps polling until every
+request completes — re-dispatching the un-answered requests of any
+replica whose heartbeat lapses. It is the only rank that prints
+``CHAOS_RESULT``; the invariants the matrix asserts:
+
+* ``zero_lost`` — every submitted request completed, despite the kill;
+* ``requeued``  — the dead replica's in-flight requests really were
+  redistributed (nonzero), not silently never-assigned.
+
+Ranks >= 1 are serving replicas: each builds the same tiny
+deterministic transformer (seed 0 — replicas must agree on params) and
+runs :func:`~horovod_tpu.serve.replica.run_kv_replica` until rank 0
+publishes the stop key. ``HOROVOD_FAULT_INJECT=kill:rank=2:step=5``
+fires on the victim's 5th DECODE step (the serving step counter), so
+the kill lands mid-generation with work in flight. No ``hvd.init()``
+anywhere — the serving plane rides the KV store alone, which is itself
+part of what the cell proves.
+"""
+
+import json
+import os
+import sys
+import time
+import uuid
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_REQUESTS = int(os.environ.get("CHAOS_SERVE_REQUESTS", "30"))
+DRAIN_TIMEOUT = float(os.environ.get("CHAOS_SERVE_TIMEOUT", "150"))
+
+MODEL = dict(vocab_size=64, d_model=32, num_layers=2, num_heads=2,
+             d_ff=64, max_seq=64, causal=True)
+
+
+def _metric_total(snap, name):
+    fam = snap.get(name, {})
+    return float(sum(row.get("value", 0.0)
+                     for row in fam.get("values", ())))
+
+
+def run_replica(rank, addr, port) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import Transformer
+    from horovod_tpu.serve import ServePolicy
+    from horovod_tpu.serve.api import _serve_guard
+    from horovod_tpu.serve.replica import run_kv_replica
+
+    model = Transformer(dtype=jnp.float32, **MODEL)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+    policy = ServePolicy.from_env()
+    guard = _serve_guard(rank) if policy.quarantine else None
+    replica = run_kv_replica(model, params, policy, rank=rank,
+                             addr=addr, port=port, guard=guard)
+    print(f"serve_chaos_worker: rank {rank} drained "
+          f"({replica.completed} completed)", flush=True)
+    return 0
+
+
+def run_frontend(world, addr, port) -> int:
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import flight_recorder
+    from horovod_tpu.run.rendezvous import KVStoreClient
+    from horovod_tpu.serve.queue import KVQueueFrontend, Request
+
+    replicas = world - 1
+    client = KVStoreClient(addr, port, scope="serve", timeout=10.0)
+    frontend = KVQueueFrontend(client)
+    live = frontend.wait_for_replicas(replicas, timeout=60.0)
+    print(f"serve_chaos_worker: fleet up: {live}", flush=True)
+
+    rng = np.random.RandomState(0)
+    max_new = int(os.environ.get("HOROVOD_SERVE_MAX_NEW_TOKENS", "16"))
+    for i in range(N_REQUESTS):
+        prompt_len = int(rng.randint(4, 13))
+        prompt = rng.randint(1, MODEL["vocab_size"], prompt_len).tolist()
+        frontend.submit(Request(uid=f"req-{i}-{uuid.uuid4().hex[:8]}",
+                                prompt=prompt, max_new_tokens=max_new))
+
+    completions = []
+    deadline = time.monotonic() + DRAIN_TIMEOUT
+    while frontend.pending() and time.monotonic() < deadline:
+        completions.extend(frontend.poll_responses())
+        time.sleep(0.05)
+    frontend.stop_fleet()
+
+    done = len(completions)
+    zero_lost = done == N_REQUESTS and frontend.pending() == 0
+    served_by = sorted({c.rank for c in completions})
+    snap = hvd.metrics()
+    result = {
+        "rank": 0,
+        "size": world,
+        "generation": 0,
+        "submitted": N_REQUESTS,
+        "completed": done,
+        "zero_lost": zero_lost,
+        "requeued": frontend.requeued,
+        "dead_ranks": sorted(frontend.dead_ranks),
+        "served_by": served_by,
+        "net_retries_total": _metric_total(
+            snap, "horovod_net_retries_total"),
+        "chaos_injected_total": _metric_total(
+            snap, "horovod_net_chaos_injected_total"),
+    }
+    try:  # ship rank 0's dispatch/requeue events into the postmortem
+        flight_recorder.dump_debug_state(reason="serve_chaos_complete")
+    except Exception:
+        pass
+    print("CHAOS_RESULT " + json.dumps(result), flush=True)
+    return 0 if zero_lost else 3
+
+
+def main() -> int:
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    world = int(os.environ.get("HOROVOD_SIZE", "4"))
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_HTTP_ADDR", "127.0.0.1")
+    port = int(os.environ.get("HOROVOD_RENDEZVOUS_HTTP_PORT", "0"))
+    if not port:
+        print("serve_chaos_worker: no rendezvous port", file=sys.stderr)
+        return 2
+    if rank == 0:
+        return run_frontend(world, addr, port)
+    return run_replica(rank, addr, port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
