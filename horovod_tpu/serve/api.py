@@ -114,6 +114,8 @@ class ServeHandle:
         self._queue = queue
         self._policy = policy
         self._tokenizer = tokenizer
+        self._max_seq = min((r.engine.max_seq for r in replicas),
+                            default=0)
         self._threads: List[threading.Thread] = []
         self._closed = False
         self.started_s = time.monotonic()
@@ -128,15 +130,30 @@ class ServeHandle:
     # -- request API -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None) -> str:
         """Enqueue a prompt (token-id list, or text when a tokenizer was
-        given); returns the request id."""
+        given); returns the request id.
+
+        Raises :class:`ValueError` for a prompt the replicas could
+        never serve (empty, or longer than the model's ``max_seq``) —
+        admission would otherwise fail deep inside a replica thread and
+        the caller would hang in :meth:`result` until timeout. A prompt
+        that FITS but whose ``prompt + max_new_tokens`` overruns the KV
+        cache is accepted and truncated (``finish="cache_limit"`` on the
+        completion)."""
         if self._closed:
             raise RuntimeError(
                 "serve handle is closed; nothing would ever complete "
                 "this request")
         if self._tokenizer is not None and isinstance(prompt, str):
             prompt = list(self._tokenizer.encode(prompt))
+        prompt = list(prompt)
+        if not prompt:
+            raise ValueError("serve: empty prompt")
+        if self._max_seq and len(prompt) > self._max_seq:
+            raise ValueError(
+                f"serve: prompt length {len(prompt)} exceeds the "
+                f"model's max_seq ({self._max_seq})")
         return self._queue.submit(
-            list(prompt),
+            prompt,
             max_new_tokens=(self._policy.max_new_tokens
                             if max_new_tokens is None else max_new_tokens))
 

@@ -9,11 +9,13 @@ Admission policy, in priority order:
 
 1. **Token budget is a hard cap.** A candidate is admitted only if the
    committed token total — every active slot's ``prompt_len +
-   max_new_tokens`` plus the candidate's — stays within
+   max_tokens`` plus the candidate's — stays within
    ``HOROVOD_SERVE_MAX_BATCH_TOKENS``. Committed (worst-case) rather
    than current lengths, so an admitted request can never be evicted
    mid-generation by later admissions. The admission deadline never
-   overrides the budget.
+   overrides the budget. ``max_tokens`` is ``max_new_tokens`` capped at
+   admission so no KV write can land past the cache length (the request
+   then finishes with ``finish="cache_limit"``).
 2. **Slots.** At most ``HOROVOD_SERVE_SLOTS`` concurrent requests (one
    KV-cache row each).
 3. **Deadline beats the decode block.** Between admission checks the
@@ -41,34 +43,51 @@ from horovod_tpu.serve.queue import Request
 
 @dataclasses.dataclass
 class ActiveRequest:
-    """One occupied KV-cache slot."""
+    """One occupied KV-cache slot. ``max_tokens`` is the EFFECTIVE
+    generation length: the request's ``max_new_tokens``, capped at
+    admission so every KV write stays inside the cache
+    (``prompt_len + max_tokens - 1 <= max_seq`` — the last generated
+    token is returned, never written). Without the cap, positions past
+    ``max_seq`` would silently clamp onto the last cache row and the
+    request would complete with garbage tokens."""
 
     slot: int
     request: Request
     prompt_len: int
     position: int            # absolute index the NEXT token writes at
+    max_tokens: int = 0      # 0 → request.max_new_tokens (uncapped)
     generated: List[int] = dataclasses.field(default_factory=list)
     first_token_s: float = 0.0
     admitted_s: float = 0.0
 
+    def __post_init__(self):
+        if self.max_tokens <= 0:
+            self.max_tokens = self.request.max_new_tokens
+
+    @property
+    def capped(self) -> bool:
+        return self.max_tokens < self.request.max_new_tokens
+
     @property
     def committed_tokens(self) -> int:
-        return self.prompt_len + self.request.max_new_tokens
+        return self.prompt_len + self.max_tokens
 
     @property
     def done(self) -> bool:
-        return len(self.generated) >= self.request.max_new_tokens
+        return len(self.generated) >= self.max_tokens
 
 
 class ContinuousBatcher:
     """Slot assignment + admission timing for one replica."""
 
     def __init__(self, num_slots: int, max_batch_tokens: int,
-                 admission_ms: float, decode_block: int):
+                 admission_ms: float, decode_block: int,
+                 max_seq: Optional[int] = None):
         self.num_slots = num_slots
         self.max_batch_tokens = max_batch_tokens
         self.admission_s = admission_ms / 1000.0
         self.decode_block = max(1, decode_block)
+        self.max_seq = max_seq   # cache length; None → no generation cap
         # guarded-by: <replica-thread>
         self._waiting: deque = deque()   # (Request, offered_monotonic)
         self._active: Dict[int, ActiveRequest] = {}
@@ -122,7 +141,13 @@ class ContinuousBatcher:
         budget = self.committed_tokens()
         while self._waiting and self._free:
             req, _ = self._waiting[0]
-            cost = len(req.prompt) + req.max_new_tokens
+            max_tokens = req.max_new_tokens
+            if self.max_seq is not None:
+                # last generated token is returned, never written, so
+                # prompt_len + max_tokens - 1 must fit the cache
+                max_tokens = max(
+                    1, min(max_tokens, self.max_seq - len(req.prompt) + 1))
+            cost = len(req.prompt) + max_tokens
             if budget + cost > self.max_batch_tokens:
                 break   # hard cap — the deadline never overrides it
             self._waiting.popleft()
@@ -130,6 +155,7 @@ class ContinuousBatcher:
             active = ActiveRequest(slot=slot, request=req,
                                    prompt_len=len(req.prompt),
                                    position=len(req.prompt),
+                                   max_tokens=max_tokens,
                                    admitted_s=now)
             self._active[slot] = active
             admitted.append(active)

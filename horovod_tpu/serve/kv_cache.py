@@ -143,6 +143,13 @@ class DecodeEngine:
         """Run the prompt through the bucketed prefill program, filling
         ``slot``'s cache rows. Returns (first generated token id,
         max |logit|) — the first token comes from prefill itself."""
+        if not 0 < len(prompt) <= self.max_seq:
+            # callers (ServeHandle.submit, Replica._reject) screen this
+            # out; fail loudly rather than let the padded copy below
+            # raise an opaque broadcast error inside a replica thread
+            raise ValueError(
+                f"prefill: prompt length {len(prompt)} outside "
+                f"(0, max_seq={self.max_seq}]")
         bucket = prompt_bucket(len(prompt), self.max_seq)
         fn = self._prefill_fns.get(bucket)
         if fn is None:
@@ -168,8 +175,15 @@ class DecodeEngine:
         step_tokens = np.zeros((self.num_slots, 1), np.int32)
         step_pos = np.zeros((self.num_slots,), np.int32)
         for s, t, p in zip(slots, tokens, positions):
+            if p >= self.max_seq:
+                # admission caps max_tokens so no write lands past the
+                # cache (batcher.ActiveRequest); overrunning silently
+                # would overwrite the last KV row and serve garbage
+                raise ValueError(
+                    f"decode: slot {s} position {p} >= max_seq "
+                    f"{self.max_seq} (admission cap violated)")
             step_tokens[s, 0] = t
-            step_pos[s] = min(p, self.max_seq - 1)
+            step_pos[s] = p
         start = time.monotonic()
         self._cache, ids, max_abs = self._decode_fn(
             self._params, self._cache, jnp.asarray(step_tokens),

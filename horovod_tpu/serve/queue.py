@@ -34,6 +34,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.analysis import witness
+from horovod_tpu.utils.env import _get_float
 
 # rendezvous scopes of the cross-process transport
 REQ_SCOPE = "serve.req.{rank}"   # per-replica inbox: key=uid, val=request
@@ -41,9 +42,17 @@ RESP_SCOPE = "serve.resp"        # key=uid, val=completion
 HB_SCOPE = "serve.hb"            # key=str(rank), TTL-listed for liveness
 CTL_SCOPE = "serve.ctl"          # "stop" key drains the fleet
 
-# a replica heartbeats ~4x faster than the frontend declares it dead
+# a replica heartbeats ~4x faster than the frontend declares it dead.
+# Replicas beat from a dedicated thread (replica._KVTransport), NOT the
+# serve loop, so a multi-second blocking step (first-request XLA
+# compiles, large prefills) cannot lapse a healthy replica's liveness.
 HEARTBEAT_SECONDS = 0.5
 STALE_SECONDS = 2.0
+
+# completed results are held for late readers, then evicted — a serving
+# process must not leak memory proportional to total requests served
+HOROVOD_SERVE_RESULT_TTL_S = "HOROVOD_SERVE_RESULT_TTL_S"
+RESULT_TTL_SECONDS = 600.0
 
 
 class QueueFull(RuntimeError):
@@ -101,13 +110,19 @@ class RequestQueue:
     completed results, one lock. No call blocks under the lock — waiters
     poll (:meth:`result`) with short sleeps outside it."""
 
-    def __init__(self, capacity: int = 1024):
+    def __init__(self, capacity: int = 1024,
+                 result_ttl: Optional[float] = None):
         self._lock = witness.make_lock("RequestQueue._lock")
         self._capacity = capacity
+        self._result_ttl = (
+            _get_float(HOROVOD_SERVE_RESULT_TTL_S, RESULT_TTL_SECONDS)
+            if result_ttl is None else result_ttl)
         self._waiting: deque = deque()           # guarded-by: _lock
         self._inflight: Dict[str, Tuple[int, Request]] = {}  # guarded-by: _lock
         self._results: Dict[str, Completion] = {}  # guarded-by: _lock
+        self._expiry: deque = deque()            # (deadline, uid); guarded-by: _lock
         self._submitted = 0                      # guarded-by: _lock
+        self._completed = 0                      # guarded-by: _lock
         self._requeued = 0                       # guarded-by: _lock
 
     def submit(self, prompt: List[int], max_new_tokens: int,
@@ -136,11 +151,22 @@ class RequestQueue:
         return out
 
     def complete(self, completion: Completion) -> None:
+        now = time.monotonic()
         with self._lock:
             self._inflight.pop(completion.uid, None)
             # first writer wins: a requeued duplicate that also finished
             # must not overwrite the reply the caller already saw
-            self._results.setdefault(completion.uid, completion)
+            if completion.uid not in self._results:
+                self._results[completion.uid] = completion
+                self._expiry.append((now + self._result_ttl,
+                                     completion.uid))
+                self._completed += 1
+            # evict results older than the TTL (amortized on the write
+            # path) — without this a long-running serving process leaks
+            # one Completion per request ever served
+            while self._expiry and self._expiry[0][0] <= now:
+                _, uid = self._expiry.popleft()
+                self._results.pop(uid, None)
 
     def requeue_worker(self, rank: int) -> int:
         """Return every request in-flight on ``rank`` to the FRONT of
@@ -183,7 +209,8 @@ class RequestQueue:
         with self._lock:
             return {"waiting": len(self._waiting),
                     "inflight": len(self._inflight),
-                    "completed": len(self._results),
+                    "completed": self._completed,
+                    "results_held": len(self._results),
                     "submitted": self._submitted,
                     "requeued": self._requeued}
 
@@ -208,6 +235,9 @@ class KVQueueReplica:
             keys = self._client.keys(scope=self._scope)
         except Exception:
             return out
+        # taken keys leave the inbox listing when complete() finishes
+        # them — prune the memo so it tracks the inbox, not all history
+        self._taken.intersection_update(keys)
         for key in keys:
             if key in self._taken or len(out) >= max_n:
                 continue
@@ -241,6 +271,11 @@ class KVQueueFrontend:
     """Dispatcher side of the KV transport (runs in the load generator /
     ``hvd.serve`` controller process). Single-owner thread."""
 
+    # dedup memory for late zombie replies: completions already consumed
+    # and finished server-side; bounded so a long-running frontend does
+    # not leak one Completion per request ever served
+    _DONE_MAX = 65536
+
     def __init__(self, client, stale_seconds: float = STALE_SECONDS):
         self._client = client
         self._stale = stale_seconds
@@ -248,6 +283,7 @@ class KVQueueFrontend:
         # guarded-by: <frontend-thread>
         self._assigned: Dict[str, Tuple[int, Request]] = {}
         self._done: Dict[str, Completion] = {}
+        self._done_order: deque = deque()
         self.requeued = 0
         self.dead_ranks: set = set()
 
@@ -284,8 +320,10 @@ class KVQueueFrontend:
         live = set(self.live_replicas())
         if not live:
             return
+        # _assigned holds only unanswered requests (poll_responses drops
+        # an entry the moment its completion is consumed)
         for uid, (rank, req) in list(self._assigned.items()):
-            if rank in live or uid in self._done:
+            if rank in live:
                 continue
             self.dead_ranks.add(rank)
             self.requeued += 1
@@ -308,12 +346,20 @@ class KVQueueFrontend:
                 continue
             done = Completion.from_json(raw)
             self._done[key] = done   # dedup: first reply wins
+            self._done_order.append(key)
+            self._assigned.pop(key, None)
             fresh.append(done)
+            try:  # shrink the response listing; liveness only
+                self._client.finish(key, scope=RESP_SCOPE)
+            except Exception:
+                pass
+        while len(self._done) > self._DONE_MAX:
+            self._done.pop(self._done_order.popleft(), None)
         self._redispatch_dead()
         return fresh
 
     def pending(self) -> int:
-        return len([u for u in self._assigned if u not in self._done])
+        return len(self._assigned)
 
     def stop_fleet(self) -> None:
         self._client.set("stop", b"1", scope=CTL_SCOPE)

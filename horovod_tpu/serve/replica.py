@@ -112,6 +112,14 @@ class _KVTransport:
     quarantine the replica just goes silent — its heartbeat lapses and
     the frontend redistributes everything unanswered.
 
+    Heartbeats come from a dedicated daemon thread, NOT the serve loop:
+    a blocking step longer than STALE_SECONDS (first-request XLA
+    prefill/decode compiles routinely take many seconds) must not make
+    the frontend declare a healthy replica dead and re-dispatch its
+    pending work. The thread only writes one KV key; ``silent`` and the
+    stop event are its whole shared state (single-word flags, read-only
+    here, set by the replica thread).
+
     The serve loop spins at millisecond cadence; every KV op is an HTTP
     round trip, so the inbox poll and the stop-key check are throttled —
     an idle replica costs the rendezvous server ~60 requests/s, not
@@ -122,11 +130,25 @@ class _KVTransport:
 
     def __init__(self, kv: KVQueueReplica):
         self._kv = kv
-        self._last_beat = 0.0
         self._last_poll = 0.0
         self._last_stop_check = 0.0
         self._stopped = False
-        self.silent = False
+        self.silent = False          # set by replica thread on quarantine
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="serve-heartbeat")
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        while True:
+            if not self.silent:
+                try:
+                    self._kv.heartbeat()
+                except Exception as exc:
+                    log.warning("serve: heartbeat failed: %s", exc)
+            if self._hb_stop.wait(HEARTBEAT_SECONDS):
+                return
 
     def pull(self, max_n):
         now = time.monotonic()
@@ -143,13 +165,13 @@ class _KVTransport:
         return 0
 
     def heartbeat(self):
-        now = time.monotonic()
-        if not self.silent and now - self._last_beat >= HEARTBEAT_SECONDS:
-            self._last_beat = now
-            try:
-                self._kv.heartbeat()
-            except Exception as exc:
-                log.warning("serve: heartbeat failed: %s", exc)
+        pass   # the dedicated thread owns liveness
+
+    def shutdown(self) -> None:
+        """Stop heartbeating for good (replica drained or crashed) so
+        the frontend does not keep dispatching to a gone replica."""
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=2 * HEARTBEAT_SECONDS)
 
     def stopped(self) -> bool:
         if self._stopped:
@@ -179,7 +201,8 @@ class Replica:
             num_slots=engine.num_slots,
             max_batch_tokens=policy.max_batch_tokens,
             admission_ms=policy.admission_ms,
-            decode_block=policy.decode_block)
+            decode_block=policy.decode_block,
+            max_seq=engine.max_seq)
         self.guard = guard
         self.quarantined = False
         self.completed = 0
@@ -193,15 +216,30 @@ class Replica:
 
     def _finish(self, active, now: float) -> None:
         req = active.request
+        # "cache_limit" (not "length") when the KV cache, not the
+        # request, bounded the generation — callers must be able to
+        # tell a fulfilled budget from a truncated one
         completion = Completion(
             uid=req.uid, tokens=list(active.generated),
             prompt_len=active.prompt_len, rank=self.rank,
             ttft_s=active.first_token_s - req.submitted_s,
-            latency_s=now - req.submitted_s, finish="length")
+            latency_s=now - req.submitted_s,
+            finish="cache_limit" if active.capped else "length")
         self.transport.complete(completion)
         self.completed += 1
         _REQUESTS.labels(outcome="completed").inc()
         _LATENCY.labels(phase="total").observe(completion.latency_s)
+
+    def _reject(self, req, reason: str) -> None:
+        """Complete an unservable request (empty, or prompt longer than
+        the KV cache) with ``finish="rejected"`` instead of crashing the
+        loop on it or stranding its caller in ``result()``."""
+        self.transport.complete(Completion(
+            uid=req.uid, tokens=[], prompt_len=len(req.prompt),
+            rank=self.rank, finish="rejected"))
+        _REQUESTS.labels(outcome="rejected").inc()
+        log.warning("serve: replica %s rejected request %s (%s)",
+                    self.name, req.uid, reason)
 
     def _quarantine(self, reason: str) -> None:
         """Integrity trip: never serve garbage. Active + waiting work
@@ -256,6 +294,14 @@ class Replica:
                 flight_recorder.emit("serve_requeue", replica=self.name,
                                      rank=self.rank, requeued=requeued)
                 raise
+            except Exception as exc:
+                # anything else must not silently kill the loop thread
+                # and strand its in-flight callers — quarantine instead
+                # (which requeues active + waiting work for the other
+                # replicas / the dispatcher first)
+                log.error("serve: replica %s loop error: %r",
+                          self.name, exc)
+                self._quarantine(f"loop error: {exc!r}")
         flight_recorder.emit("serve_replica_stop", replica=self.name,
                              rank=self.rank, completed=self.completed)
 
@@ -264,7 +310,17 @@ class Replica:
         free = self.engine.num_slots - self.batcher.occupancy()
         if free > 0 or self.batcher.waiting() == 0:
             for req in self.transport.pull(max(free, 1)):
-                self.batcher.offer(req, now)
+                # unservable prompts answer immediately — an oversized
+                # prompt must never reach prefill (where it would blow
+                # up the padded copy) or circulate in requeue forever
+                if not req.prompt:
+                    self._reject(req, "empty prompt")
+                elif len(req.prompt) > self.engine.max_seq:
+                    self._reject(
+                        req, f"prompt length {len(req.prompt)} > "
+                             f"max_seq {self.engine.max_seq}")
+                else:
+                    self.batcher.offer(req, now)
         _QUEUE_DEPTH.labels(replica=self.name).set(
             self.batcher.waiting() + self.transport.depth())
 
@@ -293,7 +349,11 @@ class Replica:
         self.decode_iterations += 1
         fault_inject.maybe_inject(self.decode_iterations)
         ids, max_abs = self.engine.decode(slots, tokens, positions)
-        if not all(self._guard_ok(m) for m in max_abs):
+        # no short-circuit: the guard's EWMA/skip-budget state must see
+        # EVERY slot's observation, not a prefix that stops at the
+        # first failing slot
+        verdicts = [self._guard_ok(m) for m in max_abs]
+        if not all(verdicts):
             self._quarantine("non-finite decode logits")
             return
         by_slot = {a.slot: a for a in self.batcher.active()}
@@ -334,8 +394,14 @@ def run_kv_replica(model, params, policy, rank: int, addr: str, port: int,
     client = KVStoreClient(addr, port, scope="serve", timeout=10.0)
     engine = DecodeEngine(model, params, num_slots=policy.slots,
                           name=f"r{rank}")
+    # the transport's heartbeat thread starts beating here, BEFORE the
+    # first (slow, compiling) prefill can run — registration is not
+    # gated on the serve loop being responsive
     transport = _KVTransport(KVQueueReplica(client, rank))
     replica = Replica(engine, transport, policy, rank=rank, guard=guard)
-    transport.heartbeat()
-    replica.run()
+    try:
+        replica.run()
+    finally:
+        # stop advertising liveness once we are no longer serving
+        transport.shutdown()
     return replica

@@ -110,7 +110,7 @@ ENV_DIRECT_KNOBS = (
     "HOROVOD_SERVE_MAX_BATCH_TOKENS", "HOROVOD_SERVE_ADMISSION_MS",
     "HOROVOD_SERVE_QUEUE_CAPACITY", "HOROVOD_SERVE_DECODE_BLOCK",
     "HOROVOD_SERVE_SLOTS", "HOROVOD_SERVE_MAX_NEW_TOKENS",
-    "HOROVOD_SERVE_QUARANTINE",
+    "HOROVOD_SERVE_QUARANTINE", "HOROVOD_SERVE_RESULT_TTL_S",
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379

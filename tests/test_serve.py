@@ -20,6 +20,7 @@ tests/test_serve_multiprocess.py.
 """
 
 import math
+import threading
 import time
 
 import pytest
@@ -100,6 +101,24 @@ class TestBatcherPolicy:
         assert b.occupancy() == 1            # but resets the block count
         assert not b.admission_due(0.0)
 
+    def test_admission_caps_generation_to_cache(self):
+        # prompt(12) + max_new(10) overruns max_seq=16: the effective
+        # generation length is capped at admission (the last token is
+        # returned, never written, hence the +1) — never silently
+        # clamped onto the last KV row mid-decode
+        b = ContinuousBatcher(num_slots=2, max_batch_tokens=10_000,
+                              admission_ms=50.0, decode_block=8,
+                              max_seq=16)
+        b.offer(_req("a", prompt_len=12, max_new=10), now=0.0)
+        b.offer(_req("b", prompt_len=4, max_new=10), now=0.0)
+        capped, fits = b.admit(0.0)
+        assert capped.max_tokens == 5 and capped.capped
+        assert fits.max_tokens == 10 and not fits.capped
+        # the budget charges the EFFECTIVE commitment, not the asked-for
+        assert b.committed_tokens() == (12 + 5) + (4 + 10)
+        capped.generated.extend([1] * 5)
+        assert capped.done                   # done at the cap
+
     def test_slots_cap(self):
         b = self._batcher(slots=2)
         for uid in ("a", "b", "c"):
@@ -162,6 +181,24 @@ class TestRequestQueue:
         q.complete(Completion(uid=uid, tokens=[1], prompt_len=1, rank=0))
         q.complete(Completion(uid=uid, tokens=[2], prompt_len=1, rank=1))
         assert q.result(uid).rank == 0       # duplicate reply discarded
+
+    def test_results_evicted_after_ttl(self):
+        # a serving process must not hold one Completion per request
+        # ever served; eviction is amortized on the complete() path
+        q = RequestQueue(result_ttl=0.05)
+        uid = q.submit([1], max_new_tokens=1)
+        q.pull(rank=0, max_n=1)
+        q.complete(Completion(uid=uid, tokens=[1], prompt_len=1, rank=0))
+        assert q.result(uid, timeout=1.0).tokens == [1]
+        time.sleep(0.06)
+        uid2 = q.submit([2], max_new_tokens=1)
+        q.pull(rank=0, max_n=1)
+        q.complete(Completion(uid=uid2, tokens=[2], prompt_len=1, rank=0))
+        assert q.try_result(uid) is None          # evicted
+        assert q.try_result(uid2) is not None     # fresh result kept
+        stats = q.stats()
+        assert stats["completed"] == 2            # counter, not dict size
+        assert stats["results_held"] == 1
 
     def test_capacity_and_timeout(self):
         q = RequestQueue(capacity=1)
@@ -320,7 +357,10 @@ class _FakeEngine:
         if self._decode_exc is not None:
             raise self._decode_exc
         self.decode_steps += 1
-        return [2] * len(slots), [self._decode_abs] * len(slots)
+        abs_ = (list(self._decode_abs)
+                if isinstance(self._decode_abs, (list, tuple))
+                else [self._decode_abs] * len(slots))
+        return [2] * len(slots), abs_[:len(slots)]
 
     def compiles_total(self):
         return 0
@@ -367,6 +407,59 @@ def test_workers_down_requeues_and_reraises():
     assert q.depth() == 1 and q.stats()["requeued"] == 1
 
 
+def test_replica_rejects_unservable_prompts():
+    """A prompt longer than the cache (or empty) arriving over the
+    transport — bypassing ServeHandle's validation — must be answered
+    with finish="rejected", not crash the loop or strand its caller."""
+    q = RequestQueue()
+    rep = _replica(_FakeEngine(), q)             # max_seq = 64
+    uid_long = q.submit(list(range(100)), max_new_tokens=4)
+    uid_empty = q.submit([], max_new_tokens=4)
+    rep._iterate()
+    assert q.result(uid_long, timeout=1.0).finish == "rejected"
+    assert q.result(uid_empty, timeout=1.0).finish == "rejected"
+    assert not rep.quarantined and q.stats()["inflight"] == 0
+
+
+def test_loop_error_quarantines_and_requeues():
+    """A non-elastic exception escaping the step must not silently kill
+    the replica thread (stranding in-flight callers): the replica
+    requeues its work and parks quarantined."""
+    q = RequestQueue()
+    rep = _replica(_FakeEngine(decode_exc=RuntimeError("boom")), q)
+    q.submit([1, 2], max_new_tokens=4)
+    t = threading.Thread(target=rep.run, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not rep.quarantined and time.monotonic() < deadline:
+        time.sleep(0.01)
+    rep.stop()
+    t.join(timeout=5.0)
+    assert rep.quarantined and not t.is_alive()
+    assert q.depth() == 1 and q.stats()["requeued"] == 1
+
+
+def test_guard_observes_every_slot():
+    """The integrity guard's EWMA state must see EVERY slot's max-|logit|
+    each step — a non-finite first slot must not short-circuit the
+    observations of the slots behind it."""
+    class _CountingGuard:
+        def __init__(self):
+            self.seen = []
+
+        def observe(self, value):
+            self.seen.append(value)
+
+    q = RequestQueue()
+    rep = _replica(_FakeEngine(decode_abs=[float("nan"), 5.0]), q)
+    rep.guard = _CountingGuard()
+    q.submit([1, 2], max_new_tokens=4)
+    q.submit([3, 4], max_new_tokens=4)
+    rep._iterate()                               # prefill x2 + decode
+    assert rep.quarantined                       # nan still trips it
+    assert 5.0 in rep.guard.seen                 # second slot observed
+
+
 def test_healthy_replica_completes():
     q = RequestQueue()
     rep = _replica(_FakeEngine(), q)
@@ -395,6 +488,30 @@ def test_policy_from_env_and_overrides(monkeypatch):
 class _Tokenizer:
     def encode(self, text):
         return [ord(c) % 50 + 1 for c in text]
+
+
+def test_submit_validates_prompt_against_max_seq():
+    """Oversized / empty prompts are refused AT SUBMIT — the caller gets
+    a ValueError now, not a result() timeout after the replica choked;
+    a prompt that fits but overruns the cache with its generation budget
+    is served truncated with finish="cache_limit"."""
+    from horovod_tpu.serve.api import ServeHandle, ServePolicy
+
+    q = RequestQueue()
+    rep = _replica(_FakeEngine(), q)             # max_seq = 64
+    handle = ServeHandle([rep], q, ServePolicy(max_new_tokens=4))
+    try:
+        with pytest.raises(ValueError, match="empty"):
+            handle.submit([])
+        with pytest.raises(ValueError, match="max_seq"):
+            handle.submit([1] * 65)
+        done = handle.generate([1] * 64, timeout=10.0)  # exactly fits
+        assert done.finish == "cache_limit"       # cache, not budget
+        assert len(done.tokens) == 1              # prefill token only
+        done = handle.generate([1] * 8, timeout=10.0)
+        assert done.finish == "length" and len(done.tokens) == 4
+    finally:
+        handle.close()
 
 
 def test_serve_end_to_end_in_process(tiny_lm):
