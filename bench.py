@@ -130,13 +130,16 @@ def enable_profiler(flops_per_step=None):
 
 
 def step_profile(n_rounds):
-    """(step_breakdown, comm_hidden_fraction) over the last ``n_rounds``
-    profiled steps — this workload's timed rounds; the no-flag sweep's
-    earlier workloads share the profiler ring, so slice instead of using
-    the whole-ring summary()."""
+    """(step_breakdown, comm_hidden_fraction, comm_hidden_fraction_bytes)
+    over the last ``n_rounds`` profiled steps — this workload's timed
+    rounds; the no-flag sweep's earlier workloads share the profiler
+    ring, so slice instead of using the whole-ring summary(). The
+    bytes-weighted fraction is the bucket-release acceptance metric:
+    payload bytes whose reduction overlapped backward / total reduced
+    bytes."""
     steps = hvd.profiler.history()[-n_rounds:]
     if not steps:
-        return None, None
+        return None, None, None
     n = len(steps)
     breakdown = {k: round(sum(s["phases"][k] for s in steps) / n, 6)
                  for k in ("host", "compute", "exposed_comm", "optimizer")}
@@ -144,11 +147,53 @@ def step_profile(n_rounds):
     exposed = sum(s["comm"]["exposed_seconds"] for s in steps)
     hidden = (min(1.0, max(0.0, 1.0 - exposed / total))
               if total > 0 else 0.0)
-    return breakdown, round(hidden, 4)
+    comm_bytes = sum(s["comm"]["bytes"] for s in steps)
+    hidden_bytes = sum(s["comm"]["hidden_fraction_bytes"]
+                       * s["comm"]["bytes"] for s in steps)
+    hidden_b = (min(1.0, max(0.0, hidden_bytes / comm_bytes))
+                if comm_bytes > 0 else 0.0)
+    return breakdown, round(hidden, 4), round(hidden_b, 4)
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def bucket_overlap_probe(model, optimizer, state, image_size,
+                         batch=8, steps=4):
+    """Bytes-weighted hidden fraction of the release plan's wire traffic.
+
+    The jitted round keeps its collectives inside one XLA program, so
+    the runtime's dispatch/drain stamps never see them; this probe runs
+    a few *eager* bucketed steps (simulated multi-lane wire on the
+    single-controller path) on the same model, where each released
+    bucket is a real pipelined dispatch. Returns None when nothing hit
+    the wire (1-chip world or wire=off)."""
+    from horovod_tpu.parallel import buckets as buckets_mod
+
+    plan = buckets_mod.GradReleasePlan()
+    one_step = training._make_one_step(model, optimizer,
+                                       training._default_loss_fn,
+                                       grad_release=plan)
+    rng = np.random.RandomState(1)
+    images = jnp.asarray(
+        rng.uniform(-1, 1, (batch, image_size, image_size, 3)),
+        jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
+    params, stats, opt_state = (state.params, state.batch_stats,
+                                state.opt_state)
+    one_step(params, stats, opt_state, images, labels)  # warmup/compile
+    for i in range(steps):
+        with hvd.profiler.step(f"overlap probe {i}"):
+            out = one_step(params, stats, opt_state, images, labels)
+            jax.block_until_ready(out[0])
+    probe = hvd.profiler.history()[-steps:]
+    comm_bytes = sum(s["comm"]["bytes"] for s in probe)
+    if not comm_bytes:
+        return None
+    hidden = sum(s["comm"]["hidden_fraction_bytes"] * s["comm"]["bytes"]
+                 for s in probe)
+    return round(min(1.0, max(0.0, hidden / comm_bytes)), 4)
 
 
 def main(model_name: str = "resnet50", allow_env: bool = True):
@@ -181,12 +226,23 @@ def main(model_name: str = "resnet50", allow_env: bool = True):
     optimizer = hvd.DistributedOptimizer(
         optax.sgd(0.01 * n_chips, momentum=0.9))
 
+    # BENCH_GRAD_BUCKETS=0 restores the post-hoc exchange for A/B; the
+    # default rides HOROVOD_GRAD_BUCKET_RELEASE via make_train_round
+    # (on the jitted global-batch lane the plan stages the collectives
+    # at their backward positions — see docs/performance.md)
+    grad_buckets = None
+    if allow_env and os.environ.get("BENCH_GRAD_BUCKETS") == "0":
+        grad_buckets = False
+    elif allow_env and os.environ.get("BENCH_GRAD_BUCKETS") == "1":
+        os.environ["HOROVOD_GRAD_BUCKET_RELEASE"] = "1"
+
     state = training.create_train_state(
         model, optimizer, (1, image_size, image_size, 3))
     # One compiled program per round (lax.scan over the batches) so host
     # dispatch latency stays out of the steady-state measurement.
     round_fn, batch_sharding = training.make_train_round(
-        model, optimizer, steps=BATCHES_PER_ROUND)
+        model, optimizer, steps=BATCHES_PER_ROUND,
+        grad_release=grad_buckets)
 
     rng = np.random.RandomState(0)
     images = jax.device_put(
@@ -220,7 +276,12 @@ def main(model_name: str = "resnet50", allow_env: bool = True):
         dt = time.perf_counter() - t0
         rates.append(global_batch * BATCHES_PER_ROUND / dt)
         log(f"round {r}: {rates[-1]:.1f} img/s")
-    breakdown, hidden_fraction = step_profile(TIMED_ROUNDS)
+    breakdown, hidden_fraction, hidden_bytes = step_profile(TIMED_ROUNDS)
+    if grad_buckets is not False:
+        probe = bucket_overlap_probe(model, optimizer, state, image_size)
+        if probe is not None:
+            log(f"bucket overlap probe: hidden_bytes={probe}")
+            hidden_bytes = probe
 
     # median, not mean: a single tunnel hiccup (reconnect mid-round) can
     # make one round read 20x slow — a transport artifact, not the chip
@@ -241,6 +302,7 @@ def main(model_name: str = "resnet50", allow_env: bool = True):
         "mfu": mfu(per_chip * train_flops_per_image),
         "step_breakdown": breakdown,
         "comm_hidden_fraction": hidden_fraction,
+        "comm_hidden_fraction_bytes": hidden_bytes,
     }
     print(json.dumps(result), flush=True)
     return result
@@ -474,7 +536,7 @@ def transformer_main(family: str, allow_env: bool = True,
         dt = time.perf_counter() - t0
         rates.append(global_batch * accum * seq * updates_per_round / dt)
         log(f"round {r}: {rates[-1]:.0f} tokens/s")
-    breakdown, hidden_fraction = step_profile(TIMED_ROUNDS)
+    breakdown, hidden_fraction, hidden_bytes = step_profile(TIMED_ROUNDS)
 
     tokens_per_sec = float(np.median(rates))  # robust to tunnel hiccups
     per_chip = tokens_per_sec / n_chips
@@ -492,6 +554,7 @@ def transformer_main(family: str, allow_env: bool = True,
         "mfu": mfu(per_chip * flops_per_token),
         "step_breakdown": breakdown,
         "comm_hidden_fraction": hidden_fraction,
+        "comm_hidden_fraction_bytes": hidden_bytes,
     }
     print(json.dumps(result), flush=True)
     return result
@@ -1239,7 +1302,7 @@ def tiny_main():
             jax.block_until_ready(loss)
         rates.append(global_batch * steps_per_round
                      / (time.perf_counter() - t0))
-    breakdown, hidden_fraction = step_profile(rounds)
+    breakdown, hidden_fraction, hidden_bytes = step_profile(rounds)
     per_chip = float(np.median(rates)) / n_chips
     result = {
         "metric": "images/sec/chip (tiny MLP smoke, synthetic)",
@@ -1249,6 +1312,7 @@ def tiny_main():
         "mfu": mfu(per_chip * flops_per_image),
         "step_breakdown": breakdown,
         "comm_hidden_fraction": hidden_fraction,
+        "comm_hidden_fraction_bytes": hidden_bytes,
         "tiny": True,
     }
     print(json.dumps(result), flush=True)

@@ -76,6 +76,7 @@ from horovod_tpu.ops.collectives import (
     broadcast,
     broadcast_async,
     grouped_allreduce,
+    grouped_allreduce_async,
     poll,
     reducescatter,
     stack_per_worker,
@@ -90,6 +91,7 @@ from horovod_tpu.parallel.dp import (
     broadcast_optimizer_state,
     broadcast_object,
 )
+from horovod_tpu.parallel.buckets import GradReleasePlan
 from horovod_tpu.parallel.zero import (
     FlatAdamState,
     ShardedOptState,
@@ -154,6 +156,7 @@ __all__ = [
     # collectives
     "Average", "Sum", "Min", "Max", "Product",
     "allreduce", "allreduce_async", "grouped_allreduce",
+    "grouped_allreduce_async",
     "allgather", "allgather_async", "broadcast", "broadcast_async",
     "reducescatter", "alltoall", "stack_per_worker",
     "Handle", "poll", "synchronize",
@@ -162,6 +165,8 @@ __all__ = [
     "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "Compression",
+    # bucket-wise gradient release (overlap allreduce with backward)
+    "GradReleasePlan",
     # ZeRO-1 sharded optimizer states (TPU-first extension)
     "sharded_update", "sharded_adamw", "ShardedOptState", "FlatAdamState",
     # sparse/embedding gradients

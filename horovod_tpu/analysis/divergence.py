@@ -24,7 +24,9 @@ culprit). Three rules:
 The matcher covers the public lanes (``allreduce*``, ``allgather*``,
 ``broadcast*``/``bcast*``, ``reducescatter``/``reduce_scatter``,
 ``alltoall*``, ``psum*``/``pmean``/``pmin``/``pmax``, ``barrier``,
-``grouped_*``, ``sharded_*``) by callee-name prefix.
+``grouped_*``, ``sharded_*``) by callee-name prefix; the numpy/jax shape
+utilities ``broadcast_to``/``broadcast_arrays``/``broadcast_shapes`` are
+explicitly excluded (same prefix, no cross-rank traffic).
 """
 
 from __future__ import annotations
@@ -64,8 +66,15 @@ NONDET_CALLS = {
 }
 
 
+# numpy/jax shape utilities that share the broadcast* prefix but move no
+# data between ranks
+NOT_COLLECTIVES = {"broadcast_to", "broadcast_arrays", "broadcast_shapes"}
+
+
 def is_collective_name(name: Optional[str]) -> bool:
-    return bool(name) and bool(COLLECTIVE_RE.match(name))
+    if not name or name in NOT_COLLECTIVES:
+        return False
+    return bool(COLLECTIVE_RE.match(name))
 
 
 def _test_tokens(test: ast.expr) -> Set[str]:

@@ -13,7 +13,10 @@ from functools import partial
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+from horovod_tpu.ops.pallas.conv_bn_act import FusedBatchNormAct
 
 
 class ConvBN(nn.Module):
@@ -22,6 +25,7 @@ class ConvBN(nn.Module):
     strides: Sequence[int] = (1, 1)
     padding: Any = "SAME"
     dtype: Any = jnp.bfloat16
+    fused: bool = True  # fused BN+ReLU epilogue (same variables/math)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -29,10 +33,49 @@ class ConvBN(nn.Module):
                     strides=tuple(self.strides), padding=self.padding,
                     use_bias=False, dtype=self.dtype,
                     param_dtype=jnp.float32)(x)
+        if self.fused:
+            return FusedBatchNormAct(momentum=0.9, epsilon=1e-3,
+                                     dtype=self.dtype,
+                                     name="BatchNorm_0")(
+                x, use_running_average=not train)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-3, dtype=self.dtype,
                          param_dtype=jnp.float32)(x)
         return nn.relu(x)
+
+
+class SpaceToDepthStem(nn.Module):
+    """Inception's 3x3/2 VALID stem conv on (299,299,3), reparametrized
+    for the MXU like ResNet's (models/resnet.py SpaceToDepthConvInit,
+    tools/conv0_s2d.py): pad the 299 image one row/col at the END to
+    300, 2x2 space-to-depth to (150,150,12), and fold the 3x3 stride-2
+    kernel into a 2x2 stride-1 kernel over 12 channels — output is the
+    identical 149x149x32 (the folded tap that would read the padded
+    row/col carries a zero weight), with 4x the contraction depth per
+    MXU pass. The parameter KEEPS the canonical (3,3,3,filters) shape so
+    checkpoints interchange with the direct stem."""
+
+    filters: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        w3 = self.param("kernel", nn.initializers.he_normal(),
+                        (3, 3, 3, self.filters), jnp.float32)
+        # fold: pad to (4,4) at the END, then
+        # w2[t,s, 6a+3b+c] = w3[2t+a, 2s+b, c] (u=3 / v=3 taps are zero)
+        w4 = jnp.pad(w3, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        w2 = w4.reshape(2, 2, 2, 2, 3, self.filters) \
+            .transpose(0, 2, 1, 3, 4, 5).reshape(2, 2, 12, self.filters)
+        n, h, w, c = x.shape
+        if h % 2 or w % 2:  # canonical 299: one zero row/col at the end
+            x = jnp.pad(x, ((0, 0), (0, h % 2), (0, w % 2), (0, 0)))
+            n, h, w, c = x.shape
+        y = x.reshape(n, h // 2, 2, w // 2, 2, c) \
+            .transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+        return jax.lax.conv_general_dilated(
+            y.astype(self.dtype), w2.astype(self.dtype), (1, 1),
+            "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def _avg_pool_same(x):
@@ -122,13 +165,20 @@ class InceptionE(nn.Module):
 class InceptionV3(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    space_to_depth: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         c = partial(ConvBN, dtype=self.dtype)
         x = x.astype(self.dtype)
         # stem
-        x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        if self.space_to_depth and x.shape[1] >= 4 and x.shape[3] == 3:
+            x = SpaceToDepthStem(32, self.dtype)(x)
+            x = FusedBatchNormAct(momentum=0.9, epsilon=1e-3,
+                                  dtype=self.dtype)(
+                x, use_running_average=not train)
+        else:
+            x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
         x = c(32, (3, 3), padding="VALID")(x, train)
         x = c(64, (3, 3))(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))

@@ -982,6 +982,36 @@ def allreduce_async(tensor, average=None, name=None, op=None,
                             compression=compression))
 
 
+def grouped_allreduce_async(tensors, names, average=None, op=None,
+                            reduce_op=None, priority=0,
+                            group_callback=None):
+    """Async grouped allreduce through the runtime: the whole group is
+    enqueued atomically (``Runtime.enqueue_allreduce_group``) so one
+    negotiation cycle sees it and the fusion planner packs it into as few
+    dispatches as ``HOROVOD_FUSION_THRESHOLD`` allows. This is the wire
+    primitive behind bucket-wise gradient release
+    (:class:`horovod_tpu.parallel.buckets.GradReleasePlan`): each bucket
+    becomes one grouped enqueue, released while backward is still
+    running. Returns one handle per tensor, in order; ``group_callback``
+    fires on the cycle thread per completion (see the runtime method)."""
+    tensors = list(tensors)
+    names = list(names)
+    if len(tensors) != len(names):
+        raise ValueError("tensors and names must pair up")
+    if not tensors:
+        return []
+    if reduce_op is None:
+        red_op = _resolve_op(average, op)
+        reduce_op = _OP_NAMES[red_op]
+    elif average is not None or op is not None:
+        raise ValueError("specify reduce_op or average/op, not both")
+    from horovod_tpu.runtime.runtime import get_runtime
+
+    return get_runtime().enqueue_allreduce_group(
+        names, [_to_plane(t) for t in tensors], reduce_op=reduce_op,
+        priority=priority, group_callback=group_callback)
+
+
 def allgather_async(tensor, name=None, priority=0):
     if name is not None:
         from horovod_tpu.runtime.runtime import get_runtime

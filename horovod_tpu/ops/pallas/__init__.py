@@ -8,6 +8,11 @@ from horovod_tpu.ops.pallas.flash_attention import (
 )
 from horovod_tpu.ops.pallas.fused_adamw import FusedAdamW, fused_adamw
 from horovod_tpu.ops.pallas.fused_optimizer import flat_adamw_shard
+from horovod_tpu.ops.pallas.conv_bn_act import (
+    FusedBatchNormAct,
+    bn_stats,
+    scale_bias_act,
+)
 
 __all__ = [
     "flash_attention",
@@ -17,4 +22,7 @@ __all__ = [
     "fused_adamw",
     "FusedAdamW",
     "flat_adamw_shard",
+    "FusedBatchNormAct",
+    "bn_stats",
+    "scale_bias_act",
 ]

@@ -99,7 +99,16 @@ def allreduce_gradients(grads, *, average: bool = True,
     (reference: the fusion-buffer batching the per-leaf reference path
     gets from its background coordinator, horovod/common/operations.cc).
     Tracer leaves keep the in-jit ``lax.pmean``/``psum`` path unchanged.
+
+    Inside a :func:`horovod_tpu.parallel.buckets.prereduced` scope the
+    tree is returned untouched: a bucket-wise release plan already
+    exchanged the gradients during backward, and reducing them a second
+    time would divide (or multiply) by the world size twice.
     """
+    from horovod_tpu.parallel import buckets as buckets_mod
+
+    if buckets_mod.is_prereduced():
+        return grads
     leaves, treedef = jax.tree_util.tree_flatten(
         grads, is_leaf=sparse_mod.is_sparse)
     out = list(leaves)
@@ -123,10 +132,16 @@ def allreduce_gradients(grads, *, average: bool = True,
         out[i] = g
         dense_eager.append(i)
     if dense_eager:
+        # submit reverse-topological (last layer first): tree-flatten
+        # order follows the forward layer order, but backward finalizes
+        # gradients back-to-front, so fronting the tail of the tree puts
+        # the earliest-ready gradients at the head of the fusion queue —
+        # same ordering the bucket-release plan uses
+        submit = list(reversed(dense_eager))
         reduced = collectives.grouped_allreduce(
-            [out[i] for i in dense_eager], average=average,
+            [out[i] for i in submit], average=average,
             compression=compression, axis_name=axis_name)
-        for i, r in zip(dense_eager, reduced):
+        for i, r in zip(submit, reduced):
             out[i] = r
     return jax.tree_util.tree_unflatten(treedef, out)
 

@@ -104,7 +104,7 @@ def _comm_totals() -> dict:
         return executor.comm_totals()
     except Exception:
         return {"total_seconds": 0.0, "exposed_seconds": 0.0,
-                "total_bytes": 0, "hidden_bytes": 0.0}
+                "total_bytes": 0, "hidden_bytes": 0.0, "ops": 0}
 
 
 def _handle_wait_seconds() -> float:
@@ -290,6 +290,7 @@ class StepProfiler:
         comm_bytes = max(0, comm1["total_bytes"] - rec.comm0["total_bytes"])
         hidden_bytes = max(0.0, comm1["hidden_bytes"]
                            - rec.comm0["hidden_bytes"])
+        comm_ops = max(0, comm1.get("ops", 0) - rec.comm0.get("ops", 0))
         hidden_fraction = 0.0
         if comm_total > 0.0:
             hidden_fraction = min(1.0, max(0.0,
@@ -336,6 +337,10 @@ class StepProfiler:
             "comm": {"total_seconds": comm_total,
                      "exposed_seconds": comm_exposed,
                      "bytes": comm_bytes,
+                     # fused executor dispatches this step: a bucketed
+                     # backward shows one per released bucket, the
+                     # unbucketed path at most a handful
+                     "dispatches": comm_ops,
                      "hidden_fraction": hidden_fraction,
                      "hidden_fraction_bytes": hidden_fraction_bytes},
             "mfu": mfu,

@@ -91,18 +91,20 @@ class _CommClock:
     the metrics registry."""
 
     __slots__ = ("total_seconds", "exposed_seconds", "total_bytes",
-                 "hidden_bytes")
+                 "hidden_bytes", "ops")
 
     def __init__(self) -> None:
         self.total_seconds = 0.0
         self.exposed_seconds = 0.0
         self.total_bytes = 0
         self.hidden_bytes = 0.0
+        self.ops = 0
 
     def record(self, total: float, exposed: float, nbytes: int) -> None:
         self.total_seconds += total
         self.exposed_seconds += exposed
         self.total_bytes += nbytes
+        self.ops += 1
         if total > 0.0:
             self.hidden_bytes += nbytes * (1.0 - exposed / total)
         _COMM_EXPOSED.inc(exposed)
@@ -118,7 +120,8 @@ def comm_totals() -> dict:
     return {"total_seconds": c.total_seconds,
             "exposed_seconds": c.exposed_seconds,
             "total_bytes": c.total_bytes,
-            "hidden_bytes": c.hidden_bytes}
+            "hidden_bytes": c.hidden_bytes,
+            "ops": c.ops}
 
 
 # reduce_op name -> stacked-axis reducer for the XLA fused programs

@@ -382,6 +382,88 @@ class Runtime:
         return self._enqueue(types.ALLREDUCE, name, tensor,
                              reduce_op=reduce_op, priority=priority)
 
+    def enqueue_allreduce_group(self, names, tensors,
+                                reduce_op: str = types.REDUCE_AVERAGE,
+                                priority: int = 0,
+                                group_callback=None):
+        """Enqueue a released gradient bucket as one atomic group.
+
+        All entries land in the tensor queue under a single lock scope
+        (``TensorQueue.add_group``) with one wake of the cycle thread, so
+        the whole bucket negotiates in the same cycle and the fusion
+        planner packs it into as few dispatches as the fusion threshold
+        allows — the per-bucket analogue of the reference's grouped
+        enqueue. ``group_callback(ok)``, if given, fires on the cycle
+        thread once per entry as it completes or fails (bucket-release
+        wire accounting). Returns one handle per tensor, in order."""
+        if self._stop.is_set():
+            from horovod_tpu import exceptions
+
+            if isinstance(self.failure, exceptions.WorkersDownError):
+                raise type(self.failure)(
+                    f"{types.SHUT_DOWN_ERROR} (cause: {self.failure})",
+                    ranks=self.failure.ranks) from self.failure
+            raise RuntimeError(types.SHUT_DOWN_ERROR)
+        names = list(names)
+        tensors = list(tensors)
+        if len(names) != len(tensors):
+            raise ValueError("names and tensors must pair up")
+        if reduce_op not in types.REDUCE_OPS:
+            raise ValueError(f"unknown reduce_op {reduce_op!r}")
+        from horovod_tpu.ops import collectives as coll
+
+        handles = []
+        entries = []
+        requests = []
+        for name, tensor in zip(names, tensors):
+            handle = RuntimeHandle(name, runtime=self)
+            handles.append(handle)
+
+            def _on_complete(status, output, _h=handle, _name=name):
+                with self._inflight_lock:
+                    self._inflight -= 1
+                    self._inflight_names.pop(_name, None)
+                if group_callback is not None:
+                    try:
+                        group_callback(status.ok())
+                    except Exception:
+                        pass  # accounting must never poison completion
+                _h._complete(status, output)
+
+            entries.append(types.TensorTableEntry(
+                name=name, tensor=tensor, request_type=types.ALLREDUCE,
+                root_rank=0, reduce_op=reduce_op, callback=_on_complete,
+                dtype=str(tensor.dtype), shape=tuple(tensor.shape),
+                enqueue_time=time.monotonic(), priority=priority))
+            wire_shape = (tuple(int(d) for d in tensor.shape[1:])
+                          if coll._is_worker_stacked(tensor)
+                          else tuple(int(d) for d in tensor.shape))
+            requests.append(msg.Request(
+                rank=self.controller.rank, request_type=types.ALLREDUCE,
+                tensor_name=name, dtype=str(tensor.dtype),
+                shape=wire_shape, root_rank=0, reduce_op=reduce_op))
+        # count BEFORE visibility, rolled back as a block on a duplicate —
+        # same transient-negative protection as _enqueue
+        now = time.monotonic()
+        fresh = []
+        with self._inflight_lock:
+            self._inflight += len(entries)
+            for name in names:
+                if name not in self._inflight_names:
+                    self._inflight_names[name] = now
+                    fresh.append(name)
+            self._last_enqueue_time = now
+        try:
+            self.queue.add_group(entries, requests)
+        except BaseException:
+            with self._inflight_lock:
+                self._inflight -= len(entries)
+                for name in fresh:
+                    self._inflight_names.pop(name, None)
+            raise
+        self._woken.set()
+        return handles
+
     def enqueue_allgather(self, name: str, tensor,
                           priority: int = 0) -> RuntimeHandle:
         return self._enqueue(types.ALLGATHER, name, tensor,

@@ -50,6 +50,28 @@ class TensorQueue:
             _ENQUEUED.inc()
             _QUEUE_DEPTH.set(len(self._table))
 
+    def add_group(self, entries: List[types.TensorTableEntry],
+                  requests: List[msg.Request]) -> None:
+        """Atomically add a released gradient bucket: all entries become
+        visible to the cycle thread under one lock acquisition (so one
+        negotiation cycle sees the whole bucket and the fusion planner can
+        pack it into one dispatch), and the duplicate check is
+        all-or-nothing — a clash on any name leaves the table untouched."""
+        if len(entries) != len(requests):
+            raise ValueError("entries and requests must pair up")
+        with self._lock:
+            for entry in entries:
+                if entry.name in self._table:
+                    raise DuplicateNameError(
+                        types.DUPLICATE_NAME_ERROR_FMT.format(
+                            op=entry.request_type.lower()))
+            for entry, request in zip(entries, requests):
+                self._table[entry.name] = entry
+                self._pending.append((-entry.priority, self._seq, request))
+                self._seq += 1
+                _ENQUEUED.inc()
+            _QUEUE_DEPTH.set(len(self._table))
+
     def pop_requests(self) -> List[msg.Request]:
         """Drain pending negotiation messages for this cycle, highest
         priority first, enqueue order within a priority level (reference:

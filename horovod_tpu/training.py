@@ -117,12 +117,22 @@ def _default_loss_fn(logits, labels):
         logits, labels).mean()
 
 
-def _make_one_step(model, optimizer, loss_fn):
+def _make_one_step(model, optimizer, loss_fn, grad_release=None):
     """Shared un-jitted train-step body: fwd + grad + optimizer update,
-    tolerating models with or without batch statistics."""
+    tolerating models with or without batch statistics.
+
+    With a :class:`~horovod_tpu.parallel.buckets.GradReleasePlan` the
+    parameter tree is tagged before the forward pass, so each fusion
+    bucket's allreduce releases during backward (eager lane) or stages at
+    its backward position (traced lane); the optimizer update then runs
+    inside a ``prereduced`` scope so ``DistributedOptimizer`` skips the
+    post-hoc exchange."""
+    from horovod_tpu.parallel import buckets as buckets_mod
 
     def one_step(params, batch_stats, opt_state, images, labels):
         def compute(params):
+            if grad_release is not None:
+                params = grad_release.tag(params)
             outputs, updates = model.apply(
                 {"params": params, "batch_stats": batch_stats},
                 images, train=True, mutable=["batch_stats"])
@@ -130,7 +140,14 @@ def _make_one_step(model, optimizer, loss_fn):
 
         (loss, new_stats), grads = jax.value_and_grad(
             compute, has_aux=True)(params)
-        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        if grad_release is not None:
+            grads = grad_release.gather(grads)
+            with buckets_mod.prereduced():
+                updates, new_opt_state = optimizer.update(
+                    grads, opt_state, params)
+        else:
+            updates, new_opt_state = optimizer.update(
+                grads, opt_state, params)
         return loss, optax.apply_updates(params, updates), new_stats, \
             new_opt_state
 
@@ -145,9 +162,24 @@ def _shardings():
     return batch_sharding, repl
 
 
+def _resolve_grad_release(grad_release):
+    """``None`` → honour ``HOROVOD_GRAD_BUCKET_RELEASE``; ``False`` →
+    explicitly off; a plan instance → use it."""
+    from horovod_tpu.parallel import buckets as buckets_mod
+
+    if grad_release is None:
+        if buckets_mod.release_enabled():
+            return buckets_mod.GradReleasePlan()
+        return None
+    if grad_release is False:
+        return None
+    return grad_release
+
+
 def make_train_step(model, optimizer,
                     loss_fn: Optional[Callable] = None,
-                    donate: bool = True):
+                    donate: bool = True,
+                    grad_release=None):
     """Build a jitted global-batch DP train step.
 
     The returned function has signature
@@ -155,9 +187,18 @@ def make_train_step(model, optimizer,
     (loss, params, batch_stats, opt_state)`` and is compiled over the
     global mesh with inputs batch-sharded; gradient averaging across
     workers falls out of the shardings (see ``parallel/dp.py``).
+
+    ``grad_release`` opts the step into bucket-wise gradient release
+    (``None`` honours ``HOROVOD_GRAD_BUCKET_RELEASE``; pass a
+    :class:`~horovod_tpu.parallel.buckets.GradReleasePlan` to control
+    bucket sizing, or ``False`` to force the post-hoc exchange). On this
+    jitted lane the hooks stage the collectives at their backward
+    positions; overlap inside one XLA program is the scheduler's, the
+    staging just stops it sinking them to the end.
     """
     batch_sharding, repl = _shardings()
-    one_step = _make_one_step(model, optimizer, loss_fn or _default_loss_fn)
+    one_step = _make_one_step(model, optimizer, loss_fn or _default_loss_fn,
+                              grad_release=_resolve_grad_release(grad_release))
     step_fn = jax.jit(
         one_step,
         in_shardings=(repl, repl, repl, batch_sharding, batch_sharding),
@@ -171,7 +212,8 @@ def make_train_step(model, optimizer,
 def make_train_round(model, optimizer,
                      loss_fn: Optional[Callable] = None,
                      steps: int = 1,
-                     donate: bool = True):
+                     donate: bool = True,
+                     grad_release=None):
     """Like :func:`make_train_step`, but one compiled program runs
     ``steps`` consecutive train steps via ``lax.scan`` (same batch each
     step — benchmark workloads), returning the last loss.
@@ -183,7 +225,8 @@ def make_train_round(model, optimizer,
     conclusion: the whole round is a single device program.
     """
     batch_sharding, repl = _shardings()
-    one_step = _make_one_step(model, optimizer, loss_fn or _default_loss_fn)
+    one_step = _make_one_step(model, optimizer, loss_fn or _default_loss_fn,
+                              grad_release=_resolve_grad_release(grad_release))
 
     def round_fn(params, batch_stats, opt_state, images, labels):
         def body(carry, _):

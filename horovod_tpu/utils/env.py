@@ -111,6 +111,12 @@ ENV_DIRECT_KNOBS = (
     "HOROVOD_SERVE_QUEUE_CAPACITY", "HOROVOD_SERVE_DECODE_BLOCK",
     "HOROVOD_SERVE_SLOTS", "HOROVOD_SERVE_MAX_NEW_TOKENS",
     "HOROVOD_SERVE_QUARANTINE", "HOROVOD_SERVE_RESULT_TTL_S",
+    # bucket-wise gradient release (parallel/buckets.py;
+    # docs/performance.md "backward overlap")
+    "HOROVOD_GRAD_BUCKET_RELEASE", "HOROVOD_GRAD_BUCKET_BYTES",
+    "HOROVOD_GRAD_BUCKET_WIRE",
+    # fused BN+activation epilogue (ops/pallas/conv_bn_act.py)
+    "HOROVOD_FUSED_BN_ACT",
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379

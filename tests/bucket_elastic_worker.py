@@ -1,0 +1,104 @@
+"""Worker for the bucket-release elastic cell (ISSUE 12 satellite).
+
+World=3 over the real socket/native transport. Every step runs a
+bucketed eager backward (one GradReleasePlan bucket per leaf, so three
+releases hit the wire per step). At BUCKET_KILL_STEP the kill rank dies
+*mid-backward* — inside its second bucket release, with the first
+bucket's allreduce already negotiated/in flight. The survivors' gather
+then fails with WorkersDownError on the orphaned bucket tokens;
+``@elastic.run`` re-forms them into a 2-worker generation, rolls back to
+the last commit, and the SAME plan object (its per-step state reset by
+the gather failure path) finishes the run. The final line reports
+outstanding fusion-buffer leases — a failed bucket token must return its
+slab, so ``leases_leaked`` has to be 0.
+
+Invariant: the loss is a plain sum, so each leaf's averaged gradient is
+exactly ones and ``w == step`` at every commit, across the re-form.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.parallel import buckets as buckets_mod
+
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "6"))
+KILL_STEP = int(os.environ.get("BUCKET_KILL_STEP", "3"))
+KILL_RANK = int(os.environ.get("BUCKET_KILL_RANK", "1"))
+ORIG_RANK = int(os.environ.get("HOROVOD_RANK", "0"))
+
+PLAN = buckets_mod.GradReleasePlan(bucket_bytes=256)  # one leaf per bucket
+
+_die_mid_backward = False
+_real_release = buckets_mod.GradReleasePlan._release
+
+
+def _release_and_maybe_die(self, bucket, values):
+    _real_release(self, bucket, values)
+    if _die_mid_backward and bucket.index >= 1:
+        # bucket 0 is already on the wire and later buckets are still
+        # differentiating: abrupt death with tokens genuinely in flight
+        os._exit(17)
+
+
+buckets_mod.GradReleasePlan._release = _release_and_maybe_die
+
+
+def bucketed_grad(params):
+    def loss(p):
+        return sum(x.sum() for x in
+                   jax.tree_util.tree_leaves(PLAN.tag(p)))
+
+    return PLAN.gather(jax.grad(loss)(params))
+
+
+@elastic.run
+def train(state):
+    global _die_mid_backward
+    while state.step < TOTAL_STEPS:
+        _die_mid_backward = (ORIG_RANK == KILL_RANK
+                             and state.step == KILL_STEP
+                             and elastic.restarts() == 0)
+        params = {"a": jnp.ones((96,), jnp.float32),
+                  "b": jnp.ones((96,), jnp.float32),
+                  "c": jnp.ones((96,), jnp.float32)}
+        g = bucketed_grad(params)
+        _die_mid_backward = False
+        state.params["w"] = state.params["w"] + np.asarray(g["a"][:4])
+        state.step += 1
+        state.commit()
+    return state
+
+
+def main() -> int:
+    hvd.init()
+    state = elastic.ArrayState(
+        params={"w": np.zeros(4, np.float32)}, optimizer=None, step=0)
+    train(state)
+
+    from horovod_tpu.runtime.runtime import get_runtime
+
+    mgr = get_runtime().executor.fusion_buffers
+    with mgr._lock:
+        free = sum(a.nbytes for lst in mgr._free.values() for a in lst)
+    leaked = mgr.allocated_bytes() - free
+    w = float(state.params["w"][0])
+    print(f"DONE rank={hvd.rank()} size={hvd.size()} step={state.step} "
+          f"w={w:g} generation={elastic.restarts()} "
+          f"wire_released={PLAN.wire_stats()['released']} "
+          f"leases_leaked={leaked}", flush=True)
+    if state.step != TOTAL_STEPS or abs(w - TOTAL_STEPS) > 1e-5:
+        return 3
+    if leaked != 0:
+        return 4
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -291,6 +291,22 @@ def sync(st, x):
     assert divergence.analyze_paths([path]) == []
 
 
+def test_broadcast_to_shape_op_not_flagged(tmp_path):
+    """jnp.broadcast_to / np.broadcast_arrays share the broadcast* prefix
+    but are pure shape utilities — a size-conditional use (e.g. the
+    bucket wire's replicated-gradient staging) must not be flagged."""
+    path = _write(tmp_path, "shapes.py", """
+import jax.numpy as jnp
+
+def stage(st, x):
+    if st.size > 1:
+        x = jnp.broadcast_to(x, (st.size,) + x.shape)
+        x, y = jnp.broadcast_arrays(x, x)
+    return x
+""")
+    assert divergence.analyze_paths([path]) == []
+
+
 def test_nondeterministic_name_flagged(tmp_path):
     path = _write(tmp_path, "nd.py", """
 import time, uuid

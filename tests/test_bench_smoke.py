@@ -180,6 +180,17 @@ def test_bench_compare_real_artifacts(bench_compare):
     assert bench_compare.main([r04, r05]) == 0
 
 
+def test_bench_compare_r05_to_r06(bench_compare):
+    """ISSUE 12 acceptance: the bucket-wise gradient release round must
+    clear the gate against r05 — ResNet-50 and Inception-V3 MFU up well
+    past the 5% threshold, nothing else regressed."""
+    r05 = os.path.join(_REPO, "BENCH_r05.json")
+    r06 = os.path.join(_REPO, "BENCH_r06.json")
+    if not (os.path.exists(r05) and os.path.exists(r06)):
+        pytest.skip("BENCH artifacts not present")
+    assert bench_compare.main([r05, r06]) == 0
+
+
 def test_bench_compare_usage_errors(bench_compare, tmp_path):
     assert bench_compare.main([]) == 2
     bad = tmp_path / "bad.json"

@@ -22,6 +22,8 @@ WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "elastic_worker.py")
 ZERO_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "zero_elastic_worker.py")
+BUCKET_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bucket_elastic_worker.py")
 
 pytestmark = pytest.mark.skipif(
     not native_built(), reason="native transport not built")
@@ -119,6 +121,33 @@ def test_zero_sharded_state_survives_reform():
         restarts = float(outs[i].split(
             "elastic_restarts_total=")[1].split()[0])
         assert restarts >= 1, (i, outs[i])
+
+
+def test_kill_mid_backward_with_buckets_in_flight():
+    """Bucket-wise gradient release under elastic failure (ISSUE 12):
+    rank 1 dies *inside* its second bucket release at step 3 — the first
+    bucket's allreduce is already in flight and later buckets never
+    arrive. The survivors' gather must fail every orphaned bucket token
+    with WorkersDownError, the re-formed 2-worker generation finishes on
+    the SAME plan object, and no fusion-buffer lease leaks across the
+    failure (the worker exits 4 if any slab stays checked out)."""
+    procs, outs = _launch_elastic(
+        3, extra_env={
+            "BUCKET_KILL_STEP": "3",
+            "BUCKET_KILL_RANK": "1",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        }, worker=BUCKET_WORKER)
+    assert procs[1].returncode == 17, outs[1]
+    for i in (0, 2):
+        assert procs[i].returncode == 0, (i, outs[i])
+        assert "DONE" in outs[i], (i, outs[i])
+        assert "step=6" in outs[i], (i, outs[i])
+        assert "w=6" in outs[i], (i, outs[i])
+        assert "size=2" in outs[i], (i, outs[i])
+        assert "leases_leaked=0" in outs[i], (i, outs[i])
+        # the bucketed path really exercised the wire: 3 buckets x steps
+        released = int(outs[i].split("wire_released=")[1].split()[0])
+        assert released >= 3 * 6, (i, outs[i])
 
 
 def test_no_fault_runs_clean():

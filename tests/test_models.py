@@ -363,3 +363,82 @@ class TestVggInception:
                                 state.opt_state, images, labels)
         loss2, *_ = step(p, st, os_, images, labels)
         assert float(loss2) < float(loss)
+
+
+class TestFusedConvKernels:
+    """Parity pins for the conv-net MFU campaign (ISSUE 12): the
+    space-to-depth Inception stem and the fused BN+ReLU epilogue must
+    compute the same function as the direct formulations they replace."""
+
+    def test_space_to_depth_stem_matches_direct_conv(self, hvd_flat):
+        from horovod_tpu.models.inception import SpaceToDepthStem
+
+        x = jnp.asarray(np.random.RandomState(0).uniform(
+            -1, 1, (2, 75, 75, 3)), jnp.float32)  # odd size, like 299
+        stem = SpaceToDepthStem(32, jnp.float32)
+        variables = stem.init(jax.random.PRNGKey(0), x)
+        folded = stem.apply(variables, x)
+        direct = jax.lax.conv_general_dilated(
+            x, variables["params"]["kernel"], (2, 2), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        assert folded.shape == direct.shape == (2, 37, 37, 32)
+        np.testing.assert_allclose(np.asarray(folded), np.asarray(direct),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fused_bn_act_matches_unfused(self, hvd_flat):
+        import flax.linen as nn
+        from horovod_tpu.ops.pallas.conv_bn_act import FusedBatchNormAct
+
+        x = jnp.asarray(np.random.RandomState(1).uniform(
+            -2, 2, (4, 9, 9, 16)), jnp.float32)
+        fused = FusedBatchNormAct(momentum=0.9, epsilon=1e-3,
+                                  dtype=jnp.float32)
+        ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                           epsilon=1e-3, dtype=jnp.float32,
+                           param_dtype=jnp.float32)
+        # identical variable names by construction: one init serves both
+        variables = fused.init(jax.random.PRNGKey(0), x)
+        out_f, mut_f = fused.apply(variables, x,
+                                   mutable=["batch_stats"])
+        out_r, mut_r = ref.apply(variables, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(out_f),
+                                   np.asarray(nn.relu(out_r)),
+                                   rtol=1e-5, atol=1e-5)
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(mut_f["batch_stats"][k]),
+                np.asarray(mut_r["batch_stats"][k]), rtol=1e-5, atol=1e-6)
+
+    def test_fused_bn_act_gradients_match(self, hvd_flat):
+        import flax.linen as nn
+        from horovod_tpu.ops.pallas.conv_bn_act import FusedBatchNormAct
+
+        x = jnp.asarray(np.random.RandomState(2).uniform(
+            -2, 2, (2, 7, 7, 8)), jnp.float32)
+        fused = FusedBatchNormAct(momentum=0.9, epsilon=1e-3,
+                                  dtype=jnp.float32)
+        ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                           epsilon=1e-3, dtype=jnp.float32,
+                           param_dtype=jnp.float32)
+        variables = fused.init(jax.random.PRNGKey(0), x)
+
+        def loss_fused(params, x):
+            out, _ = fused.apply(
+                {"params": params,
+                 "batch_stats": variables["batch_stats"]},
+                x, mutable=["batch_stats"])
+            return jnp.sum(out ** 2)
+
+        def loss_ref(params, x):
+            out, _ = ref.apply(
+                {"params": params,
+                 "batch_stats": variables["batch_stats"]},
+                x, mutable=["batch_stats"])
+            return jnp.sum(nn.relu(out) ** 2)
+
+        gf = jax.grad(loss_fused, argnums=(0, 1))(variables["params"], x)
+        gr = jax.grad(loss_ref, argnums=(0, 1))(variables["params"], x)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            gf, gr)
