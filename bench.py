@@ -159,6 +159,28 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def memory_rows(params_tree=None):
+    """Headline memory fields (docs/memory.md): per-subsystem
+    ``bytes_per_chip`` from the tracker ledger + ``peak_hbm_bytes``. The
+    jitted bench rounds never cross the eager push point in
+    DistributedOptimizer, so the caller hands its params tree here for a
+    direct push before the pull."""
+    try:
+        from horovod_tpu import memory
+
+        t = memory.tracker()
+        if params_tree is not None:
+            t.note_tree_bytes("params", params_tree)
+        led = t.ledger()
+        per_chip = {name: int(rec["bytes"])
+                    for name, rec in led["subsystems"].items()
+                    if name != "host_rss" and rec["bytes"]}
+        return {"bytes_per_chip": per_chip,
+                "peak_hbm_bytes": int(t.peak_hbm_bytes())}
+    except Exception:
+        return {"bytes_per_chip": None, "peak_hbm_bytes": None}
+
+
 def bucket_overlap_probe(model, optimizer, state, image_size,
                          batch=8, steps=4):
     """Bytes-weighted hidden fraction of the release plan's wire traffic.
@@ -303,6 +325,7 @@ def main(model_name: str = "resnet50", allow_env: bool = True):
         "step_breakdown": breakdown,
         "comm_hidden_fraction": hidden_fraction,
         "comm_hidden_fraction_bytes": hidden_bytes,
+        **memory_rows(params),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -555,6 +578,7 @@ def transformer_main(family: str, allow_env: bool = True,
         "step_breakdown": breakdown,
         "comm_hidden_fraction": hidden_fraction,
         "comm_hidden_fraction_bytes": hidden_bytes,
+        **memory_rows(params),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -882,6 +906,110 @@ def integrity_main(tiny: bool = False):
     return result
 
 
+def memory_main(tiny: bool = False):
+    """Memory-plane microbench (ISSUE 13): steady-state cost of the
+    tracker's push accounting + reconciliation sampler on the fused
+    allreduce path, at BERT-Large gradient shapes.
+
+    Two interleaved phases over identical named tensors (the
+    --integrity protocol, so dispatch drift cannot masquerade as tracker
+    cost): memory plane OFF (tracker disabled, no sampler thread) and ON
+    with the sampler at a deliberately hostile cadence (50 ms — 200x the
+    default) plus a per-step grads push. Headline ``value``: added p50
+    step %, goal < 1%. Also reports the resulting ledger and the
+    claimed-vs-actual reconciliation drift.
+
+    ``tiny`` (--tiny / the tier-1 smoke test): toy shapes + 2 steps."""
+    hvd.init()
+    from horovod_tpu import memory
+
+    world = hvd.size()
+    if tiny:
+        shapes = [(256,), (64, 8)]
+        warmup_steps, timed_steps = 3, 2
+    else:
+        shapes = [(1024, 1024), (1024, 1024), (1024, 4096), (4096, 1024),
+                  (1024,)]
+        warmup_steps, timed_steps = 6, 7
+    rng = np.random.RandomState(0)
+    payloads = [rng.randn(world, *s).astype(np.float32) for s in shapes]
+    n_elems = sum(int(np.prod(s)) for s in shapes)
+    log(f"memory bench: {len(shapes)} tensors, "
+        f"{n_elems * 4 / 1e6:.1f} MB/step/worker, np={world}"
+        f"{' (tiny)' if tiny else ''}")
+
+    t = memory.tracker()
+    was_enabled = t.enabled
+
+    def one_step(step, push):
+        hs = [hvd.allreduce_async(
+            hvd.stack_per_worker(list(payloads[j] + np.float32(step))),
+            name=f"mem/t{j}") for j in range(len(shapes))]
+        outs = [hvd.synchronize(h) for h in hs]
+        if push:  # the eager-path per-step accounting under test
+            t.note_tree_bytes("grads", outs)
+
+    def set_phase(on):
+        t.enabled = on
+        if on:
+            t.start(interval=0.05)  # hostile cadence: 200x the default
+        else:
+            t.stop()
+
+    try:
+        set_phase(True)
+        for s in range(warmup_steps):
+            one_step(s, push=True)
+
+        phases = {"off": (False, []), "on": (True, [])}
+        for s in range(timed_steps):
+            for name, (on, lat) in phases.items():
+                set_phase(on)
+                t0 = time.perf_counter()
+                one_step(1000 + s * len(phases), push=on)
+                lat.append(time.perf_counter() - t0)
+
+        set_phase(True)
+        led = t.sample()  # one explicit reconcile for the report
+    finally:
+        t.stop()
+        t.enabled = was_enabled
+        if was_enabled:
+            t.start()
+
+    p50 = {name: float(np.median(lat)) for name, (_, lat) in phases.items()}
+    overhead = (round(100.0 * (p50["on"] - p50["off"]) / p50["off"], 2)
+                if p50["off"] > 0 else None)
+    drift = led.get("reconcile_drift_ratio")
+    result = {
+        "metric": f"memory tracker steady-state step overhead "
+                  f"(sampler at 50 ms + per-step push, "
+                  f"{'toy' if tiny else 'BERT-Large layer'} gradient "
+                  f"shapes, np={world})",
+        "value": overhead,
+        "unit": "%",
+        "goal": "< 1%",
+        "p50_ms_memory_off": round(p50["off"] * 1e3, 3),
+        "p50_ms_memory_on": round(p50["on"] * 1e3, 3),
+        "reconcile_drift_ratio": (round(drift, 4)
+                                  if isinstance(drift, (int, float))
+                                  else None),
+        "bytes_per_chip": {
+            name: int(rec["bytes"])
+            for name, rec in led["subsystems"].items()
+            if name != "host_rss" and rec["bytes"]},
+        "peak_hbm_bytes": int(t.peak_hbm_bytes()),
+        "samples_taken": len(t.samples()),
+    }
+    if tiny:
+        result["tiny"] = True
+    log(f"memory: p50 off {result['p50_ms_memory_off']} ms, "
+        f"on {result['p50_ms_memory_on']} ms ({overhead}%); "
+        f"drift={result['reconcile_drift_ratio']}")
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def _bert_large_param_shapes():
     """BERT-Large parameter shapes (L=24, d=1024, ff=4096, vocab 30522,
     seq 512) as a flat dict — ~335M params, the flagship workload's
@@ -1015,6 +1143,7 @@ def sharded_optimizer_main(tiny: bool = False):
         "state_bytes_reduction_x": (
             round(rep_bytes / sharded_bytes, 2) if sharded_bytes else None),
         "steady_state_program_builds": int(steady_builds),
+        **memory_rows(),
     }
     if tiny:
         result["tiny"] = True
@@ -1248,7 +1377,17 @@ def serve_main(tiny: bool = False):
             "steady_state_compiles": steady_compiles,
             "warmup_compiles": warm_compiles,
             "served_by": sorted({o.rank for o in outs}),
+            # KV bytes/chip next to tokens/s/chip (docs/memory.md): the
+            # replica stats carry per-replica cache bytes + slot-
+            # occupancy-weighted utilization
+            "kv_cache_bytes_per_chip": int(
+                sum(r.stats()["kv_cache_bytes"]
+                    for r in handle._replicas) / max(replicas, 1)),
+            "kv_utilization": round(
+                sum(r.stats()["kv_utilization"]
+                    for r in handle._replicas) / max(replicas, 1), 3),
             "tiny": tiny,
+            **memory_rows(params),
         }
     finally:
         handle.close()
@@ -1314,6 +1453,7 @@ def tiny_main():
         "comm_hidden_fraction": hidden_fraction,
         "comm_hidden_fraction_bytes": hidden_bytes,
         "tiny": True,
+        **memory_rows(params),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -1361,6 +1501,12 @@ if __name__ == "__main__":
                              "tokens/s/chip, batch occupancy and the "
                              "zero-steady-state-compiles canary (one "
                              "JSON line)")
+    parser.add_argument("--memory", action="store_true",
+                        help="microbench the memory telemetry plane: "
+                             "tracker push + reconciliation sampler "
+                             "overhead at BERT-Large gradient shapes, "
+                             "interleaved A/B, plus the ledger and "
+                             "claimed-vs-actual drift (one JSON line)")
     parser.add_argument("--tiny", action="store_true",
                         help="toy sizes + a couple of steps for "
                              "--collectives/--sharded-optimizer/"
@@ -1377,6 +1523,8 @@ if __name__ == "__main__":
     cli = parser.parse_args()
     if cli.serve:
         serve_main(tiny=cli.tiny)
+    elif cli.memory:
+        memory_main(tiny=cli.tiny)
     elif cli.collectives:
         collectives_main(tiny=cli.tiny)
     elif cli.integrity:
@@ -1445,6 +1593,7 @@ if __name__ == "__main__":
             (main, "vgg", False, 95, None),
             (sharded_optimizer_main, "sharded-optimizer", False, 60,
              None),
+            (memory_main, "memory", False, 40, None),
             (checkpoint_main, "checkpoint", False, 90, None),
             (control_plane_main, None, False, 150, None),
         ]
@@ -1469,6 +1618,11 @@ if __name__ == "__main__":
                         f"{budget:.0f}s budget; running --tiny probe — "
                         f"run `python bench.py --sharded-optimizer` "
                         f"for the real row")
+                elif fn is memory_main:
+                    trimmed = True
+                    log(f"TRIMMED memory: over the {budget:.0f}s budget; "
+                        f"running --tiny probe — run "
+                        f"`python bench.py --memory` for the real row")
                 elif fn is checkpoint_main:
                     trimmed = True
                     log(f"TRIMMED checkpoint: over the {budget:.0f}s "
@@ -1485,7 +1639,8 @@ if __name__ == "__main__":
                 if fn is transformer_main:
                     results.append(fn(arg, allow_env=False,
                                       micro_step_cap=cap))
-                elif fn is sharded_optimizer_main or fn is checkpoint_main:
+                elif (fn is sharded_optimizer_main
+                        or fn is checkpoint_main or fn is memory_main):
                     results.append(fn(tiny=trimmed))
                 elif fn is control_plane_main:
                     results.extend(control_plane_main(

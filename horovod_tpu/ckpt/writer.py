@@ -258,7 +258,7 @@ class CheckpointManager:
                             DEFAULT_BARRIER_TIMEOUT))
         self._generation_fn = generation_fn or (lambda: 0)
         self._fault = parse_fault(os.environ.get(HOROVOD_CKPT_FAULT, ""))
-        self._slab = FusionBufferManager()
+        self._slab = FusionBufferManager(purpose="ckpt_staging")
         # one-slot blocking handoff: commit() blocks while a prior write
         # is still queued (back-pressure keeps all ranks on the same
         # step set — a latest-wins queue would starve the barrier)

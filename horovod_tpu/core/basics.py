@@ -144,6 +144,13 @@ def init(
 
         profiler.configure(rank=st.rank)
 
+        # memory plane: adopt the rank, register the flight-recorder
+        # "memory" state provider, start the reconciliation sampler
+        # (HOROVOD_MEMORY / HOROVOD_MEMORY_SAMPLE_SECONDS)
+        from horovod_tpu import memory
+
+        memory.configure(rank=st.rank)
+
         if st.config.timeline_file:
             from horovod_tpu.timeline import Timeline
 
@@ -202,6 +209,11 @@ def shutdown() -> None:
         from horovod_tpu import profiler
 
         profiler.finalize()
+        # memory plane: stop the sampler so it doesn't outlive the state
+        # it reconciles (re-init restarts it with the new rank)
+        from horovod_tpu import memory
+
+        memory.tracker().stop()
         flight_recorder.emit("shutdown", rank=st.rank)
         # leave a final dump behind (and ship it to the launcher) so the
         # postmortem covers clean exits too — only when a destination is

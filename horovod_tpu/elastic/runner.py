@@ -415,5 +415,14 @@ def run(func):
                     if isinstance(exc, exceptions.WorkerStallError)
                     else "worker_lost")
                 rollback = True
+            except Exception as exc:
+                # elastic OOM boundary: an XLA RESOURCE_EXHAUSTED raised
+                # by user step code (not through the executor) still gets
+                # forensics — ledger + top-k live arrays in the dump —
+                # before propagating. Anything else re-raises untouched.
+                from horovod_tpu import memory
+
+                memory.maybe_record_oom(exc, where="elastic")
+                raise
 
     return wrapper

@@ -518,4 +518,16 @@ def format_postmortem(dumps: List[dict], last_n: int = 40,
         lines.append("suspected culprit: rank %s (%s)" % culprit)
     else:
         lines.append("suspected culprit: none identified")
+    try:
+        # cross-rank memory report from the dumps' "memory" state (PR 13;
+        # empty for pre-memory-plane dumps). Lazy: memory.py imports this
+        # module.
+        from horovod_tpu import memory
+
+        report = memory.format_memory_report(dumps)
+        if report:
+            lines.append("")
+            lines.append(report)
+    except Exception:
+        pass  # the postmortem renders even if the memory plane is broken
     return "\n".join(lines)

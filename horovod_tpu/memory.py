@@ -1,0 +1,605 @@
+"""Memory telemetry plane: per-subsystem HBM/host accounting + OOM forensics.
+
+The time plane (metrics.py, flight_recorder.py, profiler.py, PRs 1/4/6)
+answers "where did the time go?"; this module answers "where did the
+bytes go?". One process-wide :class:`MemoryTracker` holds a ledger of
+live-bytes and high-watermarks per byte-holding subsystem:
+
+* ``params`` / ``grads`` — pushed by the eager ``DistributedOptimizer``
+  update path (:mod:`horovod_tpu.parallel.dp`);
+* ``optimizer_shards`` — pushed by the ZeRO-1 state accounting
+  (:mod:`horovod_tpu.parallel.zero`);
+* ``fusion`` / ``ckpt_staging`` — pulled from the fusion-buffer slab
+  registry (:func:`horovod_tpu.runtime.fusion_buffer.bytes_by_purpose`),
+  which distinguishes resident slab bytes from *leased* (live) bytes so
+  a leaked lease is visible;
+* ``serve_kv`` — pulled from the live :class:`~horovod_tpu.serve.
+  kv_cache.DecodeEngine` registry;
+* ``program_cache`` — pulled from the executors' compiled-program caches
+  (estimated from the bucket-stable cache keys: rows x capacity x
+  itemsize per program);
+* ``host_rss`` — the process VmRSS from ``/proc/self/status``.
+
+Claimed bytes are reconciled against **device truth** on a sampling
+cadence (``HOROVOD_MEMORY_SAMPLE_SECONDS``): ``jax.Device.
+memory_stats()`` where the backend reports it (TPU/GPU), a
+``jax.live_arrays()`` sweep otherwise (the CPU backend under tier-1).
+The drift between claimed and actual device bytes is itself a gauge
+(``horovod_memory_reconcile_drift_ratio``) — accounting rot shows up as
+a metric, not a surprise at the next OOM.
+
+Surfaces (each mirrors where the time plane already lives):
+
+* ``horovod_memory_*`` metric families + ``GET /memory`` on the metrics
+  server (docs/memory.md);
+* a ``memory`` flight-recorder state provider — every dump (crash,
+  stall, SIGUSR1) carries the ledger;
+* per-step ``peak_hbm_bytes`` in the profiler breakdown and a memory
+  counter track in the merged Perfetto trace;
+* OOM forensics: :func:`is_oom` / :func:`record_oom` catch
+  ``RESOURCE_EXHAUSTED`` at the executor and elastic boundaries and dump
+  the ledger + top-k live arrays (shape/dtype/owner);
+  :func:`format_memory_report` renders the cross-rank postmortem section
+  naming the dominant subsystem and the rank nearest its HBM ceiling
+  (``tpurun --postmortem``).
+
+Env knobs (registered in utils/env.py, table in docs/memory.md):
+``HOROVOD_MEMORY`` (sampler on/off, default on),
+``HOROVOD_MEMORY_SAMPLE_SECONDS`` (cadence, default 10),
+``HOROVOD_MEMORY_TOPK`` (live arrays in forensics dumps, default 8).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from horovod_tpu.analysis import witness
+from horovod_tpu.metrics import registry as _metrics
+from horovod_tpu.utils.env import _get_bool, _get_float, _get_int
+
+HOROVOD_MEMORY = "HOROVOD_MEMORY"
+HOROVOD_MEMORY_SAMPLE_SECONDS = "HOROVOD_MEMORY_SAMPLE_SECONDS"
+HOROVOD_MEMORY_TOPK = "HOROVOD_MEMORY_TOPK"
+
+DEFAULT_SAMPLE_SECONDS = 10.0
+DEFAULT_TOPK = 8
+_SAMPLE_RING = 512  # bounded: ~85 min of samples at the default cadence
+
+_BYTES = _metrics().gauge(
+    "horovod_memory_bytes",
+    "Live bytes claimed per subsystem (params, grads, optimizer_shards, "
+    "fusion, ckpt_staging, serve_kv, program_cache, host_rss).",
+    labelnames=("subsystem",))
+_PEAK_BYTES = _metrics().gauge(
+    "horovod_memory_peak_bytes",
+    "High watermark of the per-subsystem live bytes since process start.",
+    labelnames=("subsystem",))
+_DEVICE_BYTES = _metrics().gauge(
+    "horovod_memory_device_bytes_in_use",
+    "Device truth: bytes_in_use from jax.Device.memory_stats() (or the "
+    "jax.live_arrays() sum where the backend reports no stats).")
+_DEVICE_PEAK = _metrics().gauge(
+    "horovod_memory_device_peak_bytes",
+    "Device truth: peak_bytes_in_use high watermark.")
+_DEVICE_LIMIT = _metrics().gauge(
+    "horovod_memory_device_limit_bytes",
+    "Device HBM ceiling (bytes_limit from memory_stats; 0 when the "
+    "backend does not report one).")
+_HOST_RSS = _metrics().gauge(
+    "horovod_memory_host_rss_bytes",
+    "Process resident set size (VmRSS from /proc/self/status).")
+_DRIFT = _metrics().gauge(
+    "horovod_memory_reconcile_drift_ratio",
+    "Relative drift between claimed device-resident bytes and device "
+    "truth: (actual - claimed) / actual. Accounting rot is a metric.")
+_SAMPLES = _metrics().counter(
+    "horovod_memory_samples_total",
+    "Reconciliation sweeps completed by the memory sampler.")
+_OOMS = _metrics().counter(
+    "horovod_memory_oom_total",
+    "RESOURCE_EXHAUSTED errors caught and turned into forensics dumps.")
+
+# subsystems whose bytes live in device memory (HBM) — the reconciliation
+# set; everything else (fusion slabs, ckpt staging, host_rss) is host-side
+DEVICE_SUBSYSTEMS = ("params", "grads", "optimizer_shards", "serve_kv")
+
+
+def host_rss_bytes() -> int:
+    """VmRSS of this process, 0 when /proc is unavailable (non-Linux)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """``memory_stats()`` of the first local device, ``{}`` when the
+    backend (e.g. CPU) does not implement it."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return {}
+    if not isinstance(stats, dict):
+        return {}
+    return {k: int(v) for k, v in stats.items()
+            if isinstance(v, (int, float))}
+
+
+def live_array_bytes() -> int:
+    """Total bytes of every live jax.Array on this process — the device
+    truth of last resort (works on every backend, including CPU)."""
+    try:
+        import jax
+
+        return sum(int(getattr(a, "nbytes", 0) or 0)
+                   for a in jax.live_arrays())
+    except Exception:
+        return 0
+
+
+class MemoryTracker:
+    """Process-wide byte ledger: push gauges, pull providers, watermarks,
+    a reconciliation sampler, and the OOM forensics state.
+
+    Hot-path cost when idle is one attribute read (``enabled``); push
+    updates are a dict store + two gauge sets under a short lock."""
+
+    def __init__(self) -> None:
+        self._lock = witness.make_lock("MemoryTracker._lock")
+        self._claimed: Dict[str, int] = {}       # guarded-by: _lock
+        self._peaks: Dict[str, int] = {}         # guarded-by: _lock
+        self._providers: Dict[str, Callable[[], int]] = {}  # guarded-by: _lock
+        # id -> (weakref, subsystem) for adopted arrays; jax.Array is
+        # unhashable, so ownership is keyed by id with a removal callback
+        self._owned: Dict[int, Any] = {}         # guarded-by: _lock
+        self._samples: deque = deque(maxlen=_SAMPLE_RING)  # guarded-by: _lock
+        self._last_oom: Optional[dict] = None    # guarded-by: _lock
+        self._sampler: Optional[threading.Thread] = None  # guarded-by: _lock
+        self._stop = threading.Event()
+        self.enabled = True   # accounting; the sampler thread is separate
+        self.rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+        self.sample_seconds = DEFAULT_SAMPLE_SECONDS
+        self.topk = DEFAULT_TOPK
+
+    # -- accounting (push) -------------------------------------------------
+    def set_bytes(self, subsystem: str, nbytes: int) -> None:
+        """Record ``subsystem``'s current live bytes and roll its peak."""
+        if not self.enabled:
+            return
+        nbytes = int(nbytes)
+        with self._lock:
+            self._claimed[subsystem] = nbytes
+            peak = self._peaks.get(subsystem, 0)
+            if nbytes > peak:
+                peak = nbytes
+                self._peaks[subsystem] = peak
+        _BYTES.labels(subsystem=subsystem).set(nbytes)
+        _PEAK_BYTES.labels(subsystem=subsystem).set(peak)
+
+    def note_tree_bytes(self, subsystem: str, tree) -> None:
+        """``set_bytes`` over a pytree's array leaves (cheap: shape math
+        only, no device transfer)."""
+        if not self.enabled:
+            return
+        try:
+            import jax
+
+            total = sum(int(getattr(leaf, "nbytes", 0) or 0)
+                        for leaf in jax.tree_util.tree_leaves(tree))
+        except Exception:
+            return
+        self.set_bytes(subsystem, total)
+
+    # -- accounting (pull) -------------------------------------------------
+    def register(self, subsystem: str,
+                 fn: Optional[Callable[[], int]]) -> None:
+        """Register a live-bytes provider polled at each sample/snapshot;
+        ``None`` unregisters. Providers run OUTSIDE the tracker lock (a
+        provider typically takes its own subsystem lock)."""
+        with self._lock:
+            if fn is None:
+                self._providers.pop(subsystem, None)
+            else:
+                self._providers[subsystem] = fn
+
+    # -- ownership attribution --------------------------------------------
+    def adopt(self, subsystem: str, tree) -> None:
+        """Tag the array leaves of ``tree`` as owned by ``subsystem`` so
+        :func:`top_live_arrays` can attribute them. Weakref-tracked: a
+        freed array drops out of the registry automatically."""
+        if not self.enabled:
+            return
+        try:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(tree)
+        except Exception:
+            return
+        for leaf in leaves:
+            if not hasattr(leaf, "nbytes"):
+                continue
+            key = id(leaf)
+            try:
+                ref = weakref.ref(leaf, lambda _r, _k=key: self._disown(_k))
+            except TypeError:
+                continue  # not weakref-able (e.g. plain numpy scalar)
+            with self._lock:
+                self._owned[key] = (ref, subsystem)
+
+    def _disown(self, key: int) -> None:
+        with self._lock:
+            self._owned.pop(key, None)
+
+    def owner_of(self, arr) -> Optional[str]:
+        with self._lock:
+            entry = self._owned.get(id(arr))
+        if entry is None:
+            return None
+        ref, subsystem = entry
+        return subsystem if ref() is arr else None
+
+    # -- snapshots ---------------------------------------------------------
+    def _collect(self) -> Dict[str, int]:
+        """Merged claimed-bytes map: pushed values + polled providers +
+        the built-in sources (fusion slabs, serve KV, program caches,
+        host RSS). Providers run outside the lock."""
+        with self._lock:
+            claimed = dict(self._claimed)
+            providers = list(self._providers.items())
+        for name, fn in providers:
+            try:
+                claimed[name] = int(fn())
+            except Exception:
+                pass  # a dying subsystem must not break accounting
+        try:
+            from horovod_tpu.runtime import fusion_buffer
+
+            for purpose, rec in fusion_buffer.bytes_by_purpose().items():
+                claimed[purpose] = int(rec["allocated_bytes"])
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.serve import kv_cache
+
+            claimed["serve_kv"] = int(kv_cache.total_cache_bytes())
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.runtime import executor as executor_mod
+
+            claimed["program_cache"] = int(
+                executor_mod.program_cache_bytes())
+        except Exception:
+            pass
+        claimed["host_rss"] = host_rss_bytes()
+        # fold polled values back through the peak/gauge bookkeeping
+        for name, nbytes in claimed.items():
+            self.set_bytes(name, nbytes)
+        return claimed
+
+    def ledger(self) -> dict:
+        """The per-subsystem ledger + device truth + drift — the payload
+        of the flight-recorder ``memory`` state provider, so every dump
+        carries it."""
+        claimed = self._collect()
+        device = device_memory_stats()
+        actual = int(device.get("bytes_in_use", 0)) or live_array_bytes()
+        claimed_device = sum(claimed.get(s, 0) for s in DEVICE_SUBSYSTEMS)
+        drift = None
+        if actual > 0:
+            drift = (actual - claimed_device) / actual
+            _DRIFT.set(round(drift, 6))
+        _DEVICE_BYTES.set(actual)
+        if device.get("peak_bytes_in_use"):
+            _DEVICE_PEAK.set(int(device["peak_bytes_in_use"]))
+        if device.get("bytes_limit"):
+            _DEVICE_LIMIT.set(int(device["bytes_limit"]))
+        _HOST_RSS.set(claimed.get("host_rss", 0))
+        with self._lock:
+            peaks = dict(self._peaks)
+            last_oom = self._last_oom
+        return {
+            "rank": self.rank,
+            "wall_time": time.time(),
+            "subsystems": {
+                name: {"bytes": nbytes, "peak_bytes": peaks.get(name, nbytes)}
+                for name, nbytes in sorted(claimed.items())},
+            "total_claimed_bytes": sum(claimed.values())
+            - claimed.get("host_rss", 0),
+            "claimed_device_bytes": claimed_device,
+            "device": {
+                "bytes_in_use": int(device.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(device.get("peak_bytes_in_use", 0)),
+                "bytes_limit": int(device.get("bytes_limit", 0)),
+                "live_array_bytes": (actual if not device.get("bytes_in_use")
+                                     else live_array_bytes()),
+            },
+            "reconcile_drift_ratio": drift,
+            "last_oom": last_oom,
+        }
+
+    def top_live_arrays(self, k: Optional[int] = None) -> List[dict]:
+        """The top-k live jax arrays by size, with shape/dtype/owner —
+        the forensic core of an OOM dump."""
+        k = self.topk if k is None else int(k)
+        try:
+            import jax
+
+            arrays = list(jax.live_arrays())
+        except Exception:
+            return []
+        arrays.sort(key=lambda a: int(getattr(a, "nbytes", 0) or 0),
+                    reverse=True)
+        out = []
+        for a in arrays[:k]:
+            out.append({
+                "bytes": int(getattr(a, "nbytes", 0) or 0),
+                "shape": list(getattr(a, "shape", ())),
+                "dtype": str(getattr(a, "dtype", "?")),
+                "owner": self.owner_of(a) or "unattributed",
+            })
+        return out
+
+    def peak_hbm_bytes(self) -> int:
+        """High watermark for the profiler's per-step breakdown: device
+        peak_bytes_in_use where reported, the claimed-total watermark
+        otherwise (CPU backend)."""
+        device = device_memory_stats()
+        if device.get("peak_bytes_in_use"):
+            return int(device["peak_bytes_in_use"])
+        with self._lock:
+            return sum(v for k, v in self._peaks.items()
+                       if k in DEVICE_SUBSYSTEMS)
+
+    def samples(self) -> List[list]:
+        """The sampler's ring: [wall_time, claimed_device, actual_device]
+        rows — the merged-trace memory counter track reads this."""
+        with self._lock:
+            return [list(s) for s in self._samples]
+
+    # -- sampler -----------------------------------------------------------
+    def sample(self) -> dict:
+        """One reconciliation sweep; appends to the sample ring."""
+        led = self.ledger()
+        with self._lock:
+            self._samples.append((led["wall_time"],
+                                  led["claimed_device_bytes"],
+                                  led["device"]["bytes_in_use"]
+                                  or led["device"]["live_array_bytes"]))
+        _SAMPLES.inc()
+        return led
+
+    def start(self, interval: Optional[float] = None) -> None:
+        """Start the sampling thread (idempotent)."""
+        if interval is not None:
+            self.sample_seconds = float(interval)
+        with self._lock:
+            if self._sampler is not None and self._sampler.is_alive():
+                return
+            self._stop.clear()
+            self._sampler = threading.Thread(
+                target=self._sample_loop, daemon=True, name="hvd-memory")
+            self._sampler.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            sampler, self._sampler = self._sampler, None
+        self._stop.set()
+        if sampler is not None:
+            sampler.join(timeout=5.0)
+
+    def _sample_loop(self) -> None:
+        while not self._stop.wait(self.sample_seconds):
+            try:
+                self.sample()
+            except Exception:  # the sampler must never kill the process
+                pass
+
+    # -- OOM forensics -----------------------------------------------------
+    def record_oom(self, exc: Exception, where: str) -> dict:
+        """Turn a RESOURCE_EXHAUSTED into forensics: ledger + top-k live
+        arrays + dominant subsystem, stored on the tracker (so the
+        flight-recorder ``memory`` provider embeds it in the dump that
+        follows) and emitted as a flight event."""
+        _OOMS.inc()
+        try:
+            led = self.ledger()
+        except Exception:
+            led = {"subsystems": {}}
+        top = self.top_live_arrays()
+        subsystems = led.get("subsystems", {})
+        dominant = None
+        if subsystems:
+            dominant = max(
+                (s for s in subsystems if s != "host_rss"),
+                key=lambda s: subsystems[s]["bytes"], default=None)
+        forensics = {
+            "where": where,
+            "error": str(exc)[:2000],
+            "wall_time": time.time(),
+            "dominant_subsystem": dominant,
+            "top_live_arrays": top,
+            "subsystems": subsystems,
+        }
+        with self._lock:
+            self._last_oom = forensics
+        from horovod_tpu import flight_recorder
+
+        flight_recorder.emit(
+            "oom", where=where, dominant_subsystem=dominant,
+            device_bytes_in_use=led.get("device", {}).get("bytes_in_use"),
+            error=str(exc)[:200])
+        flight_recorder.dump_on_failure("oom")
+        return forensics
+
+    def last_oom(self) -> Optional[dict]:
+        with self._lock:
+            return self._last_oom
+
+
+_tracker = MemoryTracker()
+
+
+def tracker() -> MemoryTracker:
+    return _tracker
+
+
+def configure(rank: Optional[int] = None) -> None:
+    """Adopt the rank, parse the ``HOROVOD_MEMORY_*`` knobs, register the
+    flight-recorder ``memory`` state provider, and start the sampler.
+    Called from ``hvd.init()`` (idempotent across elastic re-inits)."""
+    t = _tracker
+    if rank is not None:
+        t.rank = int(rank)
+    t.enabled = _get_bool(HOROVOD_MEMORY, True)
+    t.sample_seconds = _get_float(HOROVOD_MEMORY_SAMPLE_SECONDS,
+                                  DEFAULT_SAMPLE_SECONDS)
+    t.topk = _get_int(HOROVOD_MEMORY_TOPK, DEFAULT_TOPK)
+    from horovod_tpu import flight_recorder
+
+    if t.enabled:
+        flight_recorder.set_state_provider("memory", t.ledger)
+        t.start()
+    else:
+        flight_recorder.set_state_provider("memory", None)
+        t.stop()
+
+
+def memory_state() -> dict:
+    """Document for the metrics server's ``GET /memory`` route: the
+    ledger + top live arrays + the recent sample trail."""
+    t = _tracker
+    state = t.ledger()
+    state["top_live_arrays"] = t.top_live_arrays()
+    state["samples"] = t.samples()[-64:]
+    state["sample_seconds"] = t.sample_seconds
+    return state
+
+
+# -- OOM detection -----------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM when allocating")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True for XLA allocator exhaustion (``XlaRuntimeError`` with
+    RESOURCE_EXHAUSTED, or any allocator OOM text — the message is the
+    only stable contract across jaxlib versions)."""
+    if exc is None:
+        return False
+    if type(exc).__name__ == "XlaRuntimeError":
+        return any(m in str(exc) for m in _OOM_MARKERS)
+    return any(m in str(exc) for m in _OOM_MARKERS[:1]) or \
+        "MemoryError" == type(exc).__name__
+
+
+def maybe_record_oom(exc: BaseException, where: str) -> bool:
+    """The executor/elastic boundary hook: one call, no-op unless the
+    exception is an OOM. Never raises (runs on failing paths)."""
+    try:
+        if not is_oom(exc):
+            return False
+        _tracker.record_oom(exc, where)
+        return True
+    except Exception:
+        return False
+
+
+# -- cross-rank postmortem ----------------------------------------------------
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return ("%.1f %s" % (n, unit)) if unit != "B" \
+                else ("%d B" % int(n))
+        n /= 1024.0
+    return "%d B" % int(n)
+
+
+def format_memory_report(dumps: List[dict]) -> str:
+    """Cross-rank memory report from flight-recorder dumps' ``memory``
+    state: per-rank claimed/actual bytes, the dominant subsystem across
+    the fleet, and the rank nearest its HBM ceiling. Empty string when no
+    dump carries a memory ledger (pre-PR-13 dumps)."""
+    ranks = []
+    for d in dumps:
+        mem = (d.get("state") or {}).get("memory")
+        if not isinstance(mem, dict):
+            continue
+        ranks.append((d.get("launch_rank", d.get("rank", "?")), mem))
+    if not ranks:
+        return ""
+    lines = ["=== memory report (%d rank%s) ==="
+             % (len(ranks), "" if len(ranks) == 1 else "s")]
+    totals: Dict[str, int] = {}
+    nearest = None  # (rank, headroom_ratio, in_use, limit)
+    for rank, mem in sorted(ranks, key=lambda r: str(r[0])):
+        subs = mem.get("subsystems", {})
+        for name, rec in subs.items():
+            if name == "host_rss":
+                continue
+            totals[name] = totals.get(name, 0) + int(rec.get("bytes", 0))
+        device = mem.get("device", {})
+        in_use = int(device.get("bytes_in_use", 0)) \
+            or int(device.get("live_array_bytes", 0))
+        limit = int(device.get("bytes_limit", 0))
+        ratio = (in_use / limit) if limit else None
+        drift = mem.get("reconcile_drift_ratio")
+        top = ", ".join(
+            "%s=%s" % (n, _fmt_bytes(r.get("bytes", 0)))
+            for n, r in sorted(subs.items(),
+                               key=lambda kv: -int(kv[1].get("bytes", 0)))
+            if n != "host_rss")[:200]
+        lines.append(
+            "rank %s: device %s in use%s, host rss %s%s%s" % (
+                rank, _fmt_bytes(in_use),
+                (" / %s limit (%.1f%%)" % (_fmt_bytes(limit),
+                                           100.0 * ratio))
+                if ratio is not None else "",
+                _fmt_bytes(subs.get("host_rss", {}).get("bytes", 0)),
+                ("  drift=%+.1f%%" % (100.0 * drift))
+                if isinstance(drift, (int, float)) else "",
+                ("  [%s]" % top) if top else ""))
+        oom = mem.get("last_oom")
+        if isinstance(oom, dict):
+            lines.append(
+                "rank %s: OOM at %s — dominant subsystem %s" % (
+                    rank, oom.get("where", "?"),
+                    oom.get("dominant_subsystem", "?")))
+            for arr in (oom.get("top_live_arrays") or ())[:3]:
+                lines.append(
+                    "    live array %s %s %s (%s)" % (
+                        _fmt_bytes(arr.get("bytes", 0)),
+                        tuple(arr.get("shape", ())),
+                        arr.get("dtype", "?"),
+                        arr.get("owner", "unattributed")))
+        key = ratio if ratio is not None else float(in_use)
+        if nearest is None or key > nearest[1]:
+            nearest = (rank, key, in_use, limit)
+    if totals:
+        dominant = max(totals, key=lambda k: totals[k])
+        lines.append("dominant subsystem: %s (%s across %d rank%s)"
+                     % (dominant, _fmt_bytes(totals[dominant]), len(ranks),
+                        "" if len(ranks) == 1 else "s"))
+    if nearest is not None:
+        rank, _key, in_use, limit = nearest
+        lines.append(
+            "nearest HBM ceiling: rank %s (%s in use%s)" % (
+                rank, _fmt_bytes(in_use),
+                (" of %s, %.1f%% full" % (_fmt_bytes(limit),
+                                          100.0 * in_use / limit))
+                if limit else ""))
+    return "\n".join(lines)

@@ -324,6 +324,20 @@ class MetricsRegistry:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif route == "/memory":
+                    # memory-plane ledger: per-subsystem live bytes +
+                    # watermarks, device truth, drift, top live arrays
+                    # (memory.memory_state; docs/memory.md)
+                    from horovod_tpu import memory
+
+                    body = json.dumps(
+                        memory.memory_state(),
+                        default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_error(404)
 

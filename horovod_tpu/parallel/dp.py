@@ -209,6 +209,16 @@ def DistributedOptimizer(
         eager = _profiler.enabled() and not traced
         if eager:
             _profiler.auto_step()
+        if not traced:
+            # memory plane: grads/params live-bytes (shape math only);
+            # inside jit these are tracers and the step owns the bytes
+            from horovod_tpu import memory as _memory
+
+            _t = _memory.tracker()
+            if _t.enabled:
+                _t.note_tree_bytes("grads", grads)
+                if params is not None:
+                    _t.note_tree_bytes("params", params)
         reduced = allreduce_gradients(
             grads, average=average, compression=compression,
             axis_name=axis_name, sparse_as_dense=sparse_as_dense,

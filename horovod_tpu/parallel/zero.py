@@ -281,6 +281,9 @@ def _set_state_bytes(inner_state, world: int) -> None:
             nbytes //= world  # stacked (W, shard): 1/W lives per chip
         total += nbytes
     _STATE_BYTES.set(total)
+    from horovod_tpu import memory
+
+    memory.tracker().set_bytes("optimizer_shards", total)
 
 
 # ---------------------------------------------------------------------------

@@ -327,12 +327,25 @@ class StepProfiler:
         _STEP_SECONDS.observe(wall)
         _HIDDEN_FRACTION.set(hidden_fraction)
 
+        # memory plane: HBM high watermark observed by the end of this
+        # step (device peak_bytes_in_use where reported, the tracker's
+        # claimed-total watermark on stat-less backends). Cumulative —
+        # the allocator does not reset its peak per step.
+        peak_hbm = None
+        try:
+            from horovod_tpu import memory
+
+            peak_hbm = memory.tracker().peak_hbm_bytes()
+        except Exception:
+            pass
+
         rec.breakdown = {
             "step": rec.index,
             "name": rec.name,
             "auto": rec.auto,
             "t_start": rec.t0_epoch,
             "wall_seconds": wall,
+            "peak_hbm_bytes": peak_hbm,
             "phases": phases,
             "comm": {"total_seconds": comm_total,
                      "exposed_seconds": comm_exposed,
@@ -421,6 +434,16 @@ class StepProfiler:
 
     # -- dump / ship --------------------------------------------------------
     def snapshot(self) -> dict:
+        # memory plane: the reconciliation sampler's trail rides in the
+        # profile dump so the merged Perfetto trace gets a per-rank
+        # memory counter track (merge_profile_dir)
+        memory_samples = []
+        try:
+            from horovod_tpu import memory
+
+            memory_samples = memory.tracker().samples()
+        except Exception:
+            pass
         return {
             "schema": SCHEMA,
             "rank": self.rank,
@@ -433,6 +456,7 @@ class StepProfiler:
             "peak_flops_per_chip": self._peak_flops,
             "steps": list(self._steps),
             "trace_events": list(self._trace_events),
+            "memory_samples": memory_samples,
             "flight_events": flight_recorder.recorder().events()
             [-_FLIGHT_TRACE_EVENTS:],
         }
@@ -587,6 +611,24 @@ def _flight_trace_events(dump: dict) -> List[dict]:
     return out
 
 
+def _memory_trace_events(dump: dict) -> List[dict]:
+    """The memory sampler's trail as a Chrome counter ("C") track —
+    claimed vs actual device bytes per reconciliation sweep, rendered by
+    Perfetto as an area chart on the rank's lane."""
+    out = []
+    for row in dump.get("memory_samples", ()):
+        try:
+            t, claimed, actual = row[0], int(row[1]), int(row[2])
+        except (TypeError, ValueError, IndexError):
+            continue
+        if not isinstance(t, (int, float)):
+            continue
+        out.append({"ph": "C", "pid": 0, "tid": 0, "ts": t * 1e6,
+                    "name": "device memory (bytes)",
+                    "args": {"claimed": claimed, "actual": actual}})
+    return out
+
+
 def _device_trace_files(directory: str) -> List[str]:
     """jax.profiler output below the profile dir: TensorBoard's profile
     plugin writes ``*.trace.json.gz`` under a nested run directory."""
@@ -632,6 +674,7 @@ def merge_profile_dir(directory: str,
         events = [e for e in d.get("trace_events", ())
                   if isinstance(e, dict)]
         events += _flight_trace_events(d)
+        events += _memory_trace_events(d)
         if events:
             lanes.append((f"rank {rank} steps", events, offset))
     for path in sorted(glob.glob(os.path.join(directory,

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -111,6 +112,19 @@ class _CommClock:
 
 
 _comm_clock = _CommClock()
+
+# every live executor, so the memory tracker's "program_cache" subsystem
+# can estimate compiled-program working sets without a push on the hot path
+_executors_lock = witness.make_lock("executor._executors_lock")
+_executors: "weakref.WeakSet" = weakref.WeakSet()  # guarded-by: _executors_lock
+
+
+def program_cache_bytes() -> int:
+    """Estimated bytes of the fused-program working sets across every
+    live executor — the memory tracker's ``program_cache`` pull source."""
+    with _executors_lock:
+        executors = list(_executors)
+    return sum(e.program_cache_bytes() for e in executors)
 
 
 def comm_totals() -> dict:
@@ -239,7 +253,12 @@ class _PendingOp:
 
     def fail_exc(self, exc: Exception) -> None:
         from horovod_tpu import exceptions
+        from horovod_tpu import memory
 
+        # HBM exhaustion forensics: one choke point covers dispatch-time
+        # and drain-time failures on all three data planes. No-op unless
+        # the exception is an allocator OOM; never raises.
+        memory.maybe_record_oom(exc, where="executor")
         if (isinstance(exc, exceptions.NumericalError)
                 and self.executor.integrity_failure is None):
             # a typed integrity verdict must reach the waiting caller
@@ -319,6 +338,8 @@ class Executor:
                                if quantum is not None
                                else FusionBufferManager())
         self._ag_staging = bytearray()  # allgather wire staging (reused)
+        with _executors_lock:
+            _executors.add(self)
         # Multi-process with a global mesh (jax.distributed): the hot op
         # (allreduce) must ride XLA collectives over ICI/DCN, not the host
         # TCP ring — the ring stays as control plane + fallback. Requires
@@ -430,6 +451,30 @@ class Executor:
         with self._lock:
             self._programs[key] = fn
         return fn
+
+    def program_cache_bytes(self) -> int:
+        """Estimated working-set bytes of the compiled-program cache,
+        derived from the size-bucketed cache keys (the fused input buffer
+        each program was specialized for — the persistent device-side
+        footprint the cache pins)."""
+        import numpy as np
+
+        with self._lock:
+            keys = list(self._programs)
+        total = 0
+        for key in keys:
+            try:
+                kind = key[0]
+                if kind in ("fused_allreduce", "digest_nf"):
+                    rows, n, dtype = int(key[1]), int(key[2]), key[3]
+                elif kind == "spmd_allreduce":
+                    rows, n, dtype = jax.process_count(), int(key[1]), key[2]
+                else:
+                    continue
+                total += rows * n * np.dtype(dtype).itemsize
+            except Exception:
+                continue  # an unparseable key must not break accounting
+        return total
 
     def hierarchical_available(self) -> bool:
         """Two-level collectives need both mesh axes populated (reference

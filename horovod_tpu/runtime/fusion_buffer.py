@@ -23,6 +23,7 @@ ones for product) and the executor slices the pad off before unpacking.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -41,7 +42,48 @@ _BUF_REUSES = _metrics().counter(
     "Fusion-buffer leases served from an existing slab (no allocation).")
 _BUF_BYTES = _metrics().gauge(
     "horovod_fusion_buffer_bytes",
-    "Total bytes held in persistent fusion buffers.")
+    "Total bytes held in persistent fusion buffers (resident slabs, "
+    "leased or free), per purpose.", labelnames=("purpose",))
+_BUF_LIVE_BYTES = _metrics().gauge(
+    "horovod_fusion_buffer_live_bytes",
+    "Bytes in fusion slabs currently checked out on a lease, per purpose. "
+    "Returns to 0 between cycles; a leaked lease is visible here.",
+    labelnames=("purpose",))
+_BUF_LEASES_OUT = _metrics().gauge(
+    "horovod_fusion_buffer_leases_outstanding",
+    "Fusion-buffer leases acquired and not yet released, per purpose.",
+    labelnames=("purpose",))
+
+# every live manager, so the memory tracker can pull a per-purpose ledger
+# (weak: an executor teardown drops its manager without unregistering)
+_managers_lock = witness.make_lock("fusion_buffer._managers_lock")
+_managers: "weakref.WeakSet" = weakref.WeakSet()  # guarded-by: _managers_lock
+
+
+def bytes_by_purpose() -> Dict[str, Dict[str, int]]:
+    """Aggregate slab accounting across every live manager, keyed by
+    purpose label ("fusion" for data-plane staging, "ckpt_staging" for
+    checkpoint slabs). The memory tracker's pull source."""
+    with _managers_lock:
+        managers = list(_managers)
+    out: Dict[str, Dict[str, int]] = {}
+    for mgr in managers:
+        rec = out.setdefault(mgr.purpose, {
+            "allocated_bytes": 0, "live_bytes": 0, "leases_outstanding": 0})
+        rec["allocated_bytes"] += mgr.allocated_bytes()
+        rec["live_bytes"] += mgr.live_bytes()
+        rec["leases_outstanding"] += mgr.leases_outstanding()
+    return out
+
+
+def _refresh_gauges(purpose: str) -> None:
+    rec = bytes_by_purpose().get(purpose)
+    if rec is None:  # last manager of this purpose died
+        rec = {"allocated_bytes": 0, "live_bytes": 0,
+               "leases_outstanding": 0}
+    _BUF_BYTES.labels(purpose=purpose).set(rec["allocated_bytes"])
+    _BUF_LIVE_BYTES.labels(purpose=purpose).set(rec["live_bytes"])
+    _BUF_LEASES_OUT.labels(purpose=purpose).set(rec["leases_outstanding"])
 
 DEFAULT_BUCKET_QUANTUM_BYTES = env_mod.DEFAULT_FUSION_BUCKET_QUANTUM_BYTES
 
@@ -89,12 +131,13 @@ class BufferLease:
     """One checked-out fusion buffer: ``array`` is (rows, capacity) in the
     requested dtype; ``capacity`` is the bucket element count per row."""
 
-    __slots__ = ("array", "capacity", "_key")
+    __slots__ = ("array", "capacity", "_key", "_released")
 
     def __init__(self, array: np.ndarray, capacity: int, key: tuple):
         self.array = array
         self.capacity = capacity
         self._key = key
+        self._released = False
 
 
 class FusionBufferManager:
@@ -109,11 +152,17 @@ class FusionBufferManager:
     """
 
     def __init__(self,
-                 quantum_bytes: int = DEFAULT_BUCKET_QUANTUM_BYTES) -> None:
+                 quantum_bytes: int = DEFAULT_BUCKET_QUANTUM_BYTES,
+                 purpose: str = "fusion") -> None:
         self.quantum_bytes = int(quantum_bytes)
+        self.purpose = str(purpose)
         self._free: Dict[Tuple[int, int, str], List[np.ndarray]] = {}  # guarded-by: _lock
         self._lock = witness.make_lock("FusionBufferManager._lock")
         self._total_bytes = 0  # guarded-by: _lock
+        self._live_bytes = 0   # guarded-by: _lock
+        self._leases_out = 0   # guarded-by: _lock
+        with _managers_lock:
+            _managers.add(self)
 
     def bucket_elems(self, nelems: int, itemsize: int) -> int:
         return bucket_elems(nelems, itemsize, self.quantum_bytes)
@@ -128,18 +177,49 @@ class FusionBufferManager:
             free = self._free.get(key)
             if free:
                 _BUF_REUSES.inc()
-                return BufferLease(free.pop(), capacity, key)
+                array = free.pop()
+                self._live_bytes += array.nbytes
+                self._leases_out += 1
+                reused = True
+            else:
+                reused = False
+        if reused:
+            # gauge refresh re-takes _managers_lock then per-manager
+            # locks — must run outside our own _lock (lock order)
+            _refresh_gauges(self.purpose)
+            return BufferLease(array, capacity, key)
         _BUF_ALLOCS.inc()
         array = np.empty((int(rows), capacity), dt)
         with self._lock:
             self._total_bytes += array.nbytes
-            _BUF_BYTES.set(self._total_bytes)
+            self._live_bytes += array.nbytes
+            self._leases_out += 1
+        _refresh_gauges(self.purpose)
         return BufferLease(array, capacity, key)
 
     def release(self, lease: BufferLease) -> None:
+        """Return a lease's slab to the free list. Idempotent: failure
+        paths may release the same lease from more than one unwind."""
         with self._lock:
+            if lease._released:
+                return
+            lease._released = True
             self._free.setdefault(lease._key, []).append(lease.array)
+            self._live_bytes -= lease.array.nbytes
+            self._leases_out -= 1
+        _refresh_gauges(self.purpose)
 
     def allocated_bytes(self) -> int:
+        """Resident slab bytes (leased or free) — the slab pool's size."""
         with self._lock:
             return self._total_bytes
+
+    def live_bytes(self) -> int:
+        """Bytes currently checked out on a lease. Returns to 0 when all
+        leases are released — a leaked lease keeps this high forever."""
+        with self._lock:
+            return self._live_bytes
+
+    def leases_outstanding(self) -> int:
+        with self._lock:
+            return self._leases_out

@@ -33,6 +33,7 @@ integrity guard).
 from __future__ import annotations
 
 import time
+import weakref
 from typing import Dict, List, Tuple
 
 import jax
@@ -51,6 +52,23 @@ _COMPILES = _metrics().counter(
     "horovod_serve_compiles_total",
     "Serving programs compiled, by kind (steady state adds none).",
     labelnames=("program",))
+_KV_BYTES = _metrics().gauge(
+    "horovod_serve_kv_cache_bytes",
+    "KV-cache bytes resident per decode engine (replica).",
+    labelnames=("replica",))
+
+# every live engine, so the memory tracker's "serve_kv" subsystem can sum
+# resident cache bytes without the serve plane pushing on its hot path
+_engines_lock = witness.make_lock("kv_cache._engines_lock")
+_engines: "weakref.WeakSet" = weakref.WeakSet()  # guarded-by: _engines_lock
+
+
+def total_cache_bytes() -> int:
+    """Resident KV-cache bytes across every live engine on this process —
+    the memory tracker's pull source for the ``serve_kv`` subsystem."""
+    with _engines_lock:
+        engines = list(_engines)
+    return sum(e.cache_bytes() for e in engines)
 
 
 def prompt_bucket(prompt_len: int, max_seq: int,
@@ -83,6 +101,9 @@ class DecodeEngine:
         self._compiles: Dict[str, int] = {}      # guarded-by: _lock
         self.decode_steps = 0
         self.step_ms_ewma = 0.0
+        with _engines_lock:
+            _engines.add(self)
+        _KV_BYTES.labels(replica=self.name).set(self.cache_bytes())
 
     # -- cache -------------------------------------------------------------
     def _allocate_cache(self):

@@ -381,6 +381,12 @@ class Replica:
                 "waiting": self.batcher.waiting(),
                 "decode_steps": self.engine.decode_steps,
                 "avg_occupancy": round(self.occupancy_sum / steps, 3),
+                # memory plane: resident KV bytes + the slot-occupancy-
+                # weighted share of the cache that did useful work
+                "kv_cache_bytes": self.engine.cache_bytes(),
+                "kv_utilization": round(
+                    self.occupancy_sum
+                    / (steps * max(self.engine.num_slots, 1)), 3),
                 "engine": self.engine.stats()}
 
 

@@ -117,6 +117,9 @@ ENV_DIRECT_KNOBS = (
     "HOROVOD_GRAD_BUCKET_WIRE",
     # fused BN+activation epilogue (ops/pallas/conv_bn_act.py)
     "HOROVOD_FUSED_BN_ACT",
+    # memory telemetry plane (memory.py; docs/memory.md)
+    "HOROVOD_MEMORY", "HOROVOD_MEMORY_SAMPLE_SECONDS",
+    "HOROVOD_MEMORY_TOPK",
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379

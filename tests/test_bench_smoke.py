@@ -191,6 +191,37 @@ def test_bench_compare_r05_to_r06(bench_compare):
     assert bench_compare.main([r05, r06]) == 0
 
 
+def test_bench_compare_memory_row_regression_fails(bench_compare,
+                                                   tmp_path, capsys):
+    """ISSUE 13 acceptance: memory rows are direction-aware. Throughput
+    flat but the grads footprint doubled — the bytes sub-metric (lower
+    is better) fails the gate on its own."""
+    base_row = dict(_BASE_ROW,
+                    bytes_per_chip={"params": 4.0e8, "grads": 4.0e8},
+                    peak_hbm_bytes=1.2e9)
+    base = _artifact(tmp_path / "base.json", [base_row])
+    cand_row = dict(base_row,
+                    bytes_per_chip={"params": 4.0e8, "grads": 8.0e8})
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    assert bench_compare.main([base, cand]) == 1
+    out = capsys.readouterr().out
+    assert "grads bytes" in out
+    assert "lower is better" in out
+
+
+def test_bench_compare_memory_rows_clean_pass(bench_compare, tmp_path,
+                                              capsys):
+    # identical footprints (and a peak watermark) compare clean
+    row = dict(_BASE_ROW, bytes_per_chip={"params": 4.0e8},
+               peak_hbm_bytes=1.2e9)
+    base = _artifact(tmp_path / "base.json", [row])
+    cand = _artifact(tmp_path / "cand.json", [dict(row)])
+    assert bench_compare.main([base, cand]) == 0
+    out = capsys.readouterr().out
+    assert "params bytes" in out
+    assert "peak_hbm bytes" in out
+
+
 def test_bench_compare_usage_errors(bench_compare, tmp_path):
     assert bench_compare.main([]) == 2
     bad = tmp_path / "bad.json"
@@ -215,5 +246,26 @@ def test_serve_suite_tiny(bench, capsys):
     assert result["warmup_compiles"] > 0
     assert result["p99_latency_ms"] >= result["p50_latency_ms"] > 0
     assert result["p99_ttft_ms"] >= result["p50_ttft_ms"] > 0
+    # ISSUE 13 satellite: KV-cache footprint rides the serving headline
+    assert result["kv_cache_bytes_per_chip"] > 0
+    assert 0.0 <= result["kv_utilization"] <= 1.0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["value"] == result["value"]
+
+
+def test_memory_suite_tiny(bench, capsys):
+    """ISSUE 13 acceptance shape: ``bench.py --memory --tiny`` runs the
+    interleaved tracker-off/tracker-on A/B and reports the overhead
+    headline plus the per-subsystem footprint as one JSON line."""
+    result = bench.memory_main(tiny=True)
+    assert result["tiny"] is True
+    assert result["unit"] == "%"
+    assert result["goal"] == "< 1%"
+    assert result["p50_ms_memory_off"] > 0
+    assert result["p50_ms_memory_on"] > 0
+    assert result["samples_taken"] >= 1
+    per_chip = result["bytes_per_chip"]
+    assert per_chip and per_chip.get("grads", 0) > 0
+    assert result["peak_hbm_bytes"] > 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["value"] == result["value"]

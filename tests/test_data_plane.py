@@ -70,19 +70,29 @@ class TestFusionBufferManager:
         allocs0 = fb._BUF_ALLOCS.value
         lease = mgr.acquire(2, 300, np.float32)
         assert lease.array.shape == (2, 512)  # 1200B -> 2048B bucket
+        assert mgr.live_bytes() == lease.array.nbytes
+        assert mgr.leases_outstanding() == 1
         mgr.release(lease)
+        assert mgr.live_bytes() == 0
+        assert mgr.leases_outstanding() == 0
         again = mgr.acquire(2, 400, np.float32)  # same bucket, reused
         assert again.array is lease.array
         assert fb._BUF_ALLOCS.value - allocs0 == 1
+        assert mgr.live_bytes() == again.array.nbytes
         mgr.release(again)
+        assert mgr.live_bytes() == 0
 
     def test_outstanding_leases_get_distinct_slabs(self):
         mgr = FusionBufferManager(256)
         a = mgr.acquire(1, 100, np.float32)
         b = mgr.acquire(1, 100, np.float32)  # a still leased (pipelining)
         assert a.array is not b.array
+        assert mgr.leases_outstanding() == 2
+        assert mgr.live_bytes() == a.array.nbytes + b.array.nbytes
         mgr.release(a)
         mgr.release(b)
+        assert mgr.leases_outstanding() == 0
+        assert mgr.live_bytes() == 0
 
     def test_allocated_bytes_tracks_slabs(self):
         mgr = FusionBufferManager(0)  # identity buckets
@@ -92,6 +102,28 @@ class TestFusionBufferManager:
         reuse = mgr.acquire(4, 10, np.float32)
         assert mgr.allocated_bytes() == 4 * 10 * 4  # no second slab
         mgr.release(reuse)
+
+    def test_release_is_idempotent(self):
+        # the memory plane's live-bytes gauge must not go negative when a
+        # failure path and a finally block both release the same lease
+        mgr = FusionBufferManager(256)
+        lease = mgr.acquire(1, 100, np.float32)
+        mgr.release(lease)
+        mgr.release(lease)  # no-op, not a double decrement
+        assert mgr.live_bytes() == 0
+        assert mgr.leases_outstanding() == 0
+
+    def test_bytes_by_purpose_ledger(self):
+        mgr = FusionBufferManager(256, purpose="fusion")
+        stage = FusionBufferManager(256, purpose="ckpt_staging")
+        lease = mgr.acquire(1, 100, np.float32)
+        ledger = fb.bytes_by_purpose()
+        assert ledger["fusion"]["live_bytes"] >= lease.array.nbytes
+        assert ledger["fusion"]["leases_outstanding"] >= 1
+        assert "ckpt_staging" in ledger
+        assert ledger["ckpt_staging"]["live_bytes"] == 0
+        mgr.release(lease)
+        assert stage.live_bytes() == 0
 
 
 _AB_CASES = [(op, dt)
@@ -290,6 +322,9 @@ class TestLeaseLifecycle:
             ex._execute_allreduce_host(entries)
         assert self._slabs_free(ex.fusion_buffers) == 1, \
             "slab must return to the free list when the ring raises"
+        assert ex.fusion_buffers.live_bytes() == 0, \
+            "live-bytes gauge must drop back to baseline on failure"
+        assert ex.fusion_buffers.leases_outstanding() == 0
 
     def test_token_fail_releases_lease(self, hvd):
         from horovod_tpu.core import state
@@ -306,9 +341,12 @@ class TestLeaseLifecycle:
         assert tok.lease is None
         assert self._slabs_free(ex.fusion_buffers) == 1, \
             "failing a pending token must release its slab lease"
+        assert ex.fusion_buffers.live_bytes() == 0
         # idempotent: a second fail must not double-release
         tok.fail(types.Status.UnknownError("again"))
         assert self._slabs_free(ex.fusion_buffers) == 1
+        assert ex.fusion_buffers.live_bytes() == 0
+        assert ex.fusion_buffers.leases_outstanding() == 0
 
 
 class TestKnobParsing:

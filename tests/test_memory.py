@@ -1,0 +1,381 @@
+"""Memory telemetry plane (ISSUE 13): the per-subsystem ledger, ownership
+attribution, device reconciliation, OOM forensics through the executor
+boundary, and the cross-rank postmortem report.
+
+Tier-1 safe: the CPU backend reports no ``memory_stats()``, so device
+truth comes from the ``jax.live_arrays()`` fallback — exactly the path
+these tests exercise.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import flight_recorder, memory
+
+
+class FakeXlaRuntimeError(Exception):
+    pass
+
+
+# the tracker routes on the type NAME (jaxlib's class is not importable
+# on every backend), so a lookalike exercises the real branch
+FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+_OOM_MSG = ("RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "2147483648 bytes.")
+
+
+@pytest.fixture
+def tracker():
+    """The process-wide tracker, state-restored after the test."""
+    t = memory.tracker()
+    t.stop()
+    with t._lock:
+        saved = (dict(t._claimed), dict(t._peaks), dict(t._providers),
+                 list(t._samples), t._last_oom)
+        t._claimed.clear()
+        t._peaks.clear()
+        t._samples.clear()
+        t._last_oom = None
+    was_enabled = t.enabled
+    t.enabled = True
+    yield t
+    t.stop()
+    with t._lock:
+        t._claimed.clear()
+        t._claimed.update(saved[0])
+        t._peaks.clear()
+        t._peaks.update(saved[1])
+        t._providers.clear()
+        t._providers.update(saved[2])
+        t._samples.clear()
+        t._samples.extend(saved[3])
+        t._last_oom = saved[4]
+    t.enabled = was_enabled
+
+
+class TestLedger:
+    def test_set_bytes_rolls_peaks(self, tracker):
+        tracker.set_bytes("params", 1000)
+        tracker.set_bytes("params", 400)
+        led = tracker.ledger()
+        assert led["subsystems"]["params"]["bytes"] == 400
+        assert led["subsystems"]["params"]["peak_bytes"] == 1000
+
+    def test_note_tree_bytes_is_shape_math(self, tracker):
+        import jax.numpy as jnp
+
+        tree = {"w": jnp.ones((8, 16), jnp.float32),
+                "b": jnp.ones((16,), jnp.float32)}
+        tracker.note_tree_bytes("grads", tree)
+        led = tracker.ledger()
+        assert led["subsystems"]["grads"]["bytes"] == (8 * 16 + 16) * 4
+
+    def test_ledger_shape_and_builtin_pulls(self, tracker):
+        led = tracker.ledger()
+        for key in ("rank", "wall_time", "subsystems",
+                    "total_claimed_bytes", "claimed_device_bytes",
+                    "device", "reconcile_drift_ratio", "last_oom"):
+            assert key in led
+        # the built-in pulls always contribute host RSS (Linux CI)
+        assert led["subsystems"]["host_rss"]["bytes"] > 0
+        # host_rss is excluded from the device-claim total
+        assert led["claimed_device_bytes"] <= led["total_claimed_bytes"]
+
+    def test_registered_provider_is_polled_outside_lock(self, tracker):
+        tracker.register("custom_pool", lambda: 12345)
+        led = tracker.ledger()
+        assert led["subsystems"]["custom_pool"]["bytes"] == 12345
+        tracker.register("custom_pool", None)
+        assert "custom_pool" not in tracker._providers
+
+    def test_failing_provider_does_not_break_accounting(self, tracker):
+        def boom():
+            raise RuntimeError("subsystem mid-teardown")
+
+        tracker.register("dying", boom)
+        led = tracker.ledger()  # must not raise
+        assert "host_rss" in led["subsystems"]
+        tracker.register("dying", None)
+
+    def test_disabled_tracker_skips_pushes(self, tracker):
+        tracker.enabled = False
+        tracker.set_bytes("params", 999)
+        tracker.note_tree_bytes("grads", {"x": np.ones(4)})
+        with tracker._lock:
+            assert "params" not in tracker._claimed
+            assert "grads" not in tracker._claimed
+
+    def test_sampler_fills_the_ring(self, tracker):
+        tracker.start(interval=0.02)
+        deadline = time.monotonic() + 5.0
+        while not tracker.samples() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        tracker.stop()
+        rows = tracker.samples()
+        assert rows, "sampler produced no reconciliation samples"
+        wall, claimed, actual = rows[0]
+        assert wall > 0 and claimed >= 0 and actual >= 0
+
+
+class TestOwnership:
+    def test_adopt_and_owner_attribution(self, tracker):
+        import jax.numpy as jnp
+
+        arr = jnp.ones((64, 64), jnp.float32)
+        tracker.adopt("params", {"w": arr})
+        assert tracker.owner_of(arr) == "params"
+        top = tracker.top_live_arrays(k=10 ** 6)
+        mine = [r for r in top if r["shape"] == [64, 64]
+                and r["owner"] == "params"]
+        assert mine and mine[0]["bytes"] == 64 * 64 * 4
+        assert mine[0]["dtype"] == "float32"
+
+    def test_unadopted_arrays_are_unattributed(self, tracker):
+        import jax.numpy as jnp
+
+        arr = jnp.ones((3,), jnp.float32)
+        assert tracker.owner_of(arr) is None
+
+
+class TestOomDetection:
+    def test_is_oom_matrix(self):
+        assert memory.is_oom(FakeXlaRuntimeError(_OOM_MSG))
+        assert memory.is_oom(FakeXlaRuntimeError("OOM when allocating"))
+        assert not memory.is_oom(FakeXlaRuntimeError("INVALID_ARGUMENT"))
+        assert memory.is_oom(MemoryError())
+        assert memory.is_oom(ValueError("RESOURCE_EXHAUSTED: pool"))
+        assert not memory.is_oom(ValueError("shape mismatch"))
+        assert not memory.is_oom(None)
+
+    def test_maybe_record_oom_is_selective(self, tracker):
+        assert memory.maybe_record_oom(ValueError("benign"), "executor") \
+            is False
+        assert tracker.last_oom() is None
+
+    def test_record_oom_forensics(self, tracker, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER_DIR", str(tmp_path))
+        monkeypatch.setattr(flight_recorder._recorder,
+                            "_last_failure_dump", 0.0)
+        flight_recorder.configure(rank=0)
+        flight_recorder.set_state_provider("memory", tracker.ledger)
+        try:
+            tracker.set_bytes("grads", 5 * 10 ** 9)  # the dominant one
+            tracker.set_bytes("params", 10 ** 9)
+            assert memory.maybe_record_oom(
+                FakeXlaRuntimeError(_OOM_MSG), where="executor") is True
+            oom = tracker.last_oom()
+            assert oom["where"] == "executor"
+            assert oom["dominant_subsystem"] == "grads"
+            assert isinstance(oom["top_live_arrays"], list)
+            # the flight dump that followed embeds ledger + forensics
+            dump = json.loads(
+                (tmp_path / "flight-rank-0.json").read_text())
+            mem = dump["state"]["memory"]
+            assert mem["subsystems"]["grads"]["bytes"] == 5 * 10 ** 9
+            assert mem["last_oom"]["dominant_subsystem"] == "grads"
+            assert any(e["kind"] == "oom" for e in dump["events"])
+        finally:
+            flight_recorder.set_state_provider("memory", None)
+            flight_recorder.configure(rank=0)
+
+    def test_executor_boundary_records_oom(self, hvd, tracker, tmp_path,
+                                           monkeypatch):
+        """ISSUE 13 satellite: a RESOURCE_EXHAUSTED surfacing through
+        ``_PendingOp.fail_exc`` leaves a flight dump whose memory state
+        carries the ledger and the top-k live arrays."""
+        from horovod_tpu.core import state
+        from horovod_tpu.runtime import executor as ex_mod
+        from horovod_tpu.runtime import types
+
+        monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER_DIR", str(tmp_path))
+        monkeypatch.setattr(flight_recorder._recorder,
+                            "_last_failure_dump", 0.0)
+        flight_recorder.configure(rank=0)
+        flight_recorder.set_state_provider("memory", tracker.ledger)
+        try:
+            tracker.set_bytes("params", 7 * 10 ** 9)
+            ex = ex_mod.Executor(state.global_state().mesh)
+            entry = types.TensorTableEntry(
+                name="oom/x", tensor=np.ones((4,), "float32"))
+            tok = ex_mod._PendingOp(ex, types.ALLREDUCE, [entry], None)
+            tok.fail_exc(FakeXlaRuntimeError(_OOM_MSG))
+            oom = tracker.last_oom()
+            assert oom is not None and oom["where"] == "executor"
+            assert oom["dominant_subsystem"] == "params"
+            dump = json.loads(
+                (tmp_path / "flight-rank-0.json").read_text())
+            mem = dump["state"]["memory"]
+            assert "subsystems" in mem and "last_oom" in mem
+            assert isinstance(mem["last_oom"]["top_live_arrays"], list)
+        finally:
+            flight_recorder.set_state_provider("memory", None)
+            flight_recorder.configure(rank=0)
+
+
+def _mem_state(rank, subsystems, in_use, limit=0, oom=None):
+    return {
+        "rank": rank,
+        "subsystems": {name: {"bytes": b, "peak_bytes": b}
+                       for name, b in subsystems.items()},
+        "claimed_device_bytes": sum(
+            b for n, b in subsystems.items() if n != "host_rss"),
+        "device": {"bytes_in_use": in_use, "peak_bytes_in_use": in_use,
+                   "bytes_limit": limit, "live_array_bytes": in_use},
+        "reconcile_drift_ratio": 0.01,
+        "last_oom": oom,
+    }
+
+
+def _dump(rank, mem_state):
+    return {"schema": flight_recorder.SCHEMA, "rank": rank,
+            "launch_rank": rank, "pid": 1000 + rank,
+            "host": "host%d" % rank, "reason": "test", "wall_time": 0.0,
+            "clock_offset_seconds": 0.0, "dump_history": [], "events": [],
+            "state": {"memory": mem_state}, "metrics": {}}
+
+
+class TestPostmortemReport:
+    def test_cross_rank_report(self):
+        gib = 1024 ** 3
+        dumps = [
+            _dump(0, _mem_state(
+                0, {"params": 4 * gib, "grads": 2 * gib,
+                    "host_rss": gib}, in_use=7 * gib, limit=16 * gib)),
+            _dump(1, _mem_state(
+                1, {"params": 4 * gib, "grads": 9 * gib,
+                    "host_rss": gib}, in_use=15 * gib, limit=16 * gib,
+                oom={"where": "executor", "dominant_subsystem": "grads",
+                     "top_live_arrays": [
+                         {"bytes": 3 * gib, "shape": [1024, 786432],
+                          "dtype": "float32", "owner": "grads"}]})),
+        ]
+        text = memory.format_memory_report(dumps)
+        assert "=== memory report (2 ranks) ===" in text
+        assert "rank 1: OOM at executor — dominant subsystem grads" in text
+        assert "dominant subsystem: grads" in text
+        assert "nearest HBM ceiling: rank 1" in text
+        assert "93.8% full" in text
+        assert "(grads)" in text  # the owner tag on the top live array
+
+    def test_report_empty_without_memory_state(self):
+        dumps = [_dump(0, None)]
+        dumps[0]["state"] = {}
+        assert memory.format_memory_report(dumps) == ""
+
+    def test_format_postmortem_embeds_memory_section(self):
+        dumps = [_dump(0, _mem_state(0, {"serve_kv": 2 ** 30},
+                                     in_use=2 ** 30))]
+        text = flight_recorder.format_postmortem(dumps)
+        assert "=== memory report" in text
+        assert "serve_kv" in text
+
+    def test_postmortem_cli_names_dominant_subsystem(self, tmp_path,
+                                                     capsys):
+        """ISSUE 13 acceptance: ``tpurun --postmortem`` over dumps from
+        an OOM-ing fleet names the dominant subsystem."""
+        from horovod_tpu.run.run import run_commandline
+
+        gib = 1024 ** 3
+        for rank in range(2):
+            mem_state = _mem_state(
+                rank, {"optimizer_shards": (6 + rank) * gib},
+                in_use=(7 + rank) * gib, limit=16 * gib,
+                oom=({"where": "elastic",
+                      "dominant_subsystem": "optimizer_shards",
+                      "top_live_arrays": []} if rank == 1 else None))
+            (tmp_path / ("flight-rank-%d.json" % rank)).write_text(
+                json.dumps(_dump(rank, mem_state)))
+        assert run_commandline(["--postmortem", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "dominant subsystem: optimizer_shards" in out
+        assert "nearest HBM ceiling: rank 1" in out
+
+
+class TestConfigure:
+    def test_knobs_and_provider_registration(self, tracker, monkeypatch):
+        monkeypatch.setenv("HOROVOD_MEMORY", "1")
+        monkeypatch.setenv("HOROVOD_MEMORY_SAMPLE_SECONDS", "99")
+        monkeypatch.setenv("HOROVOD_MEMORY_TOPK", "3")
+        memory.configure(rank=5)
+        try:
+            assert tracker.enabled is True
+            assert tracker.rank == 5
+            assert tracker.sample_seconds == 99.0
+            assert tracker.topk == 3
+            assert "memory" in flight_recorder._recorder._providers
+        finally:
+            tracker.stop()
+        monkeypatch.setenv("HOROVOD_MEMORY", "0")
+        memory.configure(rank=5)
+        assert tracker.enabled is False
+        assert "memory" not in flight_recorder._recorder._providers
+
+    def test_memory_state_document(self, tracker):
+        tracker.set_bytes("params", 123)
+        state = memory.memory_state()
+        assert state["subsystems"]["params"]["bytes"] == 123
+        assert isinstance(state["top_live_arrays"], list)
+        assert isinstance(state["samples"], list)
+        assert state["sample_seconds"] == tracker.sample_seconds
+
+
+class TestMetricsRoute:
+    def test_get_memory_route(self, tracker):
+        """The metrics server serves the ledger at GET /memory."""
+        import urllib.request
+
+        from horovod_tpu.metrics import MetricsRegistry
+
+        tracker.set_bytes("params", 4321)
+        reg = MetricsRegistry()
+        port = reg.serve(0)
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/memory" % port, timeout=5) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["subsystems"]["params"]["bytes"] == 4321
+            assert "device" in doc and "samples" in doc
+        finally:
+            reg.stop_server()
+
+
+class TestHvdTop:
+    def test_render_against_live_endpoint(self, tracker):
+        import sys
+
+        from horovod_tpu.metrics import MetricsRegistry
+
+        repo_tools = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools")
+        if repo_tools not in sys.path:
+            sys.path.insert(0, repo_tools)
+        import hvd_top
+
+        tracker.set_bytes("params", 2 ** 20)
+        reg = MetricsRegistry()
+        port = reg.serve(0)
+        try:
+            table = hvd_top.render(["127.0.0.1:%d" % port])
+            assert "params" in table.splitlines()[0]
+            assert "1.0M" in table
+        finally:
+            reg.stop_server()
+
+    def test_render_unreachable_endpoint(self):
+        import sys
+
+        repo_tools = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools")
+        if repo_tools not in sys.path:
+            sys.path.insert(0, repo_tools)
+        import hvd_top
+
+        table = hvd_top.render(["127.0.0.1:1"])  # nothing listens there
+        assert "unreachable" in table

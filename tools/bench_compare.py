@@ -95,7 +95,10 @@ def higher_is_better(metric: str, unit: Optional[str]) -> bool:
 
 def derived_rows(rows: Dict[str, dict]) -> Dict[str, Tuple[float, str]]:
     """Flatten headlines to comparable (value, unit) rows, adding the
-    per-headline MFU and step-phase sub-metrics."""
+    per-headline MFU, step-phase, and memory sub-metrics. Memory rows
+    ("bytes" unit) are direction-aware via LOWER_IS_BETTER_UNITS: a
+    watermark or per-subsystem footprint growth gates like a perf
+    regression."""
     flat: Dict[str, Tuple[float, str]] = {}
     for metric, obj in rows.items():
         flat[metric] = (float(obj["value"]), obj.get("unit") or "")
@@ -107,6 +110,18 @@ def derived_rows(rows: Dict[str, dict]) -> Dict[str, Tuple[float, str]]:
                 if isinstance(seconds, (int, float)):
                     flat[f"{metric} [{phase} seconds]"] = (
                         float(seconds), "seconds")
+        per_chip = obj.get("bytes_per_chip")
+        if isinstance(per_chip, dict):
+            for subsystem, nbytes in per_chip.items():
+                if isinstance(nbytes, (int, float)):
+                    flat[f"{metric} [{subsystem} bytes]"] = (
+                        float(nbytes), "bytes")
+        if isinstance(obj.get("peak_hbm_bytes"), (int, float)):
+            flat[f"{metric} [peak_hbm bytes]"] = (
+                float(obj["peak_hbm_bytes"]), "bytes")
+        if isinstance(obj.get("kv_cache_bytes_per_chip"), (int, float)):
+            flat[f"{metric} [kv_cache bytes]"] = (
+                float(obj["kv_cache_bytes_per_chip"]), "bytes")
     return flat
 
 

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""hvd_top: curses-free live memory/throughput view across ranks.
+
+Polls each rank's metrics endpoint (``GET /memory`` for the per-subsystem
+ledger + device truth, ``GET /metrics`` for a couple of headline rates)
+and renders one table per refresh — plain ANSI-free text, so it works in
+a dumb terminal, under ``watch``, or piped to a log.
+
+    python tools/hvd_top.py host1:9100 host2:9100
+    python tools/hvd_top.py --interval 5 :9100          # localhost
+    python tools/hvd_top.py --once :9100                # single snapshot
+
+Endpoints come from ``HOROVOD_METRICS_PORT`` on each worker
+(docs/metrics.md); the memory plane behind ``/memory`` is described in
+docs/memory.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+POLL_TIMEOUT_SECONDS = 3.0
+
+# ledger columns, widest consumers first; anything else folds into "other"
+COLUMNS = ("params", "grads", "optimizer_shards", "serve_kv", "fusion",
+           "ckpt_staging", "program_cache")
+
+
+def fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "K", "M", "G", "T"):
+        if abs(n) < 1024.0 or unit == "T":
+            return "%d%s" % (n, unit) if unit == "B" else \
+                "%.1f%s" % (n, unit)
+        n /= 1024.0
+    return str(int(n))
+
+
+def fetch_json(endpoint: str, route: str) -> Optional[dict]:
+    url = "http://%s%s" % (endpoint, route)
+    try:
+        with urllib.request.urlopen(url, timeout=POLL_TIMEOUT_SECONDS) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def fetch_metric(endpoint: str, text: Optional[str], name: str) -> Optional[float]:
+    """One unlabeled sample from an already-fetched /metrics exposition."""
+    if text is None:
+        return None
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.split()[1])
+            except (ValueError, IndexError):
+                return None
+    return None
+
+
+def fetch_metrics_text(endpoint: str) -> Optional[str]:
+    url = "http://%s/metrics" % endpoint
+    try:
+        with urllib.request.urlopen(url, timeout=POLL_TIMEOUT_SECONDS) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
+def normalize(endpoint: str) -> str:
+    endpoint = endpoint.strip()
+    if endpoint.startswith(":"):
+        return "127.0.0.1" + endpoint
+    return endpoint
+
+
+def render(endpoints: List[str]) -> str:
+    header = ["rank", "endpoint", "device", "peak", "limit", "drift"]
+    header += list(COLUMNS) + ["other", "rss", "oom"]
+    rows: List[List[str]] = []
+    for ep in endpoints:
+        mem = fetch_json(ep, "/memory")
+        if mem is None:
+            rows.append(["?", ep, "unreachable"] + [""] * (len(header) - 3))
+            continue
+        subs: Dict[str, dict] = mem.get("subsystems", {})
+
+        def b(name: str) -> Optional[int]:
+            rec = subs.get(name)
+            return None if rec is None else rec.get("bytes")
+
+        other = sum(int(rec.get("bytes", 0)) for name, rec in subs.items()
+                    if name not in COLUMNS and name != "host_rss")
+        device = mem.get("device", {})
+        in_use = device.get("bytes_in_use") or device.get("live_array_bytes")
+        drift = mem.get("reconcile_drift_ratio")
+        oom = mem.get("last_oom")
+        rows.append(
+            [str(mem.get("rank", "?")), ep, fmt_bytes(in_use),
+             fmt_bytes(device.get("peak_bytes_in_use") or None),
+             fmt_bytes(device.get("bytes_limit") or None),
+             ("%+.1f%%" % (100.0 * drift))
+             if isinstance(drift, (int, float)) else "-"]
+            + [fmt_bytes(b(c)) for c in COLUMNS]
+            + [fmt_bytes(other),
+               fmt_bytes(b("host_rss")),
+               (oom.get("dominant_subsystem", "?") if isinstance(oom, dict)
+                else "-")])
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows), 1)
+              if rows else len(header[i]) for i in range(len(header))]
+    out = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        out.append("  ".join(
+            (r[i] if i < len(r) else "").ljust(widths[i])
+            for i in range(len(header))))
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live per-rank memory ledger (polls /memory)")
+    parser.add_argument("endpoints", nargs="+", metavar="HOST:PORT",
+                        help="metrics endpoints (':9100' = localhost)")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh seconds (default 2)")
+    parser.add_argument("--once", action="store_true",
+                        help="print one snapshot and exit")
+    args = parser.parse_args(argv)
+    endpoints = [normalize(e) for e in args.endpoints]
+    while True:
+        stamp = time.strftime("%H:%M:%S")
+        print("hvd_top  %s  (%d endpoint%s)" % (
+            stamp, len(endpoints), "" if len(endpoints) == 1 else "s"))
+        print(render(endpoints))
+        if args.once:
+            return 0
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        print()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
