@@ -1357,7 +1357,49 @@ def serve_main(tiny: bool = False):
         steps = (sum(r.engine.decode_steps for r in handle._replicas)
                  - warm_steps)
         occ = sum(r.occupancy_sum for r in handle._replicas)
+
+        # interleaved A/B overhead probe: decode-path cost with the
+        # tracing plane off vs on, doing exactly the per-step work the
+        # replica loop does — a block-step counter increment per step
+        # and ONE span record per decode block (the handle runs
+        # decode_block=4). Arms interleave so clock drift and cache
+        # effects cancel; runs on the hot decode program with the queue
+        # idle, so it must also compile nothing.
+        from horovod_tpu import tracing as tracing_mod
+
+        probe_engine = handle._replicas[0].engine
+        n_probe = 60 if tiny else 200
+        tracer = tracing_mod.tracer()
+        was_enabled = tracer.enabled
+        off_s, on_s = [], []
+        block_steps, block_t0 = 0, time.time()
+        for i in range(2 * n_probe):
+            trace_on = i % 2 == 1
+            tracer.enabled = trace_on
+            t_probe = time.perf_counter()
+            probe_engine.decode([0], [1], [0])
+            if trace_on:
+                block_steps += 1
+                if block_steps >= handle.policy.decode_block:
+                    t1 = time.time()
+                    tracing_mod.record(
+                        "request.decode_block", block_t0, t1 - block_t0,
+                        trace_id="bench-ab", tokens=block_steps)
+                    block_t0, block_steps = t1, 0
+            (on_s if trace_on else off_s).append(
+                time.perf_counter() - t_probe)
+        tracer.enabled = was_enabled
+        p50_off = float(np.percentile(off_s, 50))
+        p50_on = float(np.percentile(on_s, 50))
+        tracing_overhead_pct = (100.0 * (p50_on - p50_off) / p50_off
+                                if p50_off > 0 else 0.0)
+        log(f"serve: tracing A/B decode p50 off={p50_off * 1e3:.3f} ms "
+            f"on={p50_on * 1e3:.3f} ms ({tracing_overhead_pct:+.2f}%)")
+
+        # measured AFTER the probe: the tracing arm must not have
+        # compiled anything either
         steady_compiles = handle.compiles_total() - warm_compiles
+        slo = tracing_mod.slo_state()
         result = {
             "bench": "serve",
             "metric": "serving decode throughput (Poisson load, "
@@ -1386,6 +1428,18 @@ def serve_main(tiny: bool = False):
             "kv_utilization": round(
                 sum(r.stats()["kv_utilization"]
                     for r in handle._replicas) / max(replicas, 1), 3),
+            # SLO plane (tracing.py; docs/tracing.md): per-objective
+            # burn rate + remaining error budget over the run, and the
+            # decode-path cost of having the plane on at all
+            "tracing_overhead_pct": round(tracing_overhead_pct, 2),
+            "spans_recorded": tracing_mod.tracer().spans_recorded(),
+            "slo_requests_scored": slo["requests_scored"],
+            "slo_burn_rate": {
+                obj: slo["slo"][obj]["burn_rate"]
+                for obj in ("ttft", "latency", "availability")},
+            "slo_error_budget_remaining": {
+                obj: slo["slo"][obj]["error_budget_remaining"]
+                for obj in ("ttft", "latency", "availability")},
             "tiny": tiny,
             **memory_rows(params),
         }

@@ -124,6 +124,7 @@ from horovod_tpu.parallel.ep import (
 from horovod_tpu.ops.pallas import flash_attention
 from horovod_tpu.flight_recorder import dump_debug_state
 from horovod_tpu import profiler
+from horovod_tpu import tracing
 from horovod_tpu import checkpoint
 from horovod_tpu import ckpt
 from horovod_tpu import data
@@ -149,6 +150,7 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "mesh", "metrics", "is_homogeneous", "dump_debug_state", "profiler",
+    "tracing",
     "CROSS_AXIS", "LOCAL_AXIS", "GLOBAL_AXES",
     # capability probes
     "mpi_built", "gloo_built", "nccl_built", "ddl_built", "mlsl_built",

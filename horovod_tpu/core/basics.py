@@ -151,6 +151,13 @@ def init(
 
         memory.configure(rank=st.rank)
 
+        # tracing + SLO plane: adopt the rank, register the "slo" state
+        # provider, flip the /healthz readiness gate (HOROVOD_TRACE /
+        # HOROVOD_SLO_*)
+        from horovod_tpu import tracing
+
+        tracing.configure(rank=st.rank)
+
         if st.config.timeline_file:
             from horovod_tpu.timeline import Timeline
 
@@ -214,6 +221,11 @@ def shutdown() -> None:
         from horovod_tpu import memory
 
         memory.tracker().stop()
+        # /healthz must stop reporting ready the moment the runtime is
+        # gone — a load balancer probing a shut-down worker gets 503
+        from horovod_tpu import tracing
+
+        tracing.mark_initialized(False)
         flight_recorder.emit("shutdown", rank=st.rank)
         # leave a final dump behind (and ship it to the launcher) so the
         # postmortem covers clean exits too — only when a destination is

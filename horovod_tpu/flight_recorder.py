@@ -530,4 +530,17 @@ def format_postmortem(dumps: List[dict], last_n: int = 40,
             lines.append(report)
     except Exception:
         pass  # the postmortem renders even if the memory plane is broken
+    try:
+        # cross-rank SLO report from the dumps' "slo" state (tracing.py;
+        # empty for pre-tracing dumps): burn rates, budgets, and the
+        # slowest-request exemplars with their victim trace ids. Lazy:
+        # tracing.py imports this module.
+        from horovod_tpu import tracing
+
+        report = tracing.format_slo_report(dumps)
+        if report:
+            lines.append("")
+            lines.append(report)
+    except Exception:
+        pass  # likewise if the tracing plane is broken
     return "\n".join(lines)

@@ -338,6 +338,33 @@ class MetricsRegistry:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif route == "/slo":
+                    # SLO plane: per-objective burn rate / error budget,
+                    # latency percentiles, slow-request exemplars
+                    # (tracing.slo_state; docs/tracing.md)
+                    from horovod_tpu import tracing
+
+                    body = json.dumps(
+                        tracing.slo_state(),
+                        default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif route == "/healthz":
+                    # readiness gate for external load balancers: 200
+                    # only once hvd.init() ran and — when serving — a
+                    # replica proved alive (tracing.healthz_state)
+                    from horovod_tpu import tracing
+
+                    state = tracing.healthz_state()
+                    body = json.dumps(state).encode()
+                    self.send_response(200 if state["ready"] else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_error(404)
 

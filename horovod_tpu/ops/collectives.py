@@ -42,7 +42,7 @@ from jax import lax
 from horovod_tpu.utils import compat
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from horovod_tpu import flight_recorder
+from horovod_tpu import flight_recorder, tracing
 from horovod_tpu.compression import Compression
 from horovod_tpu.core import basics, mesh as mesh_mod, state as state_mod
 
@@ -592,9 +592,15 @@ def allreduce(
         _integrity_check_stacked(x, name or "allreduce")
         if (st.config.hierarchical_allreduce
                 and _hierarchical_enabled(st, red_op)):
-            out = _hierarchical_reduce_stacked_fn(st.mesh, red_op)(x)
+            out = _op_event(
+                "allreduce", st, x,
+                lambda: _hierarchical_reduce_stacked_fn(st.mesh, red_op)(x),
+                name=name)
         else:
-            out = _reduce_stacked_fn(st.mesh, red_op)(x)
+            out = _op_event(
+                "allreduce", st, x,
+                lambda: _reduce_stacked_fn(st.mesh, red_op)(x),
+                name=name)
     elif _multiprocess_world(st) and not _is_globally_replicated(x, st):
         # Multi-process world with a plain local array: the data lives
         # per-rank, so "replicated" math would silently return a
@@ -707,20 +713,26 @@ def grouped_allreduce(
     return out
 
 
-def _op_event(op: str, st, x, fn):
+def _op_event(op: str, st, x, fn, name: Optional[str] = None):
     """Bracket an eager single-controller collective dispatch with
     flight-recorder ``op_dispatch``/``op_complete`` events (shard index +
-    bytes), mirroring the executor's events on the multi-process path —
-    postmortems attribute a stalled sharded step to the right phase."""
+    bytes) and a ``collective:<name>`` tracing span, mirroring the
+    executor's events on the multi-process path — postmortems attribute a
+    stalled sharded step to the right phase, and eager collectives land on
+    the same Perfetto lane as the enqueue runtime's (tracing.py)."""
     nbytes = int(np.prod(np.shape(x), dtype=np.int64)
                  * np.dtype(x.dtype).itemsize)
     flight_recorder.emit("op_dispatch", op=op, shard=int(st.rank),
                          bytes=nbytes)
     t0 = time.monotonic()
+    t0_epoch = time.time()
     out = fn()
+    total = time.monotonic() - t0
     flight_recorder.emit("op_complete", op=op, shard=int(st.rank),
-                         bytes=nbytes,
-                         seconds=round(time.monotonic() - t0, 6))
+                         bytes=nbytes, seconds=round(total, 6))
+    if tracing.enabled():
+        tracing.record("collective:" + str(name or op), t0_epoch, total,
+                       op=op, bytes=nbytes)
     return out
 
 

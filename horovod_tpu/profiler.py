@@ -444,6 +444,16 @@ class StepProfiler:
             memory_samples = memory.tracker().samples()
         except Exception:
             pass
+        # tracing plane: the request/collective span ring rides the dump
+        # so merge_profile_dir can lay out per-rank request lanes and
+        # join one trace_id across ranks with flow arrows
+        request_spans = []
+        try:
+            from horovod_tpu import tracing
+
+            request_spans = tracing.spans()
+        except Exception:
+            pass
         return {
             "schema": SCHEMA,
             "rank": self.rank,
@@ -457,6 +467,7 @@ class StepProfiler:
             "steps": list(self._steps),
             "trace_events": list(self._trace_events),
             "memory_samples": memory_samples,
+            "request_spans": request_spans,
             "flight_events": flight_recorder.recorder().events()
             [-_FLIGHT_TRACE_EVENTS:],
         }
@@ -661,8 +672,13 @@ def merge_profile_dir(directory: str,
     ``jax.profiler`` device traces below it. Every rank's events are
     shifted by that rank's ``/_time`` clock-offset estimate so two hosts'
     spans line up on the launcher's clock; each source file gets a private
-    pid range labeled ``rank N <kind>``. Returns (path, event count)."""
+    pid range labeled ``rank N <kind>``. Request spans (tracing.py) get
+    their own ``rank N requests`` lane, and one trace_id's spans across
+    ALL lanes are joined by Perfetto flow arrows — a request's life is
+    one connected line from the frontend's submit through the serving
+    replica's prefill/decode to the response. Returns (path, count)."""
     from horovod_tpu import timeline as timeline_mod
+    from horovod_tpu import tracing
 
     dumps = load_dumps(directory)
     offsets: Dict[int, float] = {}
@@ -677,6 +693,11 @@ def merge_profile_dir(directory: str,
         events += _memory_trace_events(d)
         if events:
             lanes.append((f"rank {rank} steps", events, offset))
+        spans = [s for s in d.get("request_spans", ())
+                 if isinstance(s, dict)]
+        if spans:
+            lanes.append((f"rank {rank} requests",
+                          tracing.spans_to_chrome(spans), offset))
     for path in sorted(glob.glob(os.path.join(directory,
                                               "timeline-rank-*.json"))):
         rank = _rank_of_path(path)
@@ -701,6 +722,7 @@ def merge_profile_dir(directory: str,
                       offsets.get(rank, 0.0)))
 
     merged: List[dict] = []
+    anchors: List[dict] = []   # corrected-clock request-span coordinates
     pid_base = 0
     for label, events, offset_s in lanes:
         pids = [e.get("pid", 0) for e in events]
@@ -715,7 +737,17 @@ def merge_profile_dir(directory: str,
             if isinstance(e.get("ts"), (int, float)) and e.get("ph") != "M":
                 e["ts"] = e["ts"] + off_us
             merged.append(e)
+            if e.get("ph") == "X" and e.get("cat") == "request":
+                trace_id = (e.get("args") or {}).get("trace_id")
+                if trace_id:
+                    anchors.append({"trace_id": trace_id, "pid": e["pid"],
+                                    "tid": e.get("tid", 0), "ts": e["ts"],
+                                    "dur": e.get("dur", 0.0)})
         pid_base += max(pids, default=0) + 2
+    # flow arrows must be generated AFTER the layout: they bind to their
+    # enclosing slices by exact (pid, tid, ts), which only exist once
+    # every lane has its final pid range and corrected clock
+    merged.extend(tracing.flow_events(anchors))
     merged.sort(key=lambda e: (e.get("ts") or 0))
     out = out_path or os.path.join(directory, MERGED_TRACE)
     with open(out, "w") as f:

@@ -422,6 +422,14 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                              f"{exc}\n")
             merged_path, n_events = None, 0
         print(profiler.format_step_report(dumps))
+        spans = sum(len(d.get("request_spans", ())) for d in dumps)
+        if spans:
+            traces = {s.get("trace_id") for d in dumps
+                      for s in d.get("request_spans", ())
+                      if isinstance(s, dict) and s.get("trace_id")}
+            print(f"tpurun: {spans} request/collective spans across "
+                  f"{len(traces)} trace(s) merged into per-rank request "
+                  f"lanes (docs/tracing.md)")
         if merged_path and n_events:
             print(f"tpurun: merged trace ({n_events} events) written to "
                   f"{merged_path}")

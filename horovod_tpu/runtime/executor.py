@@ -42,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import flight_recorder
 from horovod_tpu import timeline as timeline_mod
+from horovod_tpu import tracing
 from horovod_tpu.analysis import witness
 from horovod_tpu.exceptions import WorkerLostError, WorkerStallError
 from horovod_tpu.utils import resilience
@@ -195,7 +196,7 @@ class _PendingOp:
 
     __slots__ = ("executor", "op", "entries", "timeline", "name0", "t0",
                  "finish", "done", "lease", "nbytes", "bucket",
-                 "t_disp_end", "t_drain_start")
+                 "t_disp_end", "t_drain_start", "t0_epoch")
 
     def __init__(self, executor: "Executor", op: str, entries, timeline):
         self.executor = executor
@@ -204,6 +205,10 @@ class _PendingOp:
         self.timeline = timeline
         self.name0 = entries[0].name if entries else "?"
         self.t0 = time.perf_counter()
+        # epoch twin of t0 (the tracing clock domain): the collective
+        # span emitted at close must land on the same merged-trace
+        # timeline as the request spans (tracing.py)
+        self.t0_epoch = time.time()
         self.finish: Optional[Callable[[], None]] = None
         self.done = False
         self.lease = None
@@ -231,6 +236,16 @@ class _PendingOp:
                        else t_end)
         hidden = max(0.0, min(drain_start, t_end) - min(disp_end, t_end))
         _comm_clock.record(total, max(0.0, total - hidden), self.nbytes)
+        if tracing.enabled():
+            # per-tensor submit→dispatch→overlap→drain lineage: the
+            # training-plane analogue of the request spans, so an
+            # exposed-comm spike attributes to a named tensor
+            tracing.record(
+                "collective:" + str(self.name0), self.t0_epoch, total,
+                op=self.op, bytes=self.nbytes, bucket=self.bucket,
+                dispatch_ms=round((disp_end - self.t0) * 1000.0, 3),
+                overlap_ms=round(hidden * 1000.0, 3),
+                drain_ms=round(max(t_end - drain_start, 0.0) * 1000.0, 3))
         if self.timeline is not None:
             self.timeline.end(self.name0)
 

@@ -36,6 +36,7 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
+    from horovod_tpu import profiler, tracing
     from horovod_tpu.models.transformer import Transformer
     from horovod_tpu.serve import ServePolicy, run_kv_replica
     from horovod_tpu.serve.api import _serve_guard
@@ -56,10 +57,21 @@ def main(argv=None) -> int:
     params = model.init(jax.random.PRNGKey(args.seed), tokens,
                         train=False)["params"]
 
+    # no hvd.init() here (the serving plane rides the KV store alone),
+    # so the tracing/profiling planes adopt the rank explicitly — a
+    # replica launched under --profile-dir must dump its request spans
+    # for the launcher's merged Perfetto trace
+    tracing.configure(rank=rank)
+    tracing.note_serve_started()
+    profiler.configure(rank=rank)
+
     policy = ServePolicy.from_env()
     guard = _serve_guard(rank) if policy.quarantine else None
-    replica = run_kv_replica(model, params, policy, rank=rank,
-                             addr=addr, port=port, guard=guard)
+    try:
+        replica = run_kv_replica(model, params, policy, rank=rank,
+                                 addr=addr, port=port, guard=guard)
+    finally:
+        profiler.finalize()
     print(f"horovod_tpu.serve: rank {rank} drained "
           f"({replica.completed} completed)", flush=True)
     return 0

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import List, Optional
 
+from horovod_tpu import flight_recorder, tracing
 from horovod_tpu.analysis import witness
 from horovod_tpu.integrity.guards import StepGuard
 from horovod_tpu.serve.kv_cache import DecodeEngine
@@ -119,6 +120,9 @@ class ServeHandle:
         self._threads: List[threading.Thread] = []
         self._closed = False
         self.started_s = time.monotonic()
+        # /healthz flips to "serving": not ready again until a replica
+        # loop (or KV heartbeat) proves the fleet actually came up
+        tracing.note_serve_started()
         for replica in replicas:
             t = threading.Thread(target=replica.run, daemon=True,
                                  name=replica.name)
@@ -152,10 +156,17 @@ class ServeHandle:
             raise ValueError(
                 f"serve: prompt length {len(prompt)} exceeds the "
                 f"model's max_seq ({self._max_seq})")
-        return self._queue.submit(
+        # the trace context is minted HERE, at the public API edge —
+        # every span and serve-path flight event downstream carries it
+        trace_id = tracing.new_trace_id()
+        uid = self._queue.submit(
             prompt,
             max_new_tokens=(self._policy.max_new_tokens
-                            if max_new_tokens is None else max_new_tokens))
+                            if max_new_tokens is None else max_new_tokens),
+            trace_id=trace_id)
+        flight_recorder.emit("serve_submit", uid=uid, trace_id=trace_id,
+                             prompt_len=len(prompt))
+        return uid
 
     def result(self, uid: str, timeout: Optional[float] = None
                ) -> Completion:

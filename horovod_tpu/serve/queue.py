@@ -33,6 +33,7 @@ import uuid
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu import flight_recorder, tracing
 from horovod_tpu.analysis import witness
 from horovod_tpu.utils.env import _get_float
 
@@ -62,22 +63,33 @@ class QueueFull(RuntimeError):
 @dataclasses.dataclass
 class Request:
     """One generation request. ``submitted_s`` is the submitter's local
-    monotonic clock (latency accounting happens where the clock lives)."""
+    monotonic clock (latency accounting happens where the clock lives).
+    ``trace_id`` is the distributed trace context (tracing.py): minted
+    once at submit, it rides the wire format through every transport hop
+    so spans on the frontend and on whichever replica(s) serve the
+    request join into one Perfetto flow. ``requeues`` counts how many
+    times worker loss bounced the request back into the waiting line."""
 
     uid: str
     prompt: List[int]
     max_new_tokens: int
     submitted_s: float = 0.0
+    trace_id: str = ""
+    requeues: int = 0
 
     def to_json(self) -> bytes:
         return json.dumps({"uid": self.uid, "prompt": list(self.prompt),
-                           "max_new_tokens": self.max_new_tokens}).encode()
+                           "max_new_tokens": self.max_new_tokens,
+                           "trace_id": self.trace_id,
+                           "requeues": self.requeues}).encode()
 
     @classmethod
     def from_json(cls, raw: bytes) -> "Request":
         d = json.loads(raw)
         return cls(uid=d["uid"], prompt=[int(t) for t in d["prompt"]],
-                   max_new_tokens=int(d["max_new_tokens"]))
+                   max_new_tokens=int(d["max_new_tokens"]),
+                   trace_id=d.get("trace_id", ""),
+                   requeues=int(d.get("requeues", 0)))
 
 
 @dataclasses.dataclass
@@ -91,6 +103,8 @@ class Completion:
     ttft_s: float = 0.0      # submit -> first generated token
     latency_s: float = 0.0   # submit -> completion
     finish: str = "length"
+    trace_id: str = ""       # trace context echoed back to the submitter
+    requeues: int = 0
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()
@@ -102,7 +116,9 @@ class Completion:
                    prompt_len=int(d["prompt_len"]), rank=int(d["rank"]),
                    ttft_s=float(d.get("ttft_s", 0.0)),
                    latency_s=float(d.get("latency_s", 0.0)),
-                   finish=d.get("finish", "length"))
+                   finish=d.get("finish", "length"),
+                   trace_id=d.get("trace_id", ""),
+                   requeues=int(d.get("requeues", 0)))
 
 
 class RequestQueue:
@@ -126,16 +142,21 @@ class RequestQueue:
         self._requeued = 0                       # guarded-by: _lock
 
     def submit(self, prompt: List[int], max_new_tokens: int,
-               uid: Optional[str] = None) -> str:
+               uid: Optional[str] = None, trace_id: str = "") -> str:
+        t0 = time.time()
         req = Request(uid=uid or uuid.uuid4().hex, prompt=list(prompt),
                       max_new_tokens=int(max_new_tokens),
-                      submitted_s=time.monotonic())
+                      submitted_s=time.monotonic(),
+                      trace_id=trace_id or tracing.new_trace_id())
         with self._lock:
             if len(self._waiting) >= self._capacity:
                 raise QueueFull(
                     f"serve queue at capacity ({self._capacity})")
             self._waiting.append(req)
             self._submitted += 1
+        tracing.record("request.submit", t0, time.time() - t0,
+                       trace_id=req.trace_id, uid=req.uid,
+                       prompt_len=len(req.prompt))
         return req.uid
 
     def pull(self, rank: int, max_n: int) -> List[Request]:
@@ -151,6 +172,7 @@ class RequestQueue:
         return out
 
     def complete(self, completion: Completion) -> None:
+        t0 = time.time()
         now = time.monotonic()
         with self._lock:
             self._inflight.pop(completion.uid, None)
@@ -167,6 +189,9 @@ class RequestQueue:
             while self._expiry and self._expiry[0][0] <= now:
                 _, uid = self._expiry.popleft()
                 self._results.pop(uid, None)
+        tracing.record("request.response", t0, time.time() - t0,
+                       trace_id=completion.trace_id, uid=completion.uid,
+                       finish=completion.finish)
 
     def requeue_worker(self, rank: int) -> int:
         """Return every request in-flight on ``rank`` to the FRONT of
@@ -180,6 +205,7 @@ class RequestQueue:
                                    key=lambda kv: kv[1].submitted_s,
                                    reverse=True):
                 del self._inflight[uid]
+                req.requeues += 1
                 self._waiting.appendleft(req)
             self._requeued += len(stranded)
             return len(stranded)
@@ -305,7 +331,12 @@ class KVQueueFrontend:
 
     def submit(self, request: Request,
                rank: Optional[int] = None) -> int:
-        """Dispatch to ``rank`` (or round-robin over live replicas)."""
+        """Dispatch to ``rank`` (or round-robin over live replicas).
+        Mints the trace context if the caller didn't — the span covers
+        the KV put, i.e. the frontend→replica wire hop."""
+        if not request.trace_id:
+            request.trace_id = tracing.new_trace_id()
+        t0 = time.time()
         if rank is None:
             live = self.live_replicas()
             if not live:
@@ -314,6 +345,9 @@ class KVQueueFrontend:
         self._client.set(request.uid, request.to_json(),
                          scope=REQ_SCOPE.format(rank=rank))
         self._assigned[request.uid] = (rank, request)
+        tracing.record("request.submit", t0, time.time() - t0,
+                       trace_id=request.trace_id, uid=request.uid,
+                       prompt_len=len(request.prompt), to_rank=rank)
         return rank
 
     def _redispatch_dead(self) -> None:
@@ -327,7 +361,12 @@ class KVQueueFrontend:
                 continue
             self.dead_ranks.add(rank)
             self.requeued += 1
-            self.submit(req)
+            req.requeues += 1
+            new_rank = self.submit(req)
+            flight_recorder.emit(
+                "serve_redispatch", uid=uid, trace_id=req.trace_id,
+                dead_rank=rank, new_rank=new_rank,
+                requeues=req.requeues)
 
     def poll_responses(self) -> List[Completion]:
         """Drain newly-published completions; re-dispatches the pending
@@ -340,6 +379,7 @@ class KVQueueFrontend:
         for key in keys:
             if key in self._done:
                 continue
+            t0 = time.time()
             try:
                 raw = self._client.get(key, scope=RESP_SCOPE, wait=False)
             except KeyError:
@@ -349,6 +389,9 @@ class KVQueueFrontend:
             self._done_order.append(key)
             self._assigned.pop(key, None)
             fresh.append(done)
+            tracing.record("request.response", t0, time.time() - t0,
+                           trace_id=done.trace_id, uid=done.uid,
+                           from_rank=done.rank, finish=done.finish)
             try:  # shrink the response listing; liveness only
                 self._client.finish(key, scope=RESP_SCOPE)
             except Exception:

@@ -36,7 +36,7 @@ import threading
 import time
 from typing import List, Optional
 
-from horovod_tpu import flight_recorder
+from horovod_tpu import flight_recorder, tracing
 from horovod_tpu.elastic import fault_inject
 from horovod_tpu.exceptions import NumericalError, WorkersDownError
 from horovod_tpu.metrics import COUNT_BUCKETS, registry as _metrics
@@ -145,6 +145,7 @@ class _KVTransport:
             if not self.silent:
                 try:
                     self._kv.heartbeat()
+                    tracing.note_replica_heartbeat()
                 except Exception as exc:
                     log.warning("serve: heartbeat failed: %s", exc)
             if self._hb_stop.wait(HEARTBEAT_SECONDS):
@@ -216,6 +217,13 @@ class Replica:
 
     def _finish(self, active, now: float) -> None:
         req = active.request
+        epoch_now = time.time()
+        if active.block_steps > 0:   # close the trailing decode block
+            tracing.record(
+                "request.decode_block", active.block_t0,
+                max(epoch_now - active.block_t0, 0.0),
+                trace_id=req.trace_id, uid=req.uid, slot=active.slot,
+                block=active.blocks, tokens=active.block_steps)
         # "cache_limit" (not "length") when the KV cache, not the
         # request, bounded the generation — callers must be able to
         # tell a fulfilled budget from a truncated one
@@ -224,11 +232,26 @@ class Replica:
             prompt_len=active.prompt_len, rank=self.rank,
             ttft_s=active.first_token_s - req.submitted_s,
             latency_s=now - req.submitted_s,
-            finish="cache_limit" if active.capped else "length")
+            finish="cache_limit" if active.capped else "length",
+            trace_id=req.trace_id, requeues=req.requeues)
         self.transport.complete(completion)
         self.completed += 1
         _REQUESTS.labels(outcome="completed").inc()
         _LATENCY.labels(phase="total").observe(completion.latency_s)
+        serve_dur = max(now - active.admitted_s, 0.0)
+        tracing.record(
+            "request.serve", epoch_now - serve_dur, serve_dur,
+            trace_id=req.trace_id, uid=req.uid, slot=active.slot,
+            finish=completion.finish, requeues=req.requeues,
+            tokens=len(active.generated),
+            ttft_ms=round(completion.ttft_s * 1000.0, 3),
+            latency_ms=round(completion.latency_s * 1000.0, 3))
+        tracing.slo().record_request(
+            completion.ttft_s, completion.latency_s, ok=True,
+            trace_id=req.trace_id, rank=self.rank, requeues=req.requeues,
+            phases={"queue_wait": active.queue_wait_s,
+                    "prefill": active.prefill_s,
+                    "decode": max(now - active.first_token_s, 0.0)})
 
     def _reject(self, req, reason: str) -> None:
         """Complete an unservable request (empty, or prompt longer than
@@ -236,8 +259,14 @@ class Replica:
         loop on it or stranding its caller in ``result()``."""
         self.transport.complete(Completion(
             uid=req.uid, tokens=[], prompt_len=len(req.prompt),
-            rank=self.rank, finish="rejected"))
+            rank=self.rank, finish="rejected",
+            trace_id=req.trace_id, requeues=req.requeues))
         _REQUESTS.labels(outcome="rejected").inc()
+        # an unserved request is an availability bad event — it has no
+        # meaningful TTFT, so the latency objectives are not scored
+        tracing.slo().record_request(
+            0.0, 0.0, ok=False, trace_id=req.trace_id, rank=self.rank,
+            requeues=req.requeues)
         log.warning("serve: replica %s rejected request %s (%s)",
                     self.name, req.uid, reason)
 
@@ -248,13 +277,15 @@ class Replica:
         parks until the fleet is stopped."""
         self.quarantined = True
         _QUARANTINED.inc()
-        evicted = len(self.batcher.evict_all())
-        evicted += len(self.batcher.drain_waiting())
+        victims = self.batcher.evict_all()
+        victims += self.batcher.drain_waiting()
+        evicted = len(victims)
         requeued = self.transport.requeue_all()
         _REQUESTS.labels(outcome="requeued").inc(max(evicted, requeued))
         flight_recorder.emit("serve_quarantine", replica=self.name,
                              rank=self.rank, reason=reason,
-                             evicted=evicted)
+                             evicted=evicted,
+                             trace_ids=[r.trace_id for r in victims])
         log.error("serve: replica %s QUARANTINED (%s); %d request(s) "
                   "returned for redistribution", self.name, reason,
                   max(evicted, requeued))
@@ -275,6 +306,10 @@ class Replica:
     def run(self) -> None:
         flight_recorder.emit("serve_replica_start", replica=self.name,
                              rank=self.rank, slots=self.engine.num_slots)
+        # a running loop IS the liveness signal for in-process serving
+        # (the KV transport's heartbeat thread also notes it) — flips
+        # the /healthz readiness gate
+        tracing.note_replica_heartbeat()
         while not self._stop.is_set():
             self.transport.heartbeat()
             if self.transport.stopped():
@@ -288,11 +323,14 @@ class Replica:
                 # elastic membership change mid-step: nothing is lost —
                 # the pulled work returns to the queue before the
                 # elastic driver re-forms us
+                victims = self.batcher.evict_all()
+                victims += self.batcher.drain_waiting()
                 requeued = self.transport.requeue_all()
-                requeued += len(self.batcher.evict_all())
-                requeued += len(self.batcher.drain_waiting())
-                flight_recorder.emit("serve_requeue", replica=self.name,
-                                     rank=self.rank, requeued=requeued)
+                requeued += len(victims)
+                flight_recorder.emit(
+                    "serve_requeue", replica=self.name, rank=self.rank,
+                    requeued=requeued,
+                    trace_ids=[r.trace_id for r in victims])
                 raise
             except Exception as exc:
                 # anything else must not silently kill the loop thread
@@ -326,13 +364,31 @@ class Replica:
 
         if self.batcher.admission_due(now):
             for active in self.batcher.admit(now):
+                req = active.request
+                # queue-wait span: submitted -> admitted. submitted_s is
+                # a LOCAL monotonic stamp; map it onto the epoch trace
+                # clock by anchoring "now" and subtracting the wait.
+                p0 = time.time()
+                active.queue_wait_s = max(
+                    active.admitted_s - req.submitted_s, 0.0)
+                tracing.record(
+                    "request.queue_wait", p0 - active.queue_wait_s,
+                    active.queue_wait_s, trace_id=req.trace_id,
+                    uid=req.uid, requeues=req.requeues)
                 token, max_abs = self.engine.prefill(
-                    active.slot, active.request.prompt)
+                    active.slot, req.prompt)
                 if not self._guard_ok(max_abs):
                     self._quarantine("non-finite prefill logits")
                     return
                 active.generated.append(token)
                 active.first_token_s = time.monotonic()
+                active.prefill_s = time.time() - p0
+                tracing.record(
+                    "request.prefill", p0, active.prefill_s,
+                    trace_id=req.trace_id, uid=req.uid,
+                    slot=active.slot, prompt_len=active.prompt_len)
+                # open the first decode-block span
+                active.block_t0 = p0 + active.prefill_s
                 _TOKENS.labels(kind="prefill").inc(active.prompt_len)
                 _LATENCY.labels(phase="ttft").observe(
                     active.first_token_s - active.request.submitted_s)
@@ -361,6 +417,20 @@ class Replica:
             active = by_slot[slot]
             active.generated.append(token)
             active.position += 1
+            active.block_steps += 1
+            if active.block_steps >= self.policy.decode_block:
+                # decode-block boundary: close this request's span and
+                # open the next (one time.time() per block, not per step)
+                t1 = time.time()
+                tracing.record(
+                    "request.decode_block", active.block_t0,
+                    max(t1 - active.block_t0, 0.0),
+                    trace_id=active.request.trace_id,
+                    uid=active.request.uid, slot=slot,
+                    block=active.blocks, tokens=active.block_steps)
+                active.blocks += 1
+                active.block_t0 = t1
+                active.block_steps = 0
         occupancy = len(slots)
         self.occupancy_sum += occupancy
         _TOKENS.labels(kind="decode").inc(occupancy)

@@ -59,6 +59,13 @@ HOROVOD_PROFILE_JAX = "HOROVOD_PROFILE_JAX"
 # record acquisition order, flag inversions / live deadlocks / long holds
 HOROVOD_DEBUG_LOCKS = "HOROVOD_DEBUG_LOCKS"
 HOROVOD_LOCK_HOLD_WARN_SECONDS = "HOROVOD_LOCK_HOLD_WARN_SECONDS"
+# request-level tracing + SLO plane (tracing.py; docs/tracing.md)
+HOROVOD_TRACE = "HOROVOD_TRACE"
+HOROVOD_SLO_TTFT_MS = "HOROVOD_SLO_TTFT_MS"
+HOROVOD_SLO_LATENCY_MS = "HOROVOD_SLO_LATENCY_MS"
+HOROVOD_SLO_AVAILABILITY = "HOROVOD_SLO_AVAILABILITY"
+HOROVOD_SLO_WINDOW = "HOROVOD_SLO_WINDOW"
+HOROVOD_SLO_BURN_ALERT = "HOROVOD_SLO_BURN_ALERT"
 
 # Knobs read at their point of use rather than parsed into Config —
 # launcher/rendezvous wiring that exists before hvd.init() runs, elastic
@@ -131,6 +138,8 @@ DEFAULT_FLIGHT_RECORDER_CAPACITY = 2048
 DEFAULT_STRAGGLER_REPORT_SECONDS = 60.0
 DEFAULT_PROFILE_HISTORY = 64
 DEFAULT_LOCK_HOLD_WARN_SECONDS = 5.0
+DEFAULT_TRACE_CAPACITY = 4096
+DEFAULT_SLO_WINDOW = 512
 
 
 def _get_int(name: str, default: int) -> int:
@@ -158,6 +167,22 @@ def _get_bool(name: str, default: bool = False) -> bool:
     if value is None or value == "":
         return default
     return value.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def parse_trace(value: "str | None") -> "tuple[bool, int]":
+    """``HOROVOD_TRACE`` -> (enabled, span ring capacity). Same grammar
+    as ``HOROVOD_FLIGHT_RECORDER``: unset or truthy = on at the default
+    capacity; an integer > 1 is the capacity; 0/false/no/off disables."""
+    if value is None or value.strip() == "":
+        return True, DEFAULT_TRACE_CAPACITY
+    v = value.strip().lower()
+    if v in ("0", "false", "no", "off"):
+        return False, DEFAULT_TRACE_CAPACITY
+    try:
+        n = int(v)
+    except ValueError:
+        return True, DEFAULT_TRACE_CAPACITY
+    return True, (n if n > 1 else DEFAULT_TRACE_CAPACITY)
 
 
 def parse_flight_recorder(value: "str | None") -> "tuple[bool, int]":

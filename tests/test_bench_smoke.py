@@ -222,6 +222,43 @@ def test_bench_compare_memory_rows_clean_pass(bench_compare, tmp_path,
     assert "peak_hbm bytes" in out
 
 
+def test_bench_compare_serve_p99_regression_fails(bench_compare,
+                                                  tmp_path, capsys):
+    """ISSUE 15 satellite: serving tail latencies are direction-aware
+    sub-metrics. Throughput flat but the candidate's p99 latency
+    tripled — the ms row (lower is better) fails the gate on its own."""
+    serve_row = {"metric": "tokens/sec/chip (serving, continuous "
+                           "batching)",
+                 "value": 5000.0, "unit": "tokens/sec/chip",
+                 "p50_latency_ms": 80.0, "p99_latency_ms": 200.0,
+                 "p50_ttft_ms": 20.0, "p99_ttft_ms": 60.0}
+    base = _artifact(tmp_path / "base.json", [serve_row])
+    cand = _artifact(tmp_path / "cand.json",
+                     [dict(serve_row, p99_latency_ms=600.0)])
+    assert bench_compare.main([base, cand]) == 1
+    out = capsys.readouterr().out
+    assert "p99_latency_ms" in out
+    assert "lower is better" in out
+    # p50 + TTFT rows held steady and compare clean
+    assert "        ok  tokens/sec/chip (serving, continuous batching) " \
+           "[p50_latency_ms]" in out
+
+
+def test_bench_compare_serve_rows_clean_pass(bench_compare, tmp_path,
+                                             capsys):
+    row = {"metric": "tokens/sec/chip (serving)", "value": 5000.0,
+           "unit": "tokens/sec/chip", "p50_latency_ms": 80.0,
+           "p99_latency_ms": 200.0, "p50_ttft_ms": 20.0,
+           "p99_ttft_ms": 60.0}
+    base = _artifact(tmp_path / "base.json", [row])
+    cand = _artifact(tmp_path / "cand.json", [dict(row)])
+    assert bench_compare.main([base, cand]) == 0
+    out = capsys.readouterr().out
+    for key in ("p50_latency_ms", "p99_latency_ms", "p50_ttft_ms",
+                "p99_ttft_ms"):
+        assert key in out
+
+
 def test_bench_compare_usage_errors(bench_compare, tmp_path):
     assert bench_compare.main([]) == 2
     bad = tmp_path / "bad.json"
@@ -249,6 +286,16 @@ def test_serve_suite_tiny(bench, capsys):
     # ISSUE 13 satellite: KV-cache footprint rides the serving headline
     assert result["kv_cache_bytes_per_chip"] > 0
     assert 0.0 <= result["kv_utilization"] <= 1.0
+    # ISSUE 15: the interleaved tracing A/B rode along (goal < 1% on
+    # decode p50 — asserted loosely here, --tiny numbers are noisy) and
+    # the SLO plane scored every request in the run
+    assert isinstance(result["tracing_overhead_pct"], float)
+    assert result["spans_recorded"] > 0
+    assert result["slo_requests_scored"] >= result["requests"]
+    assert set(result["slo_burn_rate"]) == \
+        {"ttft", "latency", "availability"}
+    for obj, budget in result["slo_error_budget_remaining"].items():
+        assert 0.0 <= budget <= 1.0, obj
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["value"] == result["value"]
 

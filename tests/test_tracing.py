@@ -1,0 +1,456 @@
+"""Unit coverage for the tracing + SLO plane (tracing.py; docs/tracing.md).
+
+Pinned-down contracts:
+
+* the span ring is bounded: capacity evicts oldest-first and the
+  ``HOROVOD_TRACE`` grammar (off switch / capacity integer) holds;
+* trace context survives the KV wire format round-trip on both
+  ``Request`` and ``Completion``;
+* burn-rate math: bad fraction over the rolling window divided by the
+  allowed fraction, budget clamped at zero, ``ok=False`` scores only
+  the availability objective;
+* a burn-rate crossing emits exactly ONE ``slo_burn_rate`` flight event
+  and re-arms when the rate falls back under the threshold;
+* ``/slo`` and ``/healthz`` routes: readiness transitions (503 before
+  init, 503 while serving without a replica heartbeat, 200 after);
+* Chrome conversion + flow arrows: ``merge_profile_dir`` lays out
+  per-rank request lanes on the ``/_time``-corrected clock and joins one
+  trace_id's spans across lanes;
+* the replica loop records queue_wait/prefill/decode_block/serve spans
+  and scores the SLO tracker for every completion.
+
+The 2-rank half (frontend process + a real ``python -m
+horovod_tpu.serve`` replica, one trace_id across both ranks in the
+merged Perfetto trace) is at the bottom.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu import flight_recorder, profiler, tracing
+from horovod_tpu.serve.queue import Completion, Request, RequestQueue
+from horovod_tpu.utils.env import (HOROVOD_SLO_AVAILABILITY,
+                                   HOROVOD_SLO_LATENCY_MS,
+                                   HOROVOD_SLO_TTFT_MS, HOROVOD_SLO_WINDOW,
+                                   HOROVOD_TRACE, parse_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- span ring
+
+def test_parse_trace_grammar():
+    assert parse_trace(None) == (True, 4096)
+    assert parse_trace("1") == (True, 4096)
+    assert parse_trace("0") == (False, 4096)
+    assert parse_trace("off") == (False, 4096)
+    assert parse_trace("128") == (True, 128)
+
+
+def test_span_ring_bounded_oldest_evicted(monkeypatch):
+    monkeypatch.setenv(HOROVOD_TRACE, "16")
+    t = tracing.Tracer()
+    assert t.capacity == 16
+    for i in range(40):
+        t.record("s", t0=float(i), dur=0.001, trace_id="t%d" % i)
+    spans = t.spans()
+    assert len(spans) == 16
+    # oldest evicted, newest kept, order preserved
+    assert [s["trace_id"] for s in spans] == \
+        ["t%d" % i for i in range(24, 40)]
+
+
+def test_disabled_tracer_records_nothing(monkeypatch):
+    monkeypatch.setenv(HOROVOD_TRACE, "0")
+    t = tracing.Tracer()
+    t.record("s", t0=0.0, dur=0.001)
+    assert t.spans() == []
+
+
+def test_new_trace_ids_unique_and_wire_sized():
+    ids = {tracing.new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(len(i) == 16 for i in ids)
+
+
+# ------------------------------------------------------- context on the wire
+
+def test_trace_context_survives_kv_roundtrip():
+    req = Request(uid="r1", prompt=[1, 2, 3], max_new_tokens=4,
+                  trace_id="abcdef0123456789", requeues=2)
+    back = Request.from_json(req.to_json())
+    assert back.trace_id == "abcdef0123456789" and back.requeues == 2
+    done = Completion(uid="r1", tokens=[5], prompt_len=3, rank=1,
+                      trace_id="abcdef0123456789", requeues=2)
+    back = Completion.from_json(done.to_json())
+    assert back.trace_id == "abcdef0123456789" and back.requeues == 2
+
+
+def test_pre_tracing_wire_format_still_parses():
+    # a frontend from an older build omits the context fields entirely
+    raw = json.dumps({"uid": "r1", "prompt": [1], "max_new_tokens": 2})
+    req = Request.from_json(raw.encode())
+    assert req.trace_id == "" and req.requeues == 0
+
+
+def test_queue_submit_mints_trace_and_records_spans():
+    q = RequestQueue()
+    uid = q.submit([1, 2, 3], max_new_tokens=4)
+    req = q.pull(rank=0, max_n=1)[0]
+    assert req.trace_id                      # minted at submit
+    q.complete(Completion(uid=uid, tokens=[5], prompt_len=3, rank=0,
+                          trace_id=req.trace_id))
+    names = [s["name"] for s in tracing.spans()
+             if s.get("trace_id") == req.trace_id]
+    assert "request.submit" in names and "request.response" in names
+
+
+def test_eager_collective_records_span(hvd):
+    """The eager single-controller dispatch (_op_event) lands on the same
+    collective: lane as the enqueue runtime — a training script that never
+    touches the runtime still gets comm spans."""
+    import jax.numpy as jnp
+
+    before = tracing.tracer().spans_recorded()
+    hvd.allreduce(hvd.stack_per_worker(
+        [jnp.ones(4) * (r + 1) for r in range(hvd.size())]),
+        name="traced_probe")
+    spans = [s for s in tracing.spans()
+             if s["name"] == "collective:traced_probe"]
+    assert spans, [s["name"] for s in tracing.spans()]
+    assert spans[-1]["op"] == "allreduce" and spans[-1]["bytes"] > 0
+    assert tracing.tracer().spans_recorded() > before
+
+
+# ----------------------------------------------------------------- SLO math
+
+def _slo_tracker(monkeypatch, *, window=10, availability=0.9,
+                 latency_ms=100.0, ttft_ms=50.0, burn_alert=14.0):
+    monkeypatch.setenv(HOROVOD_SLO_WINDOW, str(window))
+    monkeypatch.setenv(HOROVOD_SLO_AVAILABILITY, str(availability))
+    monkeypatch.setenv(HOROVOD_SLO_LATENCY_MS, str(latency_ms))
+    monkeypatch.setenv(HOROVOD_SLO_TTFT_MS, str(ttft_ms))
+    monkeypatch.setenv("HOROVOD_SLO_BURN_ALERT", str(burn_alert))
+    return tracing.SLOTracker()
+
+
+def test_burn_rate_math(monkeypatch):
+    slo = _slo_tracker(monkeypatch)          # window 10, target 0.9
+    for _ in range(9):
+        slo.record_request(ttft_s=0.01, latency_s=0.05)
+    assert slo.burn_rate("latency") == 0.0
+    assert slo.error_budget_remaining("latency") == 1.0
+    # one slow request in a 10-deep window: bad fraction 0.1, allowed
+    # fraction 1 - 0.9 = 0.1 -> burn exactly 1.0, budget exhausted
+    slo.record_request(ttft_s=0.01, latency_s=0.5)
+    assert slo.burn_rate("latency") == pytest.approx(1.0)
+    assert slo.error_budget_remaining("latency") == pytest.approx(0.0)
+    # ttft stayed clean throughout
+    assert slo.burn_rate("ttft") == 0.0
+    st = slo.state()
+    assert st["slo"]["latency"]["bad_total"] == 1
+    assert st["requests_scored"] == 10
+
+
+def test_budget_clamps_at_zero(monkeypatch):
+    slo = _slo_tracker(monkeypatch)
+    for _ in range(5):
+        slo.record_request(ttft_s=0.01, latency_s=9.9)   # all bad
+    assert slo.burn_rate("latency") > 1.0
+    assert slo.error_budget_remaining("latency") == 0.0
+
+
+def test_failed_request_scores_only_availability(monkeypatch):
+    slo = _slo_tracker(monkeypatch)
+    slo.record_request(0.0, 0.0, ok=False)
+    st = slo.state()["slo"]
+    assert st["availability"]["window_observed"] == 1
+    assert st["availability"]["bad_total"] == 1
+    assert st["ttft"]["window_observed"] == 0
+    assert st["latency"]["window_observed"] == 0
+    # an unserved request must not pollute the latency percentiles
+    assert slo.state()["latency_ms_percentiles"]["p50"] is None
+
+
+def test_burn_alert_emits_once_then_rearms(monkeypatch):
+    # availability 0.5 -> allowed fraction 0.5; alert at burn >= 1.5,
+    # i.e. bad fraction >= 0.75 of the window
+    slo = _slo_tracker(monkeypatch, window=4, availability=0.5,
+                       burn_alert=1.5)
+
+    def alert_events():
+        return [e for e in flight_recorder.recorder().events()
+                if e.get("kind") == "slo_burn_rate"
+                and e.get("objective") == "latency"]
+
+    n0 = len(alert_events())
+    for _ in range(4):
+        slo.record_request(ttft_s=0.01, latency_s=9.9)
+    assert len(alert_events()) == n0 + 1     # one crossing, one event
+    slo.record_request(ttft_s=0.01, latency_s=9.9)
+    assert len(alert_events()) == n0 + 1     # sustained burn: no storm
+    assert slo.state()["slo"]["latency"]["alerting"]
+    for _ in range(4):                       # window drains clean
+        slo.record_request(ttft_s=0.01, latency_s=0.05)
+    assert not slo.state()["slo"]["latency"]["alerting"]
+    for _ in range(4):                       # re-crossing fires again
+        slo.record_request(ttft_s=0.01, latency_s=9.9)
+    assert len(alert_events()) == n0 + 2
+
+
+def test_slow_request_exemplars_keep_the_slowest(monkeypatch):
+    slo = _slo_tracker(monkeypatch, window=64, latency_ms=1e9, ttft_ms=1e9)
+    for i in range(12):
+        slo.record_request(
+            ttft_s=0.01, latency_s=0.1 * (i + 1), trace_id="t%d" % i,
+            phases={"queue_wait": 0.01, "decode": 0.09 * (i + 1)})
+    ex = slo.state()["slow_request_exemplars"]
+    assert len(ex) == 8                      # bounded
+    assert ex[0]["trace_id"] == "t11"        # slowest first
+    assert ex[0]["slowest_phase"] == "decode"
+    assert ex[0]["latency_ms"] == pytest.approx(1200.0)
+    lats = [e["latency_ms"] for e in ex]
+    assert lats == sorted(lats, reverse=True)
+
+
+def test_format_slo_report(monkeypatch):
+    slo = _slo_tracker(monkeypatch)
+    slo.record_request(ttft_s=0.01, latency_s=0.9, trace_id="deadbeef",
+                       phases={"decode": 0.8})
+    dumps = [{"launch_rank": 0, "state": {"slo": slo.state()}},
+             {"launch_rank": 1, "state": {}}]     # pre-tracing dump
+    report = tracing.format_slo_report(dumps)
+    assert "=== SLO report ===" in report
+    assert "rank 0" in report and "deadbeef" in report
+    assert tracing.format_slo_report([{"state": {}}]) == ""
+
+
+# ------------------------------------------------------------- HTTP routes
+
+def test_healthz_and_slo_routes(monkeypatch):
+    from horovod_tpu.metrics import registry
+
+    port = registry().serve(0)
+
+    def get(route):
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d%s" % (port, route),
+                    timeout=5.0) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, json.loads(exc.read().decode())
+
+    monkeypatch.setattr(tracing, "_init_ready", False)
+    monkeypatch.setattr(tracing, "_serve_started", False)
+    monkeypatch.setattr(tracing, "_serve_heartbeat_seen", False)
+    code, doc = get("/healthz")
+    assert code == 503 and not doc["ready"]
+    tracing.mark_initialized(True)
+    code, doc = get("/healthz")
+    assert code == 200 and doc["ready"]
+    # serving without a live replica heartbeat: not ready for traffic
+    tracing.note_serve_started()
+    code, doc = get("/healthz")
+    assert code == 503 and doc["serving"]
+    tracing.note_replica_heartbeat()
+    code, doc = get("/healthz")
+    assert code == 200 and doc["first_replica_heartbeat"]
+
+    code, doc = get("/slo")
+    assert code == 200
+    assert doc["schema"] == tracing.SCHEMA
+    assert set(doc["slo"]) == {"ttft", "latency", "availability"}
+    for rec in doc["slo"].values():
+        assert 0.0 <= rec["error_budget_remaining"] <= 1.0
+
+
+# --------------------------------------------- Chrome conversion + merging
+
+def test_spans_to_chrome_shape():
+    spans = [{"trace_id": "t1", "name": "request.prefill", "t": 100.0,
+              "dur": 0.25, "rank": 1, "uid": "r1"},
+             {"name": "bad", "t": "nan"}]      # malformed: skipped
+    (ev,) = tracing.spans_to_chrome(spans)
+    assert ev["ph"] == "X" and ev["cat"] == "request"
+    assert ev["ts"] == pytest.approx(100.0 * 1e6)
+    assert ev["dur"] == pytest.approx(0.25 * 1e6)
+    assert ev["args"]["trace_id"] == "t1" and ev["args"]["uid"] == "r1"
+
+
+def test_flow_events_join_multi_span_traces():
+    anchors = [
+        {"trace_id": "t1", "pid": 0, "tid": 2, "ts": 100.0, "dur": 5.0},
+        {"trace_id": "t1", "pid": 4, "tid": 2, "ts": 200.0, "dur": 9.0},
+        {"trace_id": "t1", "pid": 4, "tid": 2, "ts": 300.0, "dur": 1.0},
+        {"trace_id": "solo", "pid": 0, "tid": 2, "ts": 50.0, "dur": 1.0},
+    ]
+    flows = tracing.flow_events(anchors)
+    assert [f["ph"] for f in flows] == ["s", "t", "f"]   # solo: no flow
+    start, step, fin = flows
+    assert start["ts"] == pytest.approx(105.0)   # anchored at span END
+    assert step["ts"] == pytest.approx(200.0)    # receipt at span start
+    assert fin["bp"] == "e"
+    assert {f["id"] for f in flows} == {"t1"}
+
+
+def test_merge_profile_dir_corrects_clocks_and_draws_flows(tmp_path):
+    """Two fake rank dumps with different /_time offsets: the merged
+    trace must carry both request lanes on ONE corrected clock and join
+    the shared trace_id with flow arrows."""
+    trace_id = "feedface00000001"
+    base = 1000.0
+    dump0 = {"launch_rank": 0, "clock_offset_seconds": 0.0,
+             "trace_events": [], "flight_events": [],
+             "request_spans": [
+                 {"trace_id": trace_id, "name": "request.submit",
+                  "t": base, "dur": 0.001, "rank": 0}]}
+    # rank 1's clock runs 0.5 s fast; its offset estimate corrects it
+    dump1 = {"launch_rank": 1, "clock_offset_seconds": -0.5,
+             "trace_events": [], "flight_events": [],
+             "request_spans": [
+                 {"trace_id": trace_id, "name": "request.serve",
+                  "t": base + 0.6, "dur": 0.05, "rank": 1}]}
+    for rank, dump in ((0, dump0), (1, dump1)):
+        with open(tmp_path / f"profile-rank-{rank}.json", "w") as f:
+            json.dump(dump, f)
+    out, count = profiler.merge_profile_dir(str(tmp_path))
+    with open(out) as f:
+        merged = json.load(f)["traceEvents"]
+    labels = [e["args"]["labels"] for e in merged
+              if e.get("name") == "process_labels"]
+    assert "rank 0 requests" in labels and "rank 1 requests" in labels
+    xs = {e["name"]: e for e in merged
+          if e.get("ph") == "X" and e.get("cat") == "request"}
+    assert xs["request.submit"]["ts"] == pytest.approx(base * 1e6)
+    # 1000.6 on rank 1's fast clock is 1000.1 on the corrected one
+    assert xs["request.serve"]["ts"] == pytest.approx((base + 0.1) * 1e6)
+    assert xs["request.submit"]["pid"] != xs["request.serve"]["pid"]
+    flows = [e for e in merged if e.get("ph") in ("s", "t", "f")
+             and e.get("id") == trace_id]
+    assert [f["ph"] for f in sorted(flows, key=lambda f: f["ts"])] == \
+        ["s", "f"]
+
+
+# ------------------------------------------------------- replica lifecycle
+
+def test_replica_records_lifecycle_spans_and_scores_slo(monkeypatch):
+    from test_serve import _FakeEngine, _replica
+
+    slo = _slo_tracker(monkeypatch, window=16, latency_ms=1e9, ttft_ms=1e9)
+    monkeypatch.setattr(tracing, "_slo", slo)
+    q = RequestQueue()
+    uid = q.submit([1, 2], max_new_tokens=3)
+    rep = _replica(_FakeEngine(), q)
+    for _ in range(4):
+        rep._iterate()
+    done = q.result(uid, timeout=1.0)
+    assert done.trace_id
+    names = {s["name"] for s in tracing.spans()
+             if s.get("trace_id") == done.trace_id}
+    assert {"request.submit", "request.queue_wait", "request.prefill",
+            "request.decode_block", "request.serve",
+            "request.response"} <= names
+    st = slo.state()
+    assert st["requests_scored"] == 1
+    (ex,) = st["slow_request_exemplars"]
+    assert ex["trace_id"] == done.trace_id
+    assert set(ex["phases_ms"]) == {"queue_wait", "prefill", "decode"}
+
+
+def test_rejected_request_is_an_availability_bad_event(monkeypatch):
+    from test_serve import _FakeEngine, _replica
+
+    slo = _slo_tracker(monkeypatch, window=16)
+    monkeypatch.setattr(tracing, "_slo", slo)
+    q = RequestQueue()
+    uid = q.submit(list(range(100)), max_new_tokens=4)  # > max_seq=64
+    rep = _replica(_FakeEngine(), q)
+    rep._iterate()
+    assert q.result(uid, timeout=1.0).finish == "rejected"
+    st = slo.state()["slo"]
+    assert st["availability"]["bad_total"] == 1
+    assert st["latency"]["window_observed"] == 0
+
+
+# --------------------------------------------------- 2-rank merged trace
+
+def test_one_trace_id_spans_both_ranks_in_merged_trace(tmp_path,
+                                                       monkeypatch):
+    """The acceptance shape of the tentpole, fast-tier: a frontend (this
+    process, rank 0) submits ONE traced request to a real replica worker
+    process (rank 1, ``python -m horovod_tpu.serve``); both dump profile
+    snapshots into one dir; the merged Perfetto trace must show that
+    trace_id's spans on BOTH ranks' request lanes, joined by a flow."""
+    from horovod_tpu.run.rendezvous import KVStoreClient, RendezvousServer
+    from horovod_tpu.serve.queue import KVQueueFrontend
+
+    server = RendezvousServer(host="127.0.0.1")
+    port = server.start()
+    proc = None
+    try:
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "HOROVOD_RANK": "1",
+            "HOROVOD_RENDEZVOUS_HTTP_ADDR": "127.0.0.1",
+            "HOROVOD_RENDEZVOUS_HTTP_PORT": str(port),
+            "HOROVOD_PROFILE_DIR": str(tmp_path),
+            "HOROVOD_SERVE_ADMISSION_MS": "1",
+            "JAX_PLATFORMS": "cpu",
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.serve", "--vocab", "64",
+             "--d-model", "16", "--layers", "1", "--heads", "1",
+             "--d-ff", "32", "--max-seq", "32"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+        front = KVQueueFrontend(
+            KVStoreClient("127.0.0.1", port, scope="serve", timeout=10.0))
+        assert front.wait_for_replicas(1, timeout=90.0) == [1]
+        req = Request(uid="traced-1", prompt=[1, 2, 3, 4],
+                      max_new_tokens=4, submitted_s=time.monotonic())
+        front.submit(req, rank=1)
+        trace_id = req.trace_id
+        assert trace_id
+        deadline = time.monotonic() + 90.0
+        while front.pending() and time.monotonic() < deadline:
+            front.poll_responses()
+            time.sleep(0.05)
+        assert front.pending() == 0, "traced request never completed"
+        assert front._done["traced-1"].trace_id == trace_id
+        front.stop_fleet()
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == 0, out[-2000:]
+
+        # the worker's finalize dumped profile-rank-1.json; dump the
+        # frontend's spans (this process) alongside it and merge
+        profiler.dump(path=str(tmp_path / "profile-rank-0.json"),
+                      ship=False)
+        merged_path, _ = profiler.merge_profile_dir(str(tmp_path))
+        with open(merged_path) as f:
+            merged = json.load(f)["traceEvents"]
+        ours = [e for e in merged if e.get("ph") == "X"
+                and e.get("cat") == "request"
+                and (e.get("args") or {}).get("trace_id") == trace_id]
+        assert {e["args"]["rank"] for e in ours} == {0, 1}
+        assert len({e["pid"] for e in ours}) >= 2   # two request lanes
+        names = {e["name"] for e in ours}
+        assert "request.submit" in names            # frontend side
+        assert "request.serve" in names             # replica side
+        flows = [e for e in merged if e.get("ph") in ("s", "t", "f")
+                 and e.get("id") == trace_id]
+        assert [f for f in flows if f["ph"] == "s"] and \
+            [f for f in flows if f["ph"] == "f"]
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        server.stop()

@@ -18,9 +18,10 @@ Direction comes from the unit: rates (``*/sec*``), ``mfu`` and
 ``x``-factors are higher-is-better; ``ms``/``us``/``seconds``/``bytes``
 are lower-is-better. Rows marked ``"tiny": true`` (smoke-test mode —
 bench.py's own docs call the numbers meaningless) are ignored. The
-embedded per-headline MFU and step-phase seconds (``step_breakdown``,
-PR 6) are compared as derived sub-metrics; phases under 1 ms are skipped
-(pure jitter at that scale). Exit status: 0 clean, 1 regression(s),
+embedded per-headline MFU, step-phase seconds (``step_breakdown``,
+PR 6), and serving tail latencies (p50/p99 request latency and TTFT,
+``ms`` so lower-is-better) are compared as derived sub-metrics; phases
+under 1 ms are skipped (pure jitter at that scale). Exit status: 0 clean, 1 regression(s),
 2 usage/parse error.
 """
 
@@ -122,6 +123,12 @@ def derived_rows(rows: Dict[str, dict]) -> Dict[str, Tuple[float, str]]:
         if isinstance(obj.get("kv_cache_bytes_per_chip"), (int, float)):
             flat[f"{metric} [kv_cache bytes]"] = (
                 float(obj["kv_cache_bytes_per_chip"]), "bytes")
+        # serving tail latencies (bench.py --serve): "ms" unit makes them
+        # lower-is-better, so a p99 blow-up gates even when tokens/s holds
+        for key in ("p50_latency_ms", "p99_latency_ms",
+                    "p50_ttft_ms", "p99_ttft_ms"):
+            if isinstance(obj.get(key), (int, float)):
+                flat[f"{metric} [{key}]"] = (float(obj[key]), "ms")
     return flat
 
 

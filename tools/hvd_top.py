@@ -2,9 +2,10 @@
 """hvd_top: curses-free live memory/throughput view across ranks.
 
 Polls each rank's metrics endpoint (``GET /memory`` for the per-subsystem
-ledger + device truth, ``GET /metrics`` for a couple of headline rates)
-and renders one table per refresh — plain ANSI-free text, so it works in
-a dumb terminal, under ``watch``, or piped to a log.
+ledger + device truth, ``GET /metrics`` for a couple of headline rates,
+and — when the serving plane is live — ``GET /slo`` + ``GET /serve`` for
+the SLO panel) and renders one table per refresh — plain ANSI-free text,
+so it works in a dumb terminal, under ``watch``, or piped to a log.
 
     python tools/hvd_top.py host1:9100 host2:9100
     python tools/hvd_top.py --interval 5 :9100          # localhost
@@ -123,6 +124,65 @@ def render(endpoints: List[str]) -> str:
     return "\n".join(out)
 
 
+def render_slo(endpoints: List[str]) -> str:
+    """SLO/serve panel: error budget, burn rate and tail latencies per
+    rank (``GET /slo``, docs/tracing.md) plus completed/active request
+    counts from ``GET /serve``. Returns "" when no endpoint exposes the
+    SLO plane (training-only fleet or pre-tracing build) so the memory
+    table stays the whole display."""
+    header = ["rank", "endpoint", "scored", "burn", "budget", "alerting",
+              "ttft p50/p99", "latency p50/p99", "done", "active"]
+    rows: List[List[str]] = []
+    any_slo = False
+    for ep in endpoints:
+        slo = fetch_json(ep, "/slo")
+        if slo is None or "slo" not in slo:
+            continue
+        any_slo = True
+        per_obj: Dict[str, dict] = slo.get("slo", {})
+        burns = [o.get("burn_rate") for o in per_obj.values()
+                 if isinstance(o.get("burn_rate"), (int, float))]
+        budgets = [o.get("error_budget_remaining") for o in per_obj.values()
+                   if isinstance(o.get("error_budget_remaining"),
+                                 (int, float))]
+        alerting = ",".join(sorted(
+            name for name, o in per_obj.items() if o.get("alerting"))) or "-"
+        lat = slo.get("latency_ms_percentiles") or {}
+        ttft = slo.get("ttft_ms_percentiles") or {}
+
+        def pair(p: dict) -> str:
+            p50, p99 = p.get("p50"), p.get("p99")
+            if not isinstance(p50, (int, float)):
+                return "-"
+            return "%.0f/%.0f ms" % (p50, p99 if isinstance(
+                p99, (int, float)) else p50)
+
+        done = active = None
+        serve = fetch_json(ep, "/serve")
+        if serve is not None:
+            reps = [r for h in serve.get("handles", ())
+                    for r in h.get("replicas", ())]
+            done = sum(int(r.get("completed", 0)) for r in reps)
+            active = sum(int(r.get("active", 0)) for r in reps)
+        rows.append(
+            [str(slo.get("rank", "?")), ep,
+             str(slo.get("requests_scored", 0)),
+             ("%.2f" % max(burns)) if burns else "-",
+             ("%.2f" % min(budgets)) if budgets else "-",
+             alerting, pair(ttft), pair(lat),
+             "-" if done is None else str(done),
+             "-" if active is None else str(active)])
+    if not any_slo:
+        return ""
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows), 1)
+              if rows else len(header[i]) for i in range(len(header))]
+    out = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        out.append("  ".join(r[i].ljust(widths[i])
+                             for i in range(len(header))))
+    return "\n".join(out)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="live per-rank memory ledger (polls /memory)")
@@ -139,6 +199,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("hvd_top  %s  (%d endpoint%s)" % (
             stamp, len(endpoints), "" if len(endpoints) == 1 else "s"))
         print(render(endpoints))
+        slo_panel = render_slo(endpoints)
+        if slo_panel:
+            print()
+            print(slo_panel)
         if args.once:
             return 0
         sys.stdout.flush()
