@@ -181,6 +181,27 @@ def memory_rows(params_tree=None):
         return {"bytes_per_chip": None, "peak_hbm_bytes": None}
 
 
+def comms_rows():
+    """Headline comms fields (docs/comms.md): the busiest lane's smoothed
+    bus bandwidth + its roofline utilization from the tracker ledger.
+    None/None when no collective moved bytes this run (1-chip world with
+    nothing on any wire)."""
+    try:
+        from horovod_tpu import comms
+
+        led = comms.tracker().ledger()
+        lanes = {name: rec for name, rec in led["lanes"].items()
+                 if rec.get("busbw_gbs")}
+        if not lanes:
+            return {"busbw_gbs": None, "comms_utilization": None}
+        busiest = max(lanes, key=lambda ln: lanes[ln]["bytes_total"])
+        rec = lanes[busiest]
+        return {"busbw_gbs": rec["busbw_gbs"],
+                "comms_utilization": rec.get("utilization")}
+    except Exception:
+        return {"busbw_gbs": None, "comms_utilization": None}
+
+
 def bucket_overlap_probe(model, optimizer, state, image_size,
                          batch=8, steps=4):
     """Bytes-weighted hidden fraction of the release plan's wire traffic.
@@ -326,6 +347,7 @@ def main(model_name: str = "resnet50", allow_env: bool = True):
         "comm_hidden_fraction": hidden_fraction,
         "comm_hidden_fraction_bytes": hidden_bytes,
         **memory_rows(params),
+        **comms_rows(),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -579,6 +601,7 @@ def transformer_main(family: str, allow_env: bool = True,
         "comm_hidden_fraction": hidden_fraction,
         "comm_hidden_fraction_bytes": hidden_bytes,
         **memory_rows(params),
+        **comms_rows(),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -781,6 +804,7 @@ def collectives_main(tiny: bool = False):
         "program_compiles_total": executor_mod._PROGRAM_COMPILES.value,
         "program_cache_hits_total": executor_mod._PROGRAM_CACHE_HITS.value,
         "flight_recorder": fr_overhead,
+        **comms_rows(),
     }
     if tiny:
         result["tiny"] = True
@@ -1010,6 +1034,96 @@ def memory_main(tiny: bool = False):
     return result
 
 
+def comms_main(tiny: bool = False):
+    """Comms-plane microbench (ISSUE 16): steady-state cost of the
+    collective-transport observatory on the fused allreduce path, at
+    BERT-Large gradient shapes.
+
+    Two interleaved phases over identical named tensors (the --integrity
+    protocol, so dispatch drift cannot masquerade as tracker cost):
+    comms accounting OFF (tracker disabled — record() returns at the
+    guard) and ON (every dispatch pays the algbw/busbw bookkeeping).
+    Headline ``value``: added p50 step %, goal < 1%. The timed phases
+    must add ZERO new XLA program compiles (the --collectives canary) —
+    the observatory only ever watches the wire, never touches programs.
+
+    ``tiny`` (--tiny / the tier-1 smoke test): toy shapes + 2 steps."""
+    hvd.init()
+    from horovod_tpu import comms
+    from horovod_tpu.runtime import executor as executor_mod
+
+    world = hvd.size()
+    if tiny:
+        shapes = [(256,), (64, 8)]
+        warmup_steps, timed_steps = 3, 2
+    else:
+        shapes = [(1024, 1024), (1024, 1024), (1024, 4096), (4096, 1024),
+                  (1024,)]
+        warmup_steps, timed_steps = 6, 7
+    rng = np.random.RandomState(0)
+    payloads = [rng.randn(world, *s).astype(np.float32) for s in shapes]
+    n_elems = sum(int(np.prod(s)) for s in shapes)
+    log(f"comms bench: {len(shapes)} tensors, "
+        f"{n_elems * 4 / 1e6:.1f} MB/step/worker, np={world}"
+        f"{' (tiny)' if tiny else ''}")
+
+    t = comms.tracker()
+    was_enabled = t.enabled
+
+    def one_step(step):
+        hs = [hvd.allreduce_async(
+            hvd.stack_per_worker(list(payloads[j] + np.float32(step))),
+            name=f"comms/t{j}") for j in range(len(shapes))]
+        for h in hs:
+            hvd.synchronize(h)
+
+    try:
+        t.enabled = True
+        for s in range(warmup_steps):
+            one_step(s)
+
+        compiles0 = executor_mod._PROGRAM_COMPILES.value
+        phases = {"off": (False, []), "on": (True, [])}
+        for s in range(timed_steps):
+            for name, (on, lat) in phases.items():
+                t.enabled = on
+                t0 = time.perf_counter()
+                one_step(1000 + s * len(phases))
+                lat.append(time.perf_counter() - t0)
+        steady_compiles = executor_mod._PROGRAM_COMPILES.value - compiles0
+        t.enabled = True
+        led = t.ledger()
+    finally:
+        t.enabled = was_enabled
+
+    p50 = {name: float(np.median(lat)) for name, (_, lat) in phases.items()}
+    overhead = (round(100.0 * (p50["on"] - p50["off"]) / p50["off"], 2)
+                if p50["off"] > 0 else None)
+    lanes = {name: rec["busbw_gbs"] for name, rec in led["lanes"].items()
+             if rec.get("busbw_gbs")}
+    result = {
+        "metric": f"comms tracker steady-state step overhead "
+                  f"(per-dispatch algbw/busbw accounting, "
+                  f"{'toy' if tiny else 'BERT-Large layer'} gradient "
+                  f"shapes, np={world})",
+        "value": overhead,
+        "unit": "%",
+        "goal": "< 1%",
+        "p50_ms_comms_off": round(p50["off"] * 1e3, 3),
+        "p50_ms_comms_on": round(p50["on"] * 1e3, 3),
+        "steady_state_compiles": int(steady_compiles),
+        "lane_busbw_gbs": lanes,
+        **comms_rows(),
+    }
+    if tiny:
+        result["tiny"] = True
+    log(f"comms: p50 off {result['p50_ms_comms_off']} ms, "
+        f"on {result['p50_ms_comms_on']} ms ({overhead}%); "
+        f"compiles(timed)={steady_compiles}; lanes={lanes}")
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def _bert_large_param_shapes():
     """BERT-Large parameter shapes (L=24, d=1024, ff=4096, vocab 30522,
     seq 512) as a flat dict — ~335M params, the flagship workload's
@@ -1144,6 +1258,7 @@ def sharded_optimizer_main(tiny: bool = False):
             round(rep_bytes / sharded_bytes, 2) if sharded_bytes else None),
         "steady_state_program_builds": int(steady_builds),
         **memory_rows(),
+        **comms_rows(),
     }
     if tiny:
         result["tiny"] = True
@@ -1442,6 +1557,7 @@ def serve_main(tiny: bool = False):
                 for obj in ("ttft", "latency", "availability")},
             "tiny": tiny,
             **memory_rows(params),
+            **comms_rows(),
         }
     finally:
         handle.close()
@@ -1508,6 +1624,7 @@ def tiny_main():
         "comm_hidden_fraction_bytes": hidden_bytes,
         "tiny": True,
         **memory_rows(params),
+        **comms_rows(),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -1561,6 +1678,12 @@ if __name__ == "__main__":
                              "overhead at BERT-Large gradient shapes, "
                              "interleaved A/B, plus the ledger and "
                              "claimed-vs-actual drift (one JSON line)")
+    parser.add_argument("--comms", action="store_true",
+                        help="microbench the collective-transport "
+                             "observatory: per-dispatch algbw/busbw "
+                             "accounting overhead at BERT-Large gradient "
+                             "shapes, interleaved A/B + compile-count "
+                             "canary (one JSON line)")
     parser.add_argument("--tiny", action="store_true",
                         help="toy sizes + a couple of steps for "
                              "--collectives/--sharded-optimizer/"
@@ -1579,6 +1702,8 @@ if __name__ == "__main__":
         serve_main(tiny=cli.tiny)
     elif cli.memory:
         memory_main(tiny=cli.tiny)
+    elif cli.comms:
+        comms_main(tiny=cli.tiny)
     elif cli.collectives:
         collectives_main(tiny=cli.tiny)
     elif cli.integrity:

@@ -18,8 +18,11 @@ are served from a cache (see docs/benchmarks.md).
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import time
-from typing import Optional
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +30,14 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.core import mesh as mesh_mod
+
+log = logging.getLogger("horovod_tpu")
+
+HOROVOD_PROBE_CACHE = "HOROVOD_PROBE_CACHE"
+
+# persisted roofline artifact schema (bumped on incompatible change;
+# a mismatched schema simply re-probes)
+_CACHE_SCHEMA = 1
 
 
 def _timed_scalar(fn, *args) -> float:
@@ -79,11 +90,17 @@ def probe_hbm_bandwidth(size_mb: int = 64, iters: int = 16) -> float:
 
 
 def probe_allreduce_bandwidth(mesh=None, size_mb: int = 32,
-                              iters: int = 8) -> float:
+                              iters: int = 8,
+                              detail: bool = False) -> Union[float, dict]:
     """Algorithm bandwidth (input bytes / time) of a full-mesh all-reduce
     in GB/s — the ICI number that bounds fused-collective latency. On a
     1-device mesh this degenerates to an HBM-bound pass, which is the
-    right bound there too."""
+    right bound there too.
+
+    ``detail=True`` returns ``{"algbw_gbps", "busbw_gbps", "world"}`` —
+    bus bandwidth (algbw x 2(N-1)/N, the comms-plane convention,
+    docs/comms.md) plus the mesh size it was probed on, so a persisted
+    roofline from a different world size can be invalidated."""
     from horovod_tpu.core import basics
 
     if mesh is None:
@@ -111,7 +128,15 @@ def probe_allreduce_bandwidth(mesh=None, size_mb: int = 32,
         return chain
 
     dt = _per_iter_time(make_chain, x, max(1, iters // 4), iters)
-    return x.nbytes / dt / 1e9
+    algbw = x.nbytes / dt / 1e9
+    if not detail:
+        return algbw
+    from horovod_tpu import comms
+
+    world = int(mesh.size)
+    return {"algbw_gbps": algbw,
+            "busbw_gbps": algbw * comms.bus_factor("allreduce", world),
+            "world": world}
 
 
 def recommended_fusion_threshold(allreduce_gbps: float,
@@ -138,6 +163,54 @@ def recommended_fusion_threshold(allreduce_gbps: float,
     return max(floor_bytes, min(ceil_bytes, threshold))
 
 
+def _cache_path() -> Optional[str]:
+    path = os.environ.get(HOROVOD_PROBE_CACHE, "").strip()
+    return path or None
+
+
+def load_cached_roofline(path: Optional[str] = None,
+                         world: Optional[int] = None) -> Optional[dict]:
+    """Read the persisted probe artifact (``HOROVOD_PROBE_CACHE``).
+    Returns None when the knob is unset, the file is missing/corrupt,
+    the schema moved on, or — the invalidation this artifact exists to
+    get right — it was probed on a different world size (busbw's ring
+    factor is a function of N; a 4-chip roofline says nothing about a
+    32-chip pod)."""
+    path = path or _cache_path()
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != _CACHE_SCHEMA:
+        return None
+    if world is not None and int(doc.get("world", -1)) != int(world):
+        log.info("probe cache %s ignored: probed on world=%s, running "
+                 "world=%d", path, doc.get("world"), world)
+        return None
+    return doc
+
+
+def _persist_roofline(path: str, doc: dict) -> None:
+    """fsync'd write of the roofline artifact (tmp + rename, directory
+    fsync'd too — a crashed init must not leave a torn JSON that every
+    later restart trips over)."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dir_fd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
 def probe_and_seed(config, mesh=None) -> dict:
     """Run the probes and seed ``config.fusion_threshold_bytes``; returns
     the measurements. Called at runtime startup when
@@ -145,11 +218,76 @@ def probe_and_seed(config, mesh=None) -> dict:
     multi-controller (jax.distributed) world — the probe programs execute
     over the global mesh, which all processes must enter together; the
     coordinator's seeded value then wins via the per-cycle parameter
-    broadcast, so probe noise cannot diverge the workers."""
-    hbm = probe_hbm_bandwidth()
-    ar = probe_allreduce_bandwidth(mesh)
-    threshold = recommended_fusion_threshold(ar, config.cycle_time_ms,
-                                             hbm_gbps=hbm)
+    broadcast, so probe noise cannot diverge the workers.
+
+    With ``HOROVOD_PROBE_CACHE=<path>`` the measurements are persisted as
+    a JSON roofline artifact (fsync'd) and reloaded on restart instead of
+    re-probing every ``hvd.init()`` — a cached artifact from a different
+    world size is invalidated (the busbw ring factor depends on N). The
+    same artifact seeds the comms plane's lane rooflines
+    (comms.configure / docs/comms.md)."""
+    from horovod_tpu import comms
+
+    if mesh is None:
+        from horovod_tpu.core import basics
+
+        mesh = basics._ensure_init().mesh
+    world = int(mesh.size)
+    cached = load_cached_roofline(world=world)
+    if cached is not None:
+        measured = {
+            "hbm_gbps": float(cached["hbm_gbps"]),
+            "allreduce_gbps": float(cached["allreduce_gbps"]),
+            "allreduce_busbw_gbps": float(
+                cached.get("allreduce_busbw_gbps", 0.0)),
+            "world": world,
+            "cached": True,
+        }
+        log.info("probe cache hit (%s): HBM %.1f GB/s, allreduce %.1f "
+                 "GB/s algbw / %.1f GB/s busbw (world=%d) — probes "
+                 "skipped", _cache_path(), measured["hbm_gbps"],
+                 measured["allreduce_gbps"],
+                 measured["allreduce_busbw_gbps"], world)
+    else:
+        hbm = probe_hbm_bandwidth()
+        ar = probe_allreduce_bandwidth(mesh, detail=True)
+        if not isinstance(ar, dict):  # a monkeypatched/legacy float
+            ar = {"algbw_gbps": float(ar),
+                  "busbw_gbps": float(ar)
+                  * comms.bus_factor("allreduce", world),
+                  "world": world}
+        measured = {
+            "hbm_gbps": hbm,
+            "allreduce_gbps": ar["algbw_gbps"],
+            "allreduce_busbw_gbps": ar["busbw_gbps"],
+            "world": world,
+            "cached": False,
+        }
+    threshold = recommended_fusion_threshold(
+        measured["allreduce_gbps"], config.cycle_time_ms,
+        hbm_gbps=measured["hbm_gbps"])
     config.fusion_threshold_bytes = threshold
-    return {"hbm_gbps": hbm, "allreduce_gbps": ar,
-            "fusion_threshold_bytes": threshold}
+    measured["fusion_threshold_bytes"] = threshold
+    path = _cache_path()
+    if path and not measured["cached"]:
+        try:
+            _persist_roofline(path, {
+                "schema": _CACHE_SCHEMA,
+                "hbm_gbps": measured["hbm_gbps"],
+                "allreduce_gbps": measured["allreduce_gbps"],
+                "allreduce_busbw_gbps": measured["allreduce_busbw_gbps"],
+                "world": world,
+                "fusion_threshold_bytes": threshold,
+                "wall_time": time.time(),
+            })
+        except OSError as exc:
+            log.warning("probe cache not persisted to %s: %s", path, exc)
+    # seed the comms plane's XLA-lane rooflines from the live (or cached)
+    # measurement — the probe runs after comms.configure, so a first-boot
+    # probe (no artifact yet) still pins the roofline this run
+    if measured["allreduce_busbw_gbps"] > 0:
+        source = "probe_cache" if measured["cached"] else "probe"
+        for lane in ("device", "spmd"):
+            comms.tracker().seed_roofline(
+                lane, measured["allreduce_busbw_gbps"], source=source)
+    return measured

@@ -158,6 +158,13 @@ def init(
 
         tracing.configure(rank=st.rank)
 
+        # collective transport observatory: adopt rank/world, seed lane
+        # rooflines from the persisted probe artifact, register the
+        # "comms" state provider (HOROVOD_COMMS_* / HOROVOD_PROBE_CACHE)
+        from horovod_tpu import comms
+
+        comms.configure(rank=st.rank, world=st.size)
+
         if st.config.timeline_file:
             from horovod_tpu.timeline import Timeline
 

@@ -543,4 +543,17 @@ def format_postmortem(dumps: List[dict], last_n: int = 40,
             lines.append(report)
     except Exception:
         pass  # likewise if the tracing plane is broken
+    try:
+        # cross-rank comms report from the dumps' "comms" state (comms.py;
+        # empty for pre-comms dumps): per-lane busbw vs roofline, the
+        # slowest lane, and the rank furthest below its roofline. Lazy:
+        # comms.py imports this module.
+        from horovod_tpu import comms
+
+        report = comms.format_comms_report(dumps)
+        if report:
+            lines.append("")
+            lines.append(report)
+    except Exception:
+        pass  # likewise if the comms plane is broken
     return "\n".join(lines)

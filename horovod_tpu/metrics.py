@@ -338,6 +338,20 @@ class MetricsRegistry:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif route == "/comms":
+                    # collective-transport ledger: per-lane busbw vs
+                    # roofline, per-(op,lane,bucket) windows, degradation
+                    # state (comms.comms_state; docs/comms.md)
+                    from horovod_tpu import comms
+
+                    body = json.dumps(
+                        comms.comms_state(),
+                        default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif route == "/slo":
                     # SLO plane: per-objective burn rate / error budget,
                     # latency percentiles, slow-request exemplars

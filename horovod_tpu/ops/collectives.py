@@ -42,7 +42,7 @@ from jax import lax
 from horovod_tpu.utils import compat
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from horovod_tpu import flight_recorder, tracing
+from horovod_tpu import comms, flight_recorder, tracing
 from horovod_tpu.compression import Compression
 from horovod_tpu.core import basics, mesh as mesh_mod, state as state_mod
 
@@ -730,6 +730,9 @@ def _op_event(op: str, st, x, fn, name: Optional[str] = None):
     total = time.monotonic() - t0
     flight_recorder.emit("op_complete", op=op, shard=int(st.rank),
                          bytes=nbytes, seconds=round(total, 6))
+    # comms plane: eager single-controller collectives ride the fused
+    # XLA "device" lane (docs/comms.md lane taxonomy)
+    comms.record(op, "device", nbytes, total, world=int(st.size))
     if tracing.enabled():
         tracing.record("collective:" + str(name or op), t0_epoch, total,
                        op=op, bytes=nbytes)

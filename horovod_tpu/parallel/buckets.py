@@ -70,6 +70,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
@@ -77,6 +78,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from horovod_tpu import comms
 from horovod_tpu.analysis import witness
 from horovod_tpu.utils import env as env_mod
 
@@ -370,7 +372,12 @@ class GradReleasePlan:
             group_callback=self._on_wire_complete)
         with self._wire_lock:
             self._wire_released += len(handles)
-        self._released.append((bucket.index, list(zip(wire_idx, handles))))
+        wire_bytes = sum(
+            int(np.prod(np.shape(t), dtype=np.int64)
+                * np.dtype(t.dtype).itemsize) for t in tensors)
+        self._released.append((bucket.index,
+                               list(zip(wire_idx, handles)),
+                               time.monotonic(), wire_bytes))
 
     def _on_wire_complete(self, ok: bool) -> None:
         # runs on the runtime cycle thread as each entry completes/fails
@@ -409,13 +416,22 @@ class GradReleasePlan:
 
         out = list(leaves)
         failure = None
-        for _bucket_idx, pairs in self._released:
+        for _bucket_idx, pairs, t_release, wire_bytes in self._released:
+            bucket_ok = bool(pairs)
             for i, h in pairs:
                 try:
                     out[i] = collectives.synchronize(h)
                 except Exception as exc:  # drain the rest before raising
+                    bucket_ok = False
                     if failure is None:
                         failure = exc
+            if bucket_ok:
+                # comms plane "bucket_wire" lane: one record per released
+                # bucket, release→drain wall time over the bucket's wire
+                # payload (docs/comms.md) — the end-to-end view next to
+                # the carrying lane's per-dispatch records
+                comms.record("allreduce", "bucket_wire", wire_bytes,
+                             time.monotonic() - t_release)
         for i, v in self._local.items():
             out[i] = v
         self._reset_step()
@@ -452,7 +468,7 @@ class GradReleasePlan:
         """Drain every in-flight handle (ignoring errors) and reset —
         for callers that abandon a step without gathering (elastic
         re-form paths)."""
-        for _bucket_idx, pairs in self._released:
+        for _bucket_idx, pairs, _t_release, _wire_bytes in self._released:
             for _i, h in pairs:
                 try:
                     h.wait()

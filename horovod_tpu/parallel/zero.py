@@ -76,7 +76,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from horovod_tpu import flight_recorder
+from horovod_tpu import comms, flight_recorder
 from horovod_tpu.compression import Compression
 from horovod_tpu.core import basics, mesh as mesh_mod
 from horovod_tpu.metrics import LATENCY_BUCKETS, registry as _metrics
@@ -264,9 +264,14 @@ def _emit_phase(op: str, phase: str, shard: int, nbytes: int, fn):
                          shard=int(shard), bytes=int(nbytes))
     t0 = time.monotonic()
     out = fn()
+    seconds = time.monotonic() - t0
     flight_recorder.emit("op_complete", op=op, phase=phase,
                          shard=int(shard), bytes=int(nbytes),
-                         seconds=round(time.monotonic() - t0, 6))
+                         seconds=round(seconds, 6))
+    # comms plane: the ZeRO reduce-scatter/allgather phases get their own
+    # "zero" lane — end-to-end sharded-phase bandwidth, next to the wire
+    # lane the bytes physically rode (docs/comms.md)
+    comms.record(op, "zero", nbytes, seconds)
     return out
 
 
@@ -514,17 +519,19 @@ def sharded_update(optimizer, *, average: bool = True,
                 shard=spec.rank, group=gi, bytes=int(nbytes))
             # stable per-group names: the negotiation response cache and
             # the timeline see the same tensor lane every step
-            handles.append((gi, g, ctx, time.monotonic(),
+            handles.append((gi, g, ctx, int(nbytes), time.monotonic(),
                             get_runtime().enqueue_reducescatter(
                                 f"sharded.grads.g{gi}", wire,
                                 reduce_op=op_name)))
         gshards = [None] * len(spec.groups)
-        for gi, g, ctx, t0, h in handles:
+        for gi, g, ctx, nbytes, t0, h in handles:
             out = compression.decompress(collectives.synchronize(h), ctx)
+            seconds = time.monotonic() - t0
             flight_recorder.emit(
                 "op_complete", op="reducescatter", phase="sharded_grads",
-                shard=spec.rank, group=gi,
-                seconds=round(time.monotonic() - t0, 6))
+                shard=spec.rank, group=gi, seconds=round(seconds, 6))
+            comms.record("reducescatter", "zero", nbytes, seconds,
+                         world=spec.world)
             gshards[gi] = jnp.asarray(out).astype(np.dtype(g.dtype))
         pshards = (_local_shards(pleaves, spec)
                    if pleaves is not None else None)
@@ -538,17 +545,20 @@ def sharded_update(optimizer, *, average: bool = True,
                 "op_dispatch", op="allgather", phase="sharded_updates",
                 shard=spec.rank, group=gi,
                 bytes=int(nbytes) * spec.world)
-            ag_handles.append((gi, g, time.monotonic(),
+            ag_handles.append((gi, g, int(nbytes) * spec.world,
+                               time.monotonic(),
                                get_runtime().enqueue_allgather(
                                    f"sharded.updates.g{gi}",
                                    jnp.asarray(d))))
         out = [None] * spec.num_leaves
-        for gi, g, t0, h in ag_handles:
+        for gi, g, nbytes, t0, h in ag_handles:
             full = jnp.asarray(collectives.synchronize(h))
+            seconds = time.monotonic() - t0
             flight_recorder.emit(
                 "op_complete", op="allgather", phase="sharded_updates",
-                shard=spec.rank, group=gi,
-                seconds=round(time.monotonic() - t0, 6))
+                shard=spec.rank, group=gi, seconds=round(seconds, 6))
+            comms.record("allgather", "zero", nbytes, seconds,
+                         world=spec.world)
             _unpack_group(full, g, out)
         return tuple(out), ShardedOptState(spec, new_inner)
 

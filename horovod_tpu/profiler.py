@@ -454,6 +454,15 @@ class StepProfiler:
             request_spans = tracing.spans()
         except Exception:
             pass
+        # comms plane: the per-record busbw sample ring rides the dump so
+        # the merged trace gets a per-rank bus-bandwidth counter track
+        comms_samples = []
+        try:
+            from horovod_tpu import comms
+
+            comms_samples = comms.tracker().samples()
+        except Exception:
+            pass
         return {
             "schema": SCHEMA,
             "rank": self.rank,
@@ -468,6 +477,7 @@ class StepProfiler:
             "trace_events": list(self._trace_events),
             "memory_samples": memory_samples,
             "request_spans": request_spans,
+            "comms_samples": comms_samples,
             "flight_events": flight_recorder.recorder().events()
             [-_FLIGHT_TRACE_EVENTS:],
         }
@@ -640,6 +650,25 @@ def _memory_trace_events(dump: dict) -> List[dict]:
     return out
 
 
+def _comms_trace_events(dump: dict) -> List[dict]:
+    """The comms tracker's busbw sample ring as a Chrome counter ("C")
+    track — per-lane bus bandwidth over time next to the rank's step
+    spans, so a bandwidth sag lines up visually with the step that paid
+    for it (docs/comms.md)."""
+    out = []
+    for row in dump.get("comms_samples", ()):
+        try:
+            t, busbw, lane = row[0], float(row[1]), str(row[2])
+        except (TypeError, ValueError, IndexError):
+            continue
+        if not isinstance(t, (int, float)):
+            continue
+        out.append({"ph": "C", "pid": 0, "tid": 0, "ts": t * 1e6,
+                    "name": "bus bandwidth (GB/s)",
+                    "args": {lane: round(busbw, 4)}})
+    return out
+
+
 def _device_trace_files(directory: str) -> List[str]:
     """jax.profiler output below the profile dir: TensorBoard's profile
     plugin writes ``*.trace.json.gz`` under a nested run directory."""
@@ -691,6 +720,7 @@ def merge_profile_dir(directory: str,
                   if isinstance(e, dict)]
         events += _flight_trace_events(d)
         events += _memory_trace_events(d)
+        events += _comms_trace_events(d)
         if events:
             lanes.append((f"rank {rank} steps", events, offset))
         spans = [s for s in d.get("request_spans", ())

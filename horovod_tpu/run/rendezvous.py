@@ -41,6 +41,7 @@ from urllib.error import HTTPError
 from urllib.parse import parse_qs, urlsplit
 from urllib.request import Request, urlopen
 
+from horovod_tpu import comms
 from horovod_tpu.utils import resilience
 from horovod_tpu.utils.env import _get_float
 
@@ -345,8 +346,14 @@ class KVStoreClient:
 
     def _open(self, url_or_req, timeout: float, phase: str) -> bytes:
         resilience.inject("kv", phase)
+        t0 = time.monotonic()
         with urlopen(url_or_req, timeout=timeout) as resp:
-            return resp.read()
+            body = resp.read()
+        # kv lane: control-plane round trips are tiny but their bandwidth
+        # collapse is the earliest symptom of a sick network — account the
+        # response payload over the request wall time
+        comms.record(phase, "kv", len(body), time.monotonic() - t0)
+        return body
 
     def set(self, key: str, value: bytes, scope: Optional[str] = None) -> None:
         req = Request(self._url(key, scope), data=value, method="PUT")

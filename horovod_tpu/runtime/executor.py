@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from horovod_tpu import comms
 from horovod_tpu import flight_recorder
 from horovod_tpu import timeline as timeline_mod
 from horovod_tpu import tracing
@@ -196,7 +197,7 @@ class _PendingOp:
 
     __slots__ = ("executor", "op", "entries", "timeline", "name0", "t0",
                  "finish", "done", "lease", "nbytes", "bucket",
-                 "t_disp_end", "t_drain_start", "t0_epoch")
+                 "t_disp_end", "t_drain_start", "t0_epoch", "lane")
 
     def __init__(self, executor: "Executor", op: str, entries, timeline):
         self.executor = executor
@@ -222,6 +223,11 @@ class _PendingOp:
         # hidden behind later dispatches (profiler.py's hidden fraction).
         self.t_disp_end: Optional[float] = None
         self.t_drain_start: Optional[float] = None
+        # transport lane for the comms plane ("device" / "host_ring" /
+        # "spmd"), set by the dispatch branch that moved the bytes; None
+        # for branches that delegate to eager collectives (those record
+        # through ops.collectives._op_event instead — no double count)
+        self.lane: Optional[str] = None
 
     def _close(self) -> None:
         self.done = True
@@ -236,6 +242,10 @@ class _PendingOp:
                        else t_end)
         hidden = max(0.0, min(drain_start, t_end) - min(disp_end, t_end))
         _comm_clock.record(total, max(0.0, total - hidden), self.nbytes)
+        if self.lane is not None:
+            # the comms plane's algbw clock: payload bytes over the
+            # token's dispatch→drain wall time (docs/comms.md)
+            comms.record(self.op, self.lane, self.nbytes, total)
         if tracing.enabled():
             # per-tensor submit→dispatch→overlap→drain lineage: the
             # training-plane analogue of the request spans, so an
@@ -550,35 +560,47 @@ class Executor:
                         # these callbacks now rather than when the token
                         # drains (under pipeline depth N the drain waits
                         # behind up to N-1 later device collectives)
+                        wide_bytes = sum(
+                            types.entry_nbytes(e) for e in wide)
+                        t_ring = time.perf_counter()
                         self._execute_allreduce_host(wide, timeline)
+                        comms.record("allreduce", "host_ring", wide_bytes,
+                                     time.perf_counter() - t_ring)
                         ok = types.Status.OK()
-                        _OP_BYTES.labels(op=pend.op).inc(
-                            sum(types.entry_nbytes(e) for e in wide))
+                        _OP_BYTES.labels(op=pend.op).inc(wide_bytes)
                         for e in wide:
                             e.complete(ok, e.output)
                         pend.entries = rest
+                        # the token's remaining bytes ride the SPMD lane
+                        pend.nbytes -= wide_bytes
                     if rest:
+                        pend.lane = "spmd"
                         pend.finish = self._dispatch_allreduce_spmd(
                             rest, timeline, pend)
                 elif self.net is not None:
+                    pend.lane = "host_ring"
                     self._execute_allreduce_host(entries, timeline)
                 else:
+                    pend.lane = "device"
                     pend.finish = self._dispatch_allreduce(
                         response, entries, timeline, pend)
             elif response.response_type == types.ALLGATHER:
                 if self.net is not None:
+                    pend.lane = "host_ring"
                     self._execute_allgather_host(response, entries)
                 else:
                     for e in entries:
                         e.output = collectives.allgather(e.tensor)
             elif response.response_type == types.BROADCAST:
                 if self.net is not None:
+                    pend.lane = "host_ring"
                     self._execute_broadcast_host(entries)
                 else:
                     for e in entries:
                         e.output = collectives.broadcast(e.tensor, e.root_rank)
             elif response.response_type == types.REDUCESCATTER:
                 if self.net is not None:
+                    pend.lane = "host_ring"
                     self._execute_reducescatter_host(entries)
                 else:
                     for e in entries:
@@ -586,6 +608,7 @@ class Executor:
                             e.tensor, op=collectives.OPS_BY_NAME[e.reduce_op])
             elif response.response_type == types.ALLTOALL:
                 if self.net is not None:
+                    pend.lane = "host_ring"
                     self._execute_alltoall_host(entries)
                 else:
                     for e in entries:
@@ -678,6 +701,8 @@ class Executor:
                 e.output = e.tensor
 
         if not stacked:
+            if pend is not None:
+                pend.lane = None  # nothing crossed a wire
             return None
         reduce_op = stacked[0].reduce_op
         name0 = stacked[0].name
@@ -766,6 +791,11 @@ class Executor:
         bucket-sized and sliced to the exact payload."""
         import numpy as np
 
+        # chaos seam on the DATA plane (the ctrl/kv seams cover only the
+        # control plane): HOROVOD_FAULT_INJECT=netdelay:... slows the
+        # ring pass itself, so the comms plane's host_ring busbw visibly
+        # degrades (docs/comms.md, docs/robustness.md)
+        resilience.inject("ring", "allreduce")
         world = self.net.world
         arrays = [np.asarray(e.tensor) for e in entries]
         # narrow types have no native host-ring kernels; widen for the wire
@@ -945,6 +975,7 @@ class Executor:
 
         import numpy as np
 
+        resilience.inject("ring", "allgather")
         for e in entries:
             local = np.asarray(e.tensor)
             nb = local.nbytes
@@ -979,6 +1010,7 @@ class Executor:
         coincide exactly with the leading-axis shards."""
         import numpy as np
 
+        resilience.inject("ring", "reducescatter")
         world = self.net.world
         from horovod_tpu.integrity import digest as integ_digest
 
@@ -1010,6 +1042,7 @@ class Executor:
         cost Wx; VERDICT r2 ask 6)."""
         import numpy as np
 
+        resilience.inject("ring", "alltoall")
         for e in entries:
             a = np.ascontiguousarray(np.asarray(e.tensor))
             e.output = self.net.alltoall(a)
@@ -1017,6 +1050,7 @@ class Executor:
     def _execute_broadcast_host(self, entries) -> None:
         import numpy as np
 
+        resilience.inject("ring", "broadcast")
         for e in entries:
             local = np.ascontiguousarray(np.asarray(e.tensor))
             blob = self.net.bcast_from(

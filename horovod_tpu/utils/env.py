@@ -127,6 +127,11 @@ ENV_DIRECT_KNOBS = (
     # memory telemetry plane (memory.py; docs/memory.md)
     "HOROVOD_MEMORY", "HOROVOD_MEMORY_SAMPLE_SECONDS",
     "HOROVOD_MEMORY_TOPK",
+    # collective transport observatory (comms.py; docs/comms.md) + the
+    # persisted probe roofline artifact (autotune/probe.py)
+    "HOROVOD_COMMS", "HOROVOD_COMMS_WINDOW",
+    "HOROVOD_COMMS_EWMA_ALPHA", "HOROVOD_COMMS_DEGRADED_FRACTION",
+    "HOROVOD_PROBE_CACHE",
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379

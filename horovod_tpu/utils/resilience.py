@@ -367,6 +367,13 @@ def inject(transport: str, phase: str = "") -> None:
         if f.kind == "netdelay" and targeted and in_window:
             _CHAOS_INJECTED.labels(kind="netdelay").inc()
             time.sleep(f.delay_ms / 1000.0)
+        elif transport == "ring":
+            # the data-plane seam (executor host-ring ops) carries delay
+            # faults only: flaky resets and partitions model CONTROL
+            # traffic loss, which the retry/elastic layers own — raising
+            # them mid-ring would fail collectives no real transport
+            # fault produces (the ring retries at the message layer)
+            continue
         elif f.kind == "flaky" and targeted and in_window:
             if ch.rng.random() < f.prob:
                 _CHAOS_INJECTED.labels(kind="flaky").inc()

@@ -1418,6 +1418,60 @@ def main():
         # the dump's state providers include the witness's view
         assert state["state"].get("locks", {}).get("enabled") is True
 
+    elif scenario == "comms_degraded":
+        # ISSUE 16 acceptance: a netdelay window on the host-ring data
+        # plane must trip the comms-plane degradation detector exactly
+        # once, naming the host_ring lane; the shutdown dump then
+        # carries the ledger for the postmortem comms report.
+        import time
+
+        from horovod_tpu import comms, flight_recorder
+
+        t = comms.tracker()
+        # chaos t0 armed at the first inject seam during init, so it is
+        # strictly before this scenario's entry stamp: the delay window
+        # (never-closing, seconds=inf) is guaranteed open by
+        # t_scn + after, and the fast phase below — seconds from t_scn —
+        # is guaranteed clean as long as after= grants real headroom
+        # over a loaded box's init tail
+        t_scn = time.monotonic()
+        # fast phase: enough host-ring ops to pass detector warmup and
+        # set the lane's peak-observed roofline, all before the fault's
+        # after= window opens
+        for step in range(12):
+            h = hvd.allreduce_async(
+                np.full((4096,), float(rank), np.float32), name="cd/fast")
+            hvd.synchronize(h)
+        led = t.ledger()["lanes"].get("host_ring")
+        assert led and led["ops_total"] >= 8, led
+        assert not led["alerting"], led
+        # wait out the fault-free window (anchored to the scenario
+        # stamp, an upper bound on chaos t0), then run a FIXED number of
+        # now-delayed ops — both ranks must issue the same collective
+        # sequence in lockstep (a break-on-alert loop lets the first
+        # alerting rank shut down while its peer still has an op in
+        # flight). The EWMA (alpha 0.25) falls to 0.75^k of the fast
+        # peak after k ~100x-slower records, crossing the 0.5 threshold
+        # by k=3; 10 ops is deep margin
+        wake = t_scn + float(os.environ.get("COMMS_DELAY_AFTER", "8.5"))
+        time.sleep(max(0.0, wake - time.monotonic()))
+        for step in range(10):
+            h = hvd.allreduce_async(
+                np.full((4096,), float(rank), np.float32), name="cd/slow")
+            hvd.synchronize(h)
+        evs = [e for e in flight_recorder.recorder().events()
+               if e.get("kind") == "comms_degraded"
+               and e.get("lane") == "host_ring"]
+        assert len(evs) == 1, evs  # latched: ONE event per crossing
+        assert evs[0]["op"] == "allreduce", evs
+        assert evs[0]["utilization"] < evs[0]["threshold"], evs
+        led = t.ledger()["lanes"]["host_ring"]
+        assert led["alerting"] and led["degraded_count"] == 1, led
+        assert led["last_degraded"]["op"] == "allreduce", led
+        # leave a dump for the launcher's postmortem comms-report check
+        hvd.dump_debug_state(reason="comms_degraded_test")
+        print("COMMS_DEGRADED_OK", flush=True)
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

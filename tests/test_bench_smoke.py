@@ -316,3 +316,45 @@ def test_memory_suite_tiny(bench, capsys):
     assert result["peak_hbm_bytes"] > 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["value"] == result["value"]
+
+
+def test_bench_compare_deflated_busbw_fails(bench_compare, tmp_path,
+                                            capsys):
+    """ISSUE 16 satellite: comms rows are higher-is-better sub-metrics.
+    Throughput flat but the candidate's bus bandwidth halved — the GB/s
+    row fails the gate on its own."""
+    base_row = dict(_BASE_ROW, busbw_gbs=40.0, comms_utilization=0.8)
+    base = _artifact(tmp_path / "base.json", [base_row])
+    cand_row = dict(base_row, busbw_gbs=20.0, comms_utilization=0.4)
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    assert bench_compare.main([base, cand]) == 1
+    out = capsys.readouterr().out
+    assert "busbw_gbs" in out
+    assert "comms_utilization" in out
+    assert "higher is better" in out
+
+
+def test_bench_compare_comms_rows_clean_pass(bench_compare, tmp_path,
+                                             capsys):
+    row = dict(_BASE_ROW, busbw_gbs=40.0, comms_utilization=0.8)
+    base = _artifact(tmp_path / "base.json", [row])
+    cand = _artifact(tmp_path / "cand.json", [dict(row)])
+    assert bench_compare.main([base, cand]) == 0
+    out = capsys.readouterr().out
+    assert "[busbw_gbs]" in out
+    assert "[comms_utilization]" in out
+
+
+def test_comms_suite_tiny(bench, capsys):
+    """ISSUE 16 satellite shape: ``bench.py --comms --tiny`` runs the
+    interleaved tracker-off/tracker-on A/B and reports the overhead
+    headline as one JSON line with zero steady-state compiles."""
+    result = bench.comms_main(tiny=True)
+    assert result["tiny"] is True
+    assert result["unit"] == "%"
+    assert result["goal"] == "< 1%"
+    assert result["p50_ms_comms_off"] > 0
+    assert result["p50_ms_comms_on"] > 0
+    assert result["steady_state_compiles"] == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["value"] == result["value"]

@@ -1,0 +1,515 @@
+"""Collective transport observatory (ISSUE 16): busbw math, rolling
+windows, the degradation detector's latch/re-arm contract, the surfaces
+(/comms route, merged-trace counter track, hvd_top panel, cross-rank
+postmortem report), and the persisted probe roofline artifact.
+
+Tier-1 safe: everything here drives the tracker and probe cache directly
+— no devices, no subprocesses (the 2-rank netdelay acceptance lives in
+test_multiprocess.py::test_comms_degradation_alert_under_netdelay).
+"""
+
+import json
+import os
+import sys
+import types
+import urllib.request
+
+import pytest
+
+from horovod_tpu import comms, flight_recorder
+
+
+@pytest.fixture
+def tracker():
+    """A fresh CommsTracker so tests never fight the process singleton."""
+    t = comms.CommsTracker()
+    t.world = 2
+    yield t
+
+
+@pytest.fixture
+def singleton():
+    """The process-wide tracker, state-restored after the test (the
+    /comms route and bench comms_rows read through the singleton)."""
+    t = comms.tracker()
+    with t._lock:
+        saved_roof = (dict(t._roofline), dict(t._roofline_source))
+        saved = (t.enabled, t.rank, t.world)
+    t.reset()
+    t.enabled = True
+    yield t
+    t.reset()
+    with t._lock:
+        t._roofline.clear()
+        t._roofline.update(saved_roof[0])
+        t._roofline_source.clear()
+        t._roofline_source.update(saved_roof[1])
+    t.enabled, t.rank, t.world = saved
+
+
+def _degraded_events(lane):
+    return [e for e in flight_recorder.recorder().events()
+            if e.get("kind") == "comms_degraded" and e.get("lane") == lane]
+
+
+def _recovered_events(lane):
+    return [e for e in flight_recorder.recorder().events()
+            if e.get("kind") == "comms_recovered" and e.get("lane") == lane]
+
+
+class TestBusFactor:
+    @pytest.mark.parametrize("op,world,factor", [
+        # the NCCL-tests convention
+        ("allreduce", 2, 1.0),
+        ("allreduce", 4, 1.5),
+        ("allreduce", 8, 2 * 7 / 8),
+        ("reducescatter", 4, 0.75),
+        ("allgather", 4, 0.75),
+        ("alltoall", 8, 7 / 8),
+        ("broadcast", 4, 1.0),
+        ("get", 4, 1.0),   # kv point-to-point
+        # world=1 degenerate: a one-rank collective moves nothing
+        # across any bus — factor 0 for EVERY op
+        ("allreduce", 1, 0.0),
+        ("allgather", 1, 0.0),
+        ("broadcast", 1, 0.0),
+        ("allreduce", 0, 0.0),
+    ])
+    def test_matrix(self, op, world, factor):
+        assert comms.bus_factor(op, world) == pytest.approx(factor)
+
+    def test_case_insensitive(self):
+        # executor types constants are upper-case strings
+        assert comms.bus_factor("ALLREDUCE", 4) == pytest.approx(1.5)
+
+    def test_size_bucket_is_pow2_ceiling(self):
+        assert comms.size_bucket(1) == 1
+        assert comms.size_bucket(4096) == 4096
+        assert comms.size_bucket(4097) == 8192
+        assert comms.size_bucket(3 << 20) == 4 << 20
+        assert comms.size_bucket(0) == 1  # degenerate, never crashes
+
+    def test_fmt_bucket(self):
+        assert comms._fmt_bucket(4 << 20) == "4MiB"
+        assert comms._fmt_bucket(512) == "512B"
+        assert comms._fmt_bucket(1 << 30) == "1GiB"
+
+
+class TestRecording:
+    def test_algbw_and_busbw_land_in_ledger(self, tracker):
+        # 1 GB in 0.1 s at world=2: algbw 10 GB/s, allreduce factor 1.0
+        tracker.record("allreduce", "device", 10 ** 9, 0.1, world=2)
+        led = tracker.ledger()
+        lane = led["lanes"]["device"]
+        assert lane["busbw_gbs"] == pytest.approx(10.0, rel=1e-3)
+        assert lane["bytes_total"] == 10 ** 9
+        assert lane["ops_total"] == 1
+        key = led["keys"][0]
+        assert key["op"] == "allreduce"
+        assert key["size_bucket"] == "1GiB"
+        assert key["algbw_gbs"] == pytest.approx(10.0, rel=1e-3)
+        assert key["busbw_gbs"] == pytest.approx(10.0, rel=1e-3)
+
+    def test_per_record_world_beats_tracker_world(self, tracker):
+        tracker.world = 1  # would zero busbw if used
+        tracker.record("allreduce", "zero", 10 ** 9, 0.1, world=4)
+        led = tracker.ledger()
+        assert led["lanes"]["zero"]["busbw_gbs"] == pytest.approx(
+            15.0, rel=1e-3)  # algbw 10 x 2(4-1)/4
+        assert led["keys"][0]["busbw_gbs"] == pytest.approx(15.0, rel=1e-3)
+
+    def test_world1_records_zero_busbw(self, tracker):
+        tracker.record("allreduce", "device", 10 ** 9, 0.1, world=1)
+        assert tracker.ledger()["lanes"]["device"]["busbw_gbs"] in (
+            None, 0.0)
+
+    def test_garbage_records_ignored(self, tracker):
+        tracker.record("allreduce", "device", 0, 0.1)
+        tracker.record("allreduce", "device", -5, 0.1)
+        tracker.record("allreduce", "device", 100, 0.0)
+        tracker.record("allreduce", "device", 100, -1.0)
+        assert tracker.ledger()["lanes"] == {}
+
+    def test_disabled_tracker_records_nothing(self, tracker):
+        tracker.enabled = False
+        tracker.record("allreduce", "device", 10 ** 9, 0.1)
+        assert tracker.ledger()["lanes"] == {}
+
+    def test_window_ring_is_bounded(self, tracker):
+        tracker.window = 4
+        for i in range(10):
+            tracker.record("allreduce", "host_ring", 1 << 20, 0.001)
+        with tracker._lock:
+            (win,) = tracker._windows.values()
+            assert len(win) == 4 and win.maxlen == 4
+        assert tracker.ledger()["keys"][0]["ops"] == 4
+        # totals keep the full history even as the window rolls
+        assert tracker.ledger()["lanes"]["host_ring"]["ops_total"] == 10
+
+    def test_sample_ring_is_bounded(self, tracker):
+        for i in range(comms._SAMPLE_RING + 50):
+            tracker.record("allreduce", "device", 1 << 20, 0.001)
+        samples = tracker.samples()
+        assert len(samples) == comms._SAMPLE_RING
+        wall, busbw, lane = samples[-1]
+        assert lane == "device" and busbw > 0
+
+
+class TestRoofline:
+    def test_probe_seed_beats_peak(self, tracker):
+        tracker.seed_roofline("device", 50.0, source="probe")
+        tracker.record("allreduce", "device", 10 ** 9, 0.1, world=2)
+        lane = tracker.ledger()["lanes"]["device"]
+        assert lane["roofline_gbs"] == pytest.approx(50.0)
+        assert lane["roofline_source"] == "probe"
+        assert lane["utilization"] == pytest.approx(0.2, rel=1e-3)
+
+    def test_unseeded_lane_self_calibrates_from_peak(self, tracker):
+        tracker.record("allreduce", "host_ring", 10 ** 9, 0.1, world=2)
+        lane = tracker.ledger()["lanes"]["host_ring"]
+        assert lane["roofline_source"] == "peak_observed"
+        assert lane["roofline_gbs"] == pytest.approx(
+            lane["peak_busbw_gbs"])
+        assert lane["utilization"] == pytest.approx(1.0)
+
+    def test_nonpositive_seed_ignored(self, tracker):
+        tracker.seed_roofline("device", 0.0)
+        tracker.seed_roofline("device", -3.0)
+        with tracker._lock:
+            assert "device" not in tracker._roofline
+
+
+class TestDegradationDetector:
+    def _fast(self, t, n=comms._WARMUP_OPS):
+        for _ in range(n):
+            t.record("allreduce", "host_ring", 10 ** 9, 0.1, world=2)
+
+    def _slow(self, t, n=12):
+        for _ in range(n):
+            t.record("allreduce", "host_ring", 10 ** 7, 0.1, world=2)
+
+    def test_alert_latches_once_and_rearms(self, tracker):
+        before = len(_degraded_events("host_ring"))
+        before_rec = len(_recovered_events("host_ring"))
+        self._fast(tracker)
+        assert not tracker.ledger()["lanes"]["host_ring"]["alerting"]
+        # collapse busbw 100x: EWMA crosses below 0.5 of the peak
+        self._slow(tracker)
+        led = tracker.ledger()["lanes"]["host_ring"]
+        assert led["alerting"] is True
+        assert led["degraded_count"] == 1
+        events = _degraded_events("host_ring")
+        assert len(events) - before == 1  # ONE event while latched
+        ev = events[-1]
+        assert ev["op"] == "allreduce"
+        assert ev["size_bucket"] == "16MiB"  # the bucket that slowed
+        assert ev["utilization"] < ev["threshold"]
+        assert ev["roofline_gbs"] > ev["busbw_gbs"]
+        # recovery re-arms and emits comms_recovered
+        self._fast(tracker, n=24)
+        led = tracker.ledger()["lanes"]["host_ring"]
+        assert led["alerting"] is False
+        assert len(_recovered_events("host_ring")) - before_rec == 1
+        # a SECOND sustained degradation fires a second event
+        self._slow(tracker, n=24)
+        assert len(_degraded_events("host_ring")) - before == 2
+        assert tracker.ledger()["lanes"]["host_ring"][
+            "degraded_count"] == 2
+
+    def test_no_alert_during_warmup(self, tracker):
+        tracker.seed_roofline("host_ring", 100.0)
+        before = len(_degraded_events("host_ring"))
+        # far below the roofline, but fewer records than _WARMUP_OPS
+        self._slow(tracker, n=comms._WARMUP_OPS - 1)
+        assert not tracker.ledger()["lanes"]["host_ring"]["alerting"]
+        assert len(_degraded_events("host_ring")) == before
+
+    def test_last_degraded_names_op_and_bucket(self, tracker):
+        self._fast(tracker)
+        self._slow(tracker)
+        last = tracker.ledger()["lanes"]["host_ring"]["last_degraded"]
+        assert last["op"] == "allreduce"
+        assert last["size_bucket"] == "16MiB"
+        assert last["utilization"] < 0.5
+
+
+def _comms_state(rank, lanes):
+    return {"rank": rank, "world": 2, "wall_time": 0.0,
+            "degraded_fraction": 0.5, "lanes": lanes, "keys": []}
+
+
+def _lane(busbw, roofline=None, alerting=False, last=None):
+    util = (busbw / roofline) if roofline else None
+    return {"busbw_gbs": busbw, "peak_busbw_gbs": busbw,
+            "roofline_gbs": roofline,
+            "roofline_source": "probe" if roofline else "none",
+            "utilization": util, "bytes_total": 1 << 30, "ops_total": 10,
+            "alerting": alerting, "degraded_count": int(alerting),
+            "last_degraded": last}
+
+
+def _dump(rank, comms_state):
+    return {"schema": flight_recorder.SCHEMA, "rank": rank,
+            "launch_rank": rank, "pid": 1000 + rank,
+            "host": "host%d" % rank, "reason": "test", "wall_time": 0.0,
+            "clock_offset_seconds": 0.0, "dump_history": [], "events": [],
+            "state": {"comms": comms_state}, "metrics": {}}
+
+
+class TestPostmortemReport:
+    def test_cross_rank_report_names_slowest_lane_and_rank(self):
+        dumps = [
+            _dump(0, _comms_state(0, {
+                "device": _lane(40.0, 50.0),
+                "host_ring": _lane(2.0, 4.0)})),
+            _dump(1, _comms_state(1, {
+                "device": _lane(45.0, 50.0),
+                "host_ring": _lane(
+                    0.8, 4.0, alerting=True,
+                    last={"wall_time": 0.0, "op": "allreduce",
+                          "size_bucket": "16MiB", "busbw_gbs": 0.8,
+                          "roofline_gbs": 4.0, "utilization": 0.2})})),
+        ]
+        text = comms.format_comms_report(dumps)
+        assert "=== comms report (2 ranks) ===" in text
+        assert "slowest lane: host_ring" in text
+        assert "furthest below roofline: rank 1 host_ring" in text
+        assert "DEGRADED" in text
+        assert "degraded host_ring allreduce 16MiB" in text
+
+    def test_report_empty_without_comms_state(self):
+        dumps = [_dump(0, None)]
+        dumps[0]["state"] = {}
+        assert comms.format_comms_report(dumps) == ""
+
+    def test_format_postmortem_embeds_comms_section(self):
+        dumps = [_dump(0, _comms_state(0, {"device": _lane(40.0, 50.0)}))]
+        text = flight_recorder.format_postmortem(dumps)
+        assert "=== comms report" in text
+        assert "device 40.00 GB/s/50.00 (80%)" in text
+
+
+class TestConfigure:
+    def test_knobs_and_provider_registration(self, singleton, monkeypatch):
+        monkeypatch.setenv("HOROVOD_COMMS", "1")
+        monkeypatch.setenv("HOROVOD_COMMS_WINDOW", "7")
+        monkeypatch.setenv("HOROVOD_COMMS_EWMA_ALPHA", "0.5")
+        monkeypatch.setenv("HOROVOD_COMMS_DEGRADED_FRACTION", "0.25")
+        comms.configure(rank=3, world=4)
+        assert singleton.enabled is True
+        assert singleton.rank == 3 and singleton.world == 4
+        assert singleton.window == 7
+        assert singleton.ewma_alpha == 0.5
+        assert singleton.degraded_fraction == 0.25
+        assert "comms" in flight_recorder._recorder._providers
+        monkeypatch.setenv("HOROVOD_COMMS", "0")
+        comms.configure()
+        assert singleton.enabled is False
+        assert "comms" not in flight_recorder._recorder._providers
+
+    def test_configure_seeds_rooflines_from_probe_cache(
+            self, singleton, monkeypatch, tmp_path):
+        path = tmp_path / "roofline.json"
+        path.write_text(json.dumps({
+            "schema": 1, "hbm_gbps": 100.0, "allreduce_gbps": 30.0,
+            "allreduce_busbw_gbps": 45.0, "world": 4,
+            "fusion_threshold_bytes": 1 << 20, "wall_time": 0.0}))
+        monkeypatch.setenv("HOROVOD_PROBE_CACHE", str(path))
+        monkeypatch.setenv("HOROVOD_COMMS", "1")
+        comms.configure(rank=0, world=4)
+        with singleton._lock:
+            assert singleton._roofline["device"] == pytest.approx(45.0)
+            assert singleton._roofline["spmd"] == pytest.approx(45.0)
+            assert singleton._roofline_source["device"] == "probe_cache"
+        # host ring stays self-calibrating
+        with singleton._lock:
+            assert "host_ring" not in singleton._roofline
+
+    def test_comms_state_document(self, singleton):
+        singleton.record("allreduce", "device", 1 << 20, 0.001, world=2)
+        state = comms.comms_state()
+        assert state["enabled"] is True
+        assert "device" in state["lanes"]
+        assert isinstance(state["samples"], list) and state["samples"]
+
+
+class TestMetricsRoute:
+    def test_get_comms_route(self, singleton):
+        """The metrics server serves the ledger at GET /comms."""
+        from horovod_tpu.metrics import MetricsRegistry
+
+        singleton.record("allreduce", "device", 1 << 20, 0.001, world=2)
+        reg = MetricsRegistry()
+        port = reg.serve(0)
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/comms" % port, timeout=5) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["lanes"]["device"]["busbw_gbs"] > 0
+            assert "keys" in doc and "samples" in doc
+        finally:
+            reg.stop_server()
+
+
+class TestHvdTop:
+    def _import_hvd_top(self):
+        repo_tools = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools")
+        if repo_tools not in sys.path:
+            sys.path.insert(0, repo_tools)
+        import hvd_top
+        return hvd_top
+
+    def test_comms_panel_against_live_endpoint(self, singleton):
+        from horovod_tpu.metrics import MetricsRegistry
+
+        hvd_top = self._import_hvd_top()
+        singleton.seed_roofline("device", 20.0)
+        singleton.record("allreduce", "device", 10 ** 9, 0.1, world=2)
+        reg = MetricsRegistry()
+        port = reg.serve(0)
+        try:
+            panel = hvd_top.render_comms(["127.0.0.1:%d" % port])
+            assert "device" in panel.splitlines()[0]
+            assert "10.00/20.00 (50%)" in panel
+        finally:
+            reg.stop_server()
+
+    def test_comms_panel_empty_without_endpoint(self):
+        hvd_top = self._import_hvd_top()
+        assert hvd_top.render_comms(["127.0.0.1:1"]) == ""
+
+
+class TestMergedTraceCounterTrack:
+    def test_bus_bandwidth_counter_track(self, tmp_path):
+        from horovod_tpu import profiler
+
+        t0 = 1700000000.0
+        dump = {"schema": "horovod-profiler-v1", "rank": 0,
+                "launch_rank": 0, "clock_offset_seconds": 0.0,
+                "steps": [], "trace_events": [
+                    {"ph": "X", "pid": 0, "tid": 0, "ts": t0 * 1e6,
+                     "dur": 1e4, "name": "step 0"}],
+                "flight_events": [],
+                "comms_samples": [[t0, 12.5, "device"],
+                                  [t0 + 0.1, 3.25, "host_ring"],
+                                  ["bogus", None, 3]]}
+        with open(tmp_path / "profile-rank-0.json", "w") as f:
+            json.dump(dump, f)
+        out, n = profiler.merge_profile_dir(str(tmp_path))
+        events = json.load(open(out))["traceEvents"]
+        counters = [e for e in events
+                    if e.get("name") == "bus bandwidth (GB/s)"]
+        assert len(counters) == 2  # the malformed row was skipped
+        assert all(e["ph"] == "C" for e in counters)
+        assert counters[0]["args"] == {"device": 12.5}
+        assert counters[1]["args"] == {"host_ring": 3.25}
+
+    def test_profiler_snapshot_carries_comms_samples(self, singleton):
+        from horovod_tpu import profiler
+
+        singleton.record("allreduce", "device", 1 << 20, 0.001, world=2)
+        snap = profiler._profiler.snapshot()
+        assert snap["comms_samples"]
+        assert snap["comms_samples"][-1][2] == "device"
+
+
+class TestProbeCache:
+    def _artifact(self, world=4):
+        return {"schema": 1, "hbm_gbps": 123.0, "allreduce_gbps": 30.0,
+                "allreduce_busbw_gbps": 45.0, "world": world,
+                "fusion_threshold_bytes": 1 << 20, "wall_time": 1.0}
+
+    def test_roundtrip(self, tmp_path):
+        from horovod_tpu.autotune import probe
+
+        path = str(tmp_path / "sub" / "roofline.json")
+        probe._persist_roofline(path, self._artifact())
+        doc = probe.load_cached_roofline(path=path, world=4)
+        assert doc["allreduce_busbw_gbps"] == 45.0
+        # no stray tmp file survived the rename
+        assert os.listdir(tmp_path / "sub") == ["roofline.json"]
+
+    def test_world_mismatch_invalidates(self, tmp_path):
+        from horovod_tpu.autotune import probe
+
+        path = str(tmp_path / "roofline.json")
+        probe._persist_roofline(path, self._artifact(world=4))
+        assert probe.load_cached_roofline(path=path, world=8) is None
+        assert probe.load_cached_roofline(path=path, world=4) is not None
+        assert probe.load_cached_roofline(path=path) is not None  # unchecked
+
+    def test_corrupt_schema_and_missing_are_none(self, tmp_path):
+        from horovod_tpu.autotune import probe
+
+        assert probe.load_cached_roofline(
+            path=str(tmp_path / "nope.json")) is None
+        bad = tmp_path / "bad.json"
+        bad.write_text("{ torn")
+        assert probe.load_cached_roofline(path=str(bad)) is None
+        bad.write_text(json.dumps({"schema": 99, "world": 4}))
+        assert probe.load_cached_roofline(path=str(bad)) is None
+        assert probe.load_cached_roofline(path=None) is None  # knob unset
+
+    def test_probe_and_seed_reuses_cache(self, tmp_path, monkeypatch,
+                                         singleton):
+        """Second init with HOROVOD_PROBE_CACHE set must reload the
+        artifact instead of re-probing (ISSUE 16 satellite)."""
+        from horovod_tpu.autotune import probe
+
+        calls = {"hbm": 0, "ar": 0}
+
+        def fake_hbm(*a, **k):
+            calls["hbm"] += 1
+            return 100.0
+
+        def fake_ar(mesh=None, **k):
+            calls["ar"] += 1
+            return {"algbw_gbps": 30.0, "busbw_gbps": 45.0, "world": 4}
+
+        monkeypatch.setattr(probe, "probe_hbm_bandwidth", fake_hbm)
+        monkeypatch.setattr(probe, "probe_allreduce_bandwidth", fake_ar)
+        path = str(tmp_path / "roofline.json")
+        monkeypatch.setenv("HOROVOD_PROBE_CACHE", path)
+        mesh = types.SimpleNamespace(size=4)
+        config = types.SimpleNamespace(cycle_time_ms=5.0,
+                                       fusion_threshold_bytes=0)
+
+        first = probe.probe_and_seed(config, mesh=mesh)
+        assert first["cached"] is False
+        assert calls == {"hbm": 1, "ar": 1}
+        assert config.fusion_threshold_bytes > 0
+        assert os.path.exists(path)
+
+        config2 = types.SimpleNamespace(cycle_time_ms=5.0,
+                                        fusion_threshold_bytes=0)
+        second = probe.probe_and_seed(config2, mesh=mesh)
+        assert second["cached"] is True
+        assert calls == {"hbm": 1, "ar": 1}  # probes NOT re-run
+        assert second["allreduce_busbw_gbps"] == 45.0
+        assert (config2.fusion_threshold_bytes
+                == config.fusion_threshold_bytes)
+        # the measurement seeded the XLA-lane rooflines
+        with singleton._lock:
+            assert singleton._roofline["device"] == pytest.approx(45.0)
+
+    def test_probe_and_seed_float_monkeypatch_compat(self, monkeypatch,
+                                                     singleton):
+        """Legacy tests monkeypatch probe_allreduce_bandwidth with a
+        float-returning lambda; probe_and_seed must keep working."""
+        from horovod_tpu.autotune import probe
+
+        monkeypatch.setattr(probe, "probe_hbm_bandwidth",
+                            lambda *a, **k: 100.0)
+        monkeypatch.setattr(probe, "probe_allreduce_bandwidth",
+                            lambda mesh=None, **k: 10.0)
+        monkeypatch.delenv("HOROVOD_PROBE_CACHE", raising=False)
+        mesh = types.SimpleNamespace(size=2)
+        config = types.SimpleNamespace(cycle_time_ms=5.0,
+                                       fusion_threshold_bytes=0)
+        out = probe.probe_and_seed(config, mesh=mesh)
+        assert out["allreduce_gbps"] == 10.0
+        # factor 2(N-1)/N at N=2 is 1.0
+        assert out["allreduce_busbw_gbps"] == pytest.approx(10.0)
+        assert out["cached"] is False

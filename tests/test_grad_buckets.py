@@ -254,9 +254,9 @@ class TestFailureCleanup:
 
         # poison the FIRST released handle; gather must still drain the
         # rest, reset, and re-raise
-        bucket_idx, pairs = plan._released[0]
+        bucket_idx, pairs, t_release, wire_bytes = plan._released[0]
         plan._released[0] = (bucket_idx, [(pairs[0][0], _Boom())]
-                             + pairs[1:])
+                             + pairs[1:], t_release, wire_bytes)
         with pytest.raises(hvd.WorkersDownError):
             plan.gather(g)
         assert plan._released == [] and plan._grads == {}

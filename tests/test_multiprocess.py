@@ -288,3 +288,41 @@ def test_unnamed_eager_collectives_communicate(world):
     procs, outs = _launch("unnamed_eager", world)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
+
+
+@pytest.mark.slow
+def test_comms_degradation_alert_under_netdelay(tmp_path, capsys):
+    """ISSUE 16 acceptance: a 150 ms netdelay window opening 3 s in must
+    trip exactly one ``comms_degraded`` flight event per rank naming the
+    host_ring lane (asserted in-worker, tests/mp_worker.py scenario
+    comms_degraded), and the merged ``tpurun --postmortem`` over the
+    shutdown dumps must render the cross-rank comms report."""
+    flight_dir = tmp_path / "flight"
+    # after=8 grants the workers' fast phase real headroom over a loaded
+    # box's init tail; the worker anchors its own wake-up to its
+    # scenario-entry stamp (an upper bound on chaos t0), so the window
+    # is guaranteed open when the slow phase starts
+    procs, outs = _launch(
+        "comms_degraded", 2, timeout=180, extra_env={
+            "HOROVOD_FAULT_INJECT": "netdelay:150:after=8",
+            "COMMS_DELAY_AFTER": "8.5",
+            "HOROVOD_FLIGHT_RECORDER_DIR": str(flight_dir),
+        })
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "COMMS_DEGRADED_OK" in out
+        assert "OK rank=" in out
+
+    from horovod_tpu import flight_recorder
+    dumps = flight_recorder.load_dumps(str(flight_dir))
+    assert len(dumps) == 2
+    for d in dumps:
+        lanes = d["state"]["comms"]["lanes"]
+        assert lanes["host_ring"]["degraded_count"] == 1, lanes
+
+    from horovod_tpu.run.run import run_commandline
+    assert run_commandline(["--postmortem", str(flight_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "=== comms report (2 ranks) ===" in out
+    assert "degraded host_ring allreduce" in out
+    assert "slowest lane: host_ring" in out

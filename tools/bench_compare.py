@@ -19,8 +19,11 @@ Direction comes from the unit: rates (``*/sec*``), ``mfu`` and
 are lower-is-better. Rows marked ``"tiny": true`` (smoke-test mode —
 bench.py's own docs call the numbers meaningless) are ignored. The
 embedded per-headline MFU, step-phase seconds (``step_breakdown``,
-PR 6), and serving tail latencies (p50/p99 request latency and TTFT,
-``ms`` so lower-is-better) are compared as derived sub-metrics; phases
+PR 6), serving tail latencies (p50/p99 request latency and TTFT,
+``ms`` so lower-is-better), and comms bandwidth rows (``busbw_gbs`` /
+``comms_utilization``, rates so higher-is-better — a deflated bus
+bandwidth gates like a throughput regression) are compared as derived
+sub-metrics; phases
 under 1 ms are skipped (pure jitter at that scale). Exit status: 0 clean, 1 regression(s),
 2 usage/parse error.
 """
@@ -129,6 +132,15 @@ def derived_rows(rows: Dict[str, dict]) -> Dict[str, Tuple[float, str]]:
                     "p50_ttft_ms", "p99_ttft_ms"):
             if isinstance(obj.get(key), (int, float)):
                 flat[f"{metric} [{key}]"] = (float(obj[key]), "ms")
+        # comms plane (bench.py comms_rows, docs/comms.md): bus bandwidth
+        # and roofline utilization are rates — higher-is-better by
+        # default, so a deflated busbw gates like a throughput regression
+        if isinstance(obj.get("busbw_gbs"), (int, float)):
+            flat[f"{metric} [busbw_gbs]"] = (
+                float(obj["busbw_gbs"]), "GB/s")
+        if isinstance(obj.get("comms_utilization"), (int, float)):
+            flat[f"{metric} [comms_utilization]"] = (
+                float(obj["comms_utilization"]), "fraction")
     return flat
 
 

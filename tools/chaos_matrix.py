@@ -13,7 +13,9 @@ Scenarios (docs/robustness.md has the failure-model table):
 * ``flaky_negotiate``   — ``flaky:0.3`` during negotiate: training
   completes with zero lost steps and nonzero retries.
 * ``netdelay_negotiate``— fixed per-op latency: completes, injections
-  counted.
+  counted, and every rank's shutdown dump embeds the comms-plane ledger
+  (the ``comms`` state provider, docs/comms.md) with recorded host-ring
+  traffic, rendered by the merged postmortem's comms report.
 * ``kv_outage_reform``  — rank 1 killed at step 3 while the rendezvous
   store answers 503 for 5s starting at the first re-form registration:
   survivors bridge the outage and finish.
@@ -111,6 +113,7 @@ SCENARIOS = {
             "HOROVOD_ELASTIC_MIN_WORKERS": "2",
         },
         "require_injections": True,
+        "require_comms_state": True,
         "timeout": 180,
     },
     "kv_outage_reform": {
@@ -405,6 +408,23 @@ def run_scenario(name, spec):
             _verify_ckpt_midcommit(ckpt_dir, total, failures)
         elif ckpt_dir and spec.get("ckpt_verify") == "manifest":
             _verify_ckpt_manifest(ckpt_dir, total, failures)
+
+        if spec.get("require_comms_state"):
+            dumps = _collect_dumps(flight_dir, server)
+            ledgers = [(d.get("state") or {}).get("comms") for d in dumps]
+            ledgers = [c for c in ledgers if isinstance(c, dict)]
+            if len(ledgers) < world:
+                failures.append(
+                    f"only {len(ledgers)}/{world} dumps embedded the "
+                    "comms state provider")
+            elif not any(
+                    ((c.get("lanes") or {}).get("host_ring") or {})
+                    .get("bytes_total") for c in ledgers):
+                failures.append(
+                    "comms ledgers recorded no host_ring traffic")
+            elif "=== comms report" not in                     flight_recorder.format_postmortem(dumps):
+                failures.append(
+                    "postmortem lacks the comms report section")
 
         postmortem = ""
         culprit = spec.get("require_culprit")

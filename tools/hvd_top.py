@@ -3,8 +3,9 @@
 
 Polls each rank's metrics endpoint (``GET /memory`` for the per-subsystem
 ledger + device truth, ``GET /metrics`` for a couple of headline rates,
-and — when the serving plane is live — ``GET /slo`` + ``GET /serve`` for
-the SLO panel) and renders one table per refresh — plain ANSI-free text,
+``GET /comms`` for the per-lane bus-bandwidth panel, and — when the
+serving plane is live — ``GET /slo`` + ``GET /serve`` for the SLO
+panel) and renders one table per refresh — plain ANSI-free text,
 so it works in a dumb terminal, under ``watch``, or piped to a log.
 
     python tools/hvd_top.py host1:9100 host2:9100
@@ -183,6 +184,59 @@ def render_slo(endpoints: List[str]) -> str:
     return "\n".join(out)
 
 
+def render_comms(endpoints: List[str]) -> str:
+    """Comms panel: per-lane bus bandwidth vs roofline utilization per
+    rank (``GET /comms``, docs/comms.md). Each cell is
+    ``busbw/roofline (util%)`` with a trailing ``!`` while the lane's
+    degradation alert is latched. Returns "" when no endpoint exposes
+    the comms plane (pre-comms build or HOROVOD_COMMS=0)."""
+    lane_names: List[str] = []
+    per_ep: List[tuple] = []
+    any_comms = False
+    for ep in endpoints:
+        comms = fetch_json(ep, "/comms")
+        if comms is None or "lanes" not in comms:
+            continue
+        any_comms = True
+        lanes: Dict[str, dict] = comms.get("lanes", {})
+        per_ep.append((ep, comms))
+        for name in lanes:
+            if name not in lane_names:
+                lane_names.append(name)
+    if not any_comms:
+        return ""
+    lane_names.sort()
+    header = ["rank", "endpoint"] + lane_names + ["degraded"]
+    rows: List[List[str]] = []
+    for ep, comms in per_ep:
+        lanes = comms.get("lanes", {})
+        cells = []
+        for name in lane_names:
+            rec = lanes.get(name)
+            if not isinstance(rec, dict) or rec.get("busbw_gbs") is None:
+                cells.append("-")
+                continue
+            util = rec.get("utilization")
+            cell = "%.2f" % rec["busbw_gbs"]
+            if isinstance(util, (int, float)):
+                cell += "/%.2f (%.0f%%)" % (
+                    rec.get("roofline_gbs") or 0.0, 100.0 * util)
+            if rec.get("alerting"):
+                cell += "!"
+            cells.append(cell)
+        degraded = sum(int(rec.get("degraded_count", 0))
+                       for rec in lanes.values() if isinstance(rec, dict))
+        rows.append([str(comms.get("rank", "?")), ep] + cells
+                    + [str(degraded)])
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows), 1)
+              if rows else len(header[i]) for i in range(len(header))]
+    out = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        out.append("  ".join(r[i].ljust(widths[i])
+                             for i in range(len(header))))
+    return "\n".join(out)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="live per-rank memory ledger (polls /memory)")
@@ -199,6 +253,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("hvd_top  %s  (%d endpoint%s)" % (
             stamp, len(endpoints), "" if len(endpoints) == 1 else "s"))
         print(render(endpoints))
+        comms_panel = render_comms(endpoints)
+        if comms_panel:
+            print()
+            print(comms_panel)
         slo_panel = render_slo(endpoints)
         if slo_panel:
             print()
