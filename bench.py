@@ -1399,7 +1399,7 @@ def checkpoint_main(tiny: bool = False):
     return result
 
 
-def serve_main(tiny: bool = False):
+def serve_main(tiny: bool = False, prefix_heavy: bool = False):
     """``--serve``: load-generate Poisson traffic against an in-process
     continuous-batching replica set (serve/; docs/inference.md) and
     report the serving headline — p50/p99 request latency, tokens/s/chip
@@ -1407,6 +1407,15 @@ def serve_main(tiny: bool = False):
     after one warmup prefill per prompt-length bucket per replica, the
     measured window must compile NOTHING (the fixed-shape decode program
     and the bucketed prefill programs are already hot).
+
+    ``--prefix-heavy`` switches the traffic to the shared-system-prompt
+    shape (every request opens with the same long prefix, RAG/chat
+    style) and runs it twice on one paged replica set — unshared
+    baseline first, shared second — so the headline carries the prefix-
+    cache effect as a pair: ``p50_ttft_ms`` vs ``p50_ttft_ms_no_share``
+    and the token-weighted ``prefix_hit_rate``. Forces
+    ``HOROVOD_SERVE_PAGED`` semantics (serve/paging.py); the remaining
+    paging knobs still come from the environment.
 
     ``--tiny`` shrinks to a toy model + 16 requests for the tier-1 smoke
     (tests/test_bench_smoke.py); numbers are then meaningless."""
@@ -1422,6 +1431,7 @@ def serve_main(tiny: bool = False):
         replicas, slots, n_requests = 2, 4, 16
         rate_rps, max_new = 400.0, 8
         prompt_choices = (4, 9, 17, 33)
+        prefix_len, tail_len = 48, 5
     else:
         # "GPT-small" replica set: the GPT-2 shape at a serving-friendly
         # context length
@@ -1429,25 +1439,39 @@ def serve_main(tiny: bool = False):
         replicas, slots, n_requests = 2, 8, 200
         rate_rps, max_new = 40.0, 32
         prompt_choices = (24, 56, 100, 180, 250)
+        prefix_len, tail_len = 192, 12
 
     log(f"serve: initializing {replicas} replica(s) "
-        f"(slots={slots}, max_new={max_new})")
+        f"(slots={slots}, max_new={max_new}"
+        f"{', prefix-heavy' if prefix_heavy else ''})")
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32),
                         train=False)["params"]
-    handle = hvd_serve(model, params, replicas=replicas, slots=slots,
-                       max_new_tokens=max_new, admission_ms=25.0,
-                       decode_block=4, max_batch_tokens=4096)
+    overrides = dict(slots=slots, max_new_tokens=max_new,
+                     admission_ms=25.0, decode_block=4,
+                     max_batch_tokens=4096)
+    if prefix_heavy:
+        overrides["paged"] = True   # prefix reuse needs the paged cache
+    handle = hvd_serve(model, params, replicas=replicas, **overrides)
     try:
         # warmup: hit every prompt-length bucket on EVERY replica's own
         # program cache (replicas compile independently), plus one
         # decode step each — all while the queue is idle, so the replica
-        # threads never race these direct engine calls
+        # threads never race these direct engine calls. Warmup prompts
+        # are DISTINCT per bucket ([b]*b): under HOROVOD_SERVE_PAGED a
+        # repeated prompt would prefix-hit the previous bucket's pages,
+        # shrink the computed suffix, and leave the larger prefill
+        # program cold — a steady-state compile later.
+        warm_lens = list(prompt_choices)
+        if prefix_heavy:
+            # the shared-prefix phase prefills the full prompt once,
+            # then only the post-hit tail — warm both bucket shapes
+            warm_lens += [tail_len, prefix_len + tail_len]
         buckets = sorted({prompt_bucket(p, model.max_seq)
-                          for p in prompt_choices})
+                          for p in warm_lens})
         for replica in handle._replicas:
             for b in buckets:
-                replica.engine.prefill(0, [1] * b)
+                replica.engine.prefill(0, [b % model.vocab_size] * b)
             replica.engine.decode([0], [1], [0])
         warm_compiles = handle.compiles_total()
         warm_steps = sum(r.engine.decode_steps for r in handle._replicas)
@@ -1455,16 +1479,47 @@ def serve_main(tiny: bool = False):
             f"{len(buckets)} buckets x {replicas} replicas)")
 
         rng = np.random.RandomState(0)
-        uids = []
+
+        def run_phase(prompts):
+            """Poisson-offered load; returns (completions, elapsed_s)."""
+            uids = []
+            t_phase = time.perf_counter()
+            for prompt in prompts:
+                time.sleep(rng.exponential(1.0 / rate_rps))
+                uids.append(handle.submit(prompt))
+            phase_outs = [handle.result(u, timeout=300.0) for u in uids]
+            return phase_outs, time.perf_counter() - t_phase
+
+        def random_prompt(length):
+            return rng.randint(1, model.vocab_size, length).tolist()
+
+        ttft_no_share_ms = None
         t0 = time.perf_counter()
-        for _ in range(n_requests):
-            time.sleep(rng.exponential(1.0 / rate_rps))
-            prompt_len = int(rng.choice(prompt_choices))
-            prompt = rng.randint(1, model.vocab_size,
-                                 prompt_len).tolist()
-            uids.append(handle.submit(prompt))
-        outs = [handle.result(u, timeout=300.0) for u in uids]
-        elapsed = time.perf_counter() - t0
+        if prefix_heavy:
+            # phase A — unshared baseline: same lengths, same load, no
+            # common prefix, so every prefill computes the full prompt
+            base_outs, _ = run_phase(
+                [random_prompt(prefix_len + tail_len)
+                 for _ in range(n_requests)])
+            ttft_no_share_ms = sorted(o.ttft_s * 1000.0
+                                      for o in base_outs)
+            # phase B — shared system prompt + short unique tails; every
+            # 4th request repeats a tail so the exact-replay path (a
+            # whole-prompt hit: zero prefill compute) is exercised too
+            shared = random_prompt(prefix_len)
+            tails = [random_prompt(tail_len) for _ in range(n_requests)]
+            for i in range(3, n_requests, 4):
+                tails[i] = tails[i - 2]
+            reused0 = sum(r.engine.reused_tokens
+                          for r in handle._replicas)
+            computed0 = sum(r.engine.computed_tokens
+                            for r in handle._replicas)
+            t0 = time.perf_counter()
+            outs, elapsed = run_phase([shared + t for t in tails])
+        else:
+            outs, elapsed = run_phase(
+                [random_prompt(int(rng.choice(prompt_choices)))
+                 for _ in range(n_requests)])
 
         latencies_ms = sorted(o.latency_s * 1000.0 for o in outs)
         ttft_ms = sorted(o.ttft_s * 1000.0 for o in outs)
@@ -1543,6 +1598,7 @@ def serve_main(tiny: bool = False):
             "kv_utilization": round(
                 sum(r.stats()["kv_utilization"]
                     for r in handle._replicas) / max(replicas, 1), 3),
+            "paged": bool(handle.policy.paged),
             # SLO plane (tracing.py; docs/tracing.md): per-objective
             # burn rate + remaining error budget over the run, and the
             # decode-path cost of having the plane on at all
@@ -1559,6 +1615,36 @@ def serve_main(tiny: bool = False):
             **memory_rows(params),
             **comms_rows(),
         }
+        if handle.policy.paged:
+            # paged-cache headline (serve/paging.py): pool occupancy per
+            # decode step, token-weighted prefix reuse, and the
+            # admission pressure valves actually firing
+            stats = [r.stats() for r in handle._replicas]
+            result["page_utilization"] = round(
+                sum(s["page_utilization"] for s in stats)
+                / max(replicas, 1), 3)
+            result["prefix_hit_rate"] = round(
+                sum(s["prefix_hit_rate"] for s in stats)
+                / max(replicas, 1), 3)
+            result["preemptions"] = sum(s["preemptions"] for s in stats)
+            result["cow_copies"] = sum(s["pages"]["cow_copies"]
+                                       for s in stats)
+        if prefix_heavy:
+            result["prefix_heavy"] = True
+            result["p50_ttft_ms_no_share"] = round(
+                float(np.percentile(ttft_no_share_ms, 50)), 3)
+            # hit rate over the SHARED phase only — the baseline phase
+            # computes everything and would dilute the headline
+            reused = (sum(r.engine.reused_tokens
+                          for r in handle._replicas) - reused0)
+            computed = (sum(r.engine.computed_tokens
+                            for r in handle._replicas) - computed0)
+            result["prefix_hit_rate"] = round(
+                reused / max(reused + computed, 1), 3)
+            log(f"serve: prefix-heavy p50 ttft shared "
+                f"{result['p50_ttft_ms']} ms vs unshared "
+                f"{result['p50_ttft_ms_no_share']} ms, hit rate "
+                f"{result['prefix_hit_rate']}")
     finally:
         handle.close()
     print(json.dumps(result), flush=True)
@@ -1672,6 +1758,11 @@ if __name__ == "__main__":
                              "tokens/s/chip, batch occupancy and the "
                              "zero-steady-state-compiles canary (one "
                              "JSON line)")
+    parser.add_argument("--prefix-heavy", action="store_true",
+                        help="with --serve: shared-system-prompt traffic "
+                             "on a paged replica set, run unshared then "
+                             "shared — headline adds prefix_hit_rate and "
+                             "p50_ttft_ms_no_share (serve/paging.py)")
     parser.add_argument("--memory", action="store_true",
                         help="microbench the memory telemetry plane: "
                              "tracker push + reconciliation sampler "
@@ -1699,7 +1790,7 @@ if __name__ == "__main__":
                              "(default: BENCH_TIME_BUDGET env, 660)")
     cli = parser.parse_args()
     if cli.serve:
-        serve_main(tiny=cli.tiny)
+        serve_main(tiny=cli.tiny, prefix_heavy=cli.prefix_heavy)
     elif cli.memory:
         memory_main(tiny=cli.tiny)
     elif cli.comms:

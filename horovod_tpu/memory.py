@@ -15,6 +15,9 @@ live-bytes and high-watermarks per byte-holding subsystem:
   a leaked lease is visible;
 * ``serve_kv`` — pulled from the live :class:`~horovod_tpu.serve.
   kv_cache.DecodeEngine` registry;
+* ``kv_pages`` — pulled from the paged KV-cache pool registry
+  (:func:`horovod_tpu.serve.paging.total_pool_bytes`; the
+  ``HOROVOD_SERVE_PAGED`` serving path);
 * ``program_cache`` — pulled from the executors' compiled-program caches
   (estimated from the bucket-stable cache keys: rows x capacity x
   itemsize per program);
@@ -73,7 +76,7 @@ _SAMPLE_RING = 512  # bounded: ~85 min of samples at the default cadence
 _BYTES = _metrics().gauge(
     "horovod_memory_bytes",
     "Live bytes claimed per subsystem (params, grads, optimizer_shards, "
-    "fusion, ckpt_staging, serve_kv, program_cache, host_rss).",
+    "fusion, ckpt_staging, serve_kv, kv_pages, program_cache, host_rss).",
     labelnames=("subsystem",))
 _PEAK_BYTES = _metrics().gauge(
     "horovod_memory_peak_bytes",
@@ -106,7 +109,8 @@ _OOMS = _metrics().counter(
 
 # subsystems whose bytes live in device memory (HBM) — the reconciliation
 # set; everything else (fusion slabs, ckpt staging, host_rss) is host-side
-DEVICE_SUBSYSTEMS = ("params", "grads", "optimizer_shards", "serve_kv")
+DEVICE_SUBSYSTEMS = ("params", "grads", "optimizer_shards", "serve_kv",
+                     "kv_pages")
 
 
 def host_rss_bytes() -> int:
@@ -273,6 +277,12 @@ class MemoryTracker:
             from horovod_tpu.serve import kv_cache
 
             claimed["serve_kv"] = int(kv_cache.total_cache_bytes())
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.serve import paging
+
+            claimed["kv_pages"] = int(paging.total_pool_bytes())
         except Exception:
             pass
         try:

@@ -77,6 +77,19 @@ class SelfAttention(nn.Module):
     ``positions`` and attention runs masked against the whole cache
     (:func:`cached_attention`). Parameters are identical to the training
     module — only runtime behavior and the (non-param) cache change.
+
+    ``paged=True`` (with ``decode=True``) swaps the per-row cache for a
+    POOLED one: ``(num_pages, page_tokens, heads, head_dim)`` per layer,
+    indexed through a per-row int32 ``page_table`` mapping logical block
+    ``pos // page_tokens`` to a physical page (serve/paging.py owns the
+    allocator). Writes scatter at ``cache.at[page, offset]`` with traced
+    indices; reads gather ``cache[page_table]`` and flatten back to a
+    per-row view whose flattened key index IS the absolute position, so
+    the same ``key_pos <= q_pos`` mask applies unchanged. Table entries
+    past a request's last block point at the reserved scratch page 0 —
+    scatter clamps overflowing (padded-garbage) positions onto it and
+    the mask keeps it unattendable. Both shapes and the program are
+    fixed; growing a request only changes table VALUES.
     """
 
     num_heads: int
@@ -86,9 +99,12 @@ class SelfAttention(nn.Module):
     fused_qkv: bool = False
     decode: bool = False
     max_cache_len: int = 0
+    paged: bool = False
+    num_pages: int = 0
+    page_tokens: int = 0
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, page_table=None):
         d_model = x.shape[-1]
         if d_model % self.num_heads:
             raise ValueError(
@@ -111,6 +127,49 @@ class SelfAttention(nn.Module):
             q = dense(features=qkv_shape, name="query")(x)
             k = dense(features=qkv_shape, name="key")(x)
             v = dense(features=qkv_shape, name="value")(x)
+
+        if self.decode and self.paged:
+            if positions is None:
+                raise ValueError("decode=True requires per-row positions")
+            if page_table is None:
+                raise ValueError("paged=True requires a page_table")
+            if self.num_pages <= 0 or self.page_tokens <= 0:
+                raise ValueError(
+                    "paged=True requires num_pages and page_tokens > 0")
+            batch, new_tokens = x.shape[0], x.shape[1]
+            T = self.page_tokens
+            cache_shape = (self.num_pages, T, self.num_heads, head_dim)
+            cached_key = self.variable("cache", "cached_key", jnp.zeros,
+                                       cache_shape, self.dtype)
+            cached_value = self.variable("cache", "cached_value", jnp.zeros,
+                                         cache_shape, self.dtype)
+            table = jnp.asarray(page_table, jnp.int32)
+            width = table.shape[1]
+            pos = jnp.asarray(positions, jnp.int32)
+            abs_pos = pos[:, None] + jnp.arange(new_tokens, dtype=jnp.int32)
+            # logical block per new token; positions past the mapped
+            # table clamp onto the trailing scratch entry (padded
+            # prefill garbage lands there, masked + never gathered as a
+            # reachable key position)
+            blk = jnp.minimum(abs_pos // T, width - 1)
+            page = jnp.take_along_axis(table, blk, axis=1)
+            off = abs_pos % T
+            cached_key.value = cached_key.value.at[page, off].set(
+                k.astype(self.dtype))
+            cached_value.value = cached_value.value.at[page, off].set(
+                v.astype(self.dtype))
+            # gather the row's mapped pages and flatten: key index i is
+            # absolute position i for every mapped block, so the dense
+            # path's mask semantics carry over verbatim
+            k_all = cached_key.value[table].reshape(
+                batch, width * T, self.num_heads, head_dim)
+            v_all = cached_value.value[table].reshape(
+                batch, width * T, self.num_heads, head_dim)
+            o = cached_attention(
+                q.transpose(0, 2, 1, 3), k_all.transpose(0, 2, 1, 3),
+                v_all.transpose(0, 2, 1, 3), abs_pos)
+            o = o.transpose(0, 2, 1, 3)
+            return dense(features=d_model, axis=(-2, -1), name="out")(o)
 
         if self.decode:
             if positions is None:
@@ -190,15 +249,21 @@ class TransformerLayer(nn.Module):
     fused_qkv: bool = False
     decode: bool = False
     max_cache_len: int = 0
+    paged: bool = False
+    num_pages: int = 0
+    page_tokens: int = 0
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, page_table=None):
         ln = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
         x = x + SelfAttention(
             num_heads=self.num_heads, causal=self.causal, dtype=self.dtype,
             attention_fn=self.attention_fn, fused_qkv=self.fused_qkv,
             decode=self.decode, max_cache_len=self.max_cache_len,
-            name="attention")(ln()(x), positions=positions)
+            paged=self.paged, num_pages=self.num_pages,
+            page_tokens=self.page_tokens,
+            name="attention")(ln()(x), positions=positions,
+                              page_table=page_table)
         x = x + Mlp(d_ff=self.d_ff, dtype=self.dtype, name="mlp")(ln()(x))
         return x
 
@@ -224,10 +289,13 @@ class Transformer(nn.Module):
     attention_fn: Optional[Callable] = None
     fused_qkv: bool = False
     decode: bool = False
+    paged: bool = False
+    num_pages: int = 0
+    page_tokens: int = 0
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True, pos_offset=0,
-                 output: str = "logits", positions=None):
+                 output: str = "logits", positions=None, page_table=None):
         """``pos_offset`` is the global position of the first token — under
         sequence parallelism each device passes its shard's offset (e.g.
         ``lax.axis_index(axis) * seq_local``) so position embeddings stay
@@ -275,8 +343,11 @@ class Transformer(nn.Module):
                     causal=self.causal, dtype=self.dtype,
                     attention_fn=self.attention_fn,
                     fused_qkv=self.fused_qkv, decode=True,
-                    max_cache_len=self.max_seq,
-                    name=f"layer_{i}")(x, positions=positions)
+                    max_cache_len=self.max_seq, paged=self.paged,
+                    num_pages=self.num_pages,
+                    page_tokens=self.page_tokens,
+                    name=f"layer_{i}")(x, positions=positions,
+                                       page_table=page_table)
             x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                              name="final_norm")(x)
             if output == "hidden":

@@ -6,6 +6,7 @@ Layout (docs/inference.md is the full architecture doc):
 * ``queue``    — shared request queue (in-process + rendezvous-KV)
 * ``batcher``  — iteration-level admission/retire scheduling
 * ``kv_cache`` — per-slot KV cache + bucketed serving program caches
+* ``paging``   — paged KV cache: block pool, prefix reuse, COW sharing
 * ``replica``  — the per-replica loop; ``run_kv_replica`` for fleets
 * ``__main__`` — the ``tpurun --serve`` demo worker
 """
@@ -14,6 +15,9 @@ from horovod_tpu.serve.api import (ServeHandle, ServePolicy, serve,
                                    serve_state)
 from horovod_tpu.serve.batcher import ContinuousBatcher
 from horovod_tpu.serve.kv_cache import DecodeEngine, prompt_bucket
+from horovod_tpu.serve.paging import (PagedDecodeEngine, PagePool,
+                                      PagePoolExhausted, PrefixCache,
+                                      total_pool_bytes)
 from horovod_tpu.serve.queue import (Completion, KVQueueFrontend,
                                      KVQueueReplica, QueueFull, Request,
                                      RequestQueue)
@@ -21,7 +25,8 @@ from horovod_tpu.serve.replica import Replica, run_kv_replica
 
 __all__ = [
     "Completion", "ContinuousBatcher", "DecodeEngine", "KVQueueFrontend",
-    "KVQueueReplica", "QueueFull", "Replica", "Request", "RequestQueue",
+    "KVQueueReplica", "PagePool", "PagePoolExhausted", "PagedDecodeEngine",
+    "PrefixCache", "QueueFull", "Replica", "Request", "RequestQueue",
     "ServeHandle", "ServePolicy", "prompt_bucket", "run_kv_replica",
-    "serve", "serve_state",
+    "serve", "serve_state", "total_pool_bytes",
 ]

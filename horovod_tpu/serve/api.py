@@ -36,6 +36,13 @@ from horovod_tpu import flight_recorder, tracing
 from horovod_tpu.analysis import witness
 from horovod_tpu.integrity.guards import StepGuard
 from horovod_tpu.serve.kv_cache import DecodeEngine
+from horovod_tpu.serve.paging import (DEFAULT_PAGE_TOKENS,
+                                      DEFAULT_PREFIX_ENTRIES,
+                                      HOROVOD_SERVE_PAGE_POOL,
+                                      HOROVOD_SERVE_PAGE_TOKENS,
+                                      HOROVOD_SERVE_PAGED,
+                                      HOROVOD_SERVE_PREFIX_CACHE,
+                                      PagedDecodeEngine)
 from horovod_tpu.serve.queue import Completion, RequestQueue
 from horovod_tpu.serve.replica import Replica, _LocalTransport
 from horovod_tpu.utils.env import _get_bool, _get_float, _get_int
@@ -61,6 +68,13 @@ class ServePolicy:
     slots: int = 8
     max_new_tokens: int = 64
     quarantine: bool = True
+    # paged KV cache (serve/paging.py; docs/inference.md): page_pool=0
+    # sizes the pool to half the dense slots x max_seq capacity,
+    # prefix_cache=0 disables prefix reuse
+    paged: bool = False
+    page_tokens: int = DEFAULT_PAGE_TOKENS
+    page_pool: int = 0
+    prefix_cache: int = DEFAULT_PREFIX_ENTRIES
 
     @classmethod
     def from_env(cls, **overrides) -> "ServePolicy":
@@ -78,6 +92,12 @@ class ServePolicy:
                                        cls.max_new_tokens),
             "quarantine": _get_bool(HOROVOD_SERVE_QUARANTINE,
                                     cls.quarantine),
+            "paged": _get_bool(HOROVOD_SERVE_PAGED, cls.paged),
+            "page_tokens": _get_int(HOROVOD_SERVE_PAGE_TOKENS,
+                                    cls.page_tokens),
+            "page_pool": _get_int(HOROVOD_SERVE_PAGE_POOL, cls.page_pool),
+            "prefix_cache": _get_int(HOROVOD_SERVE_PREFIX_CACHE,
+                                     cls.prefix_cache),
         }
         unknown = set(overrides) - set(base)
         if unknown:
@@ -130,6 +150,10 @@ class ServeHandle:
             t.start()
         with _state_lock:
             _handles.append(self)
+        # flight-recorder "serve" provider: every postmortem dump now
+        # carries the serving snapshot — replica/queue state and, under
+        # HOROVOD_SERVE_PAGED, pool occupancy at death
+        flight_recorder.set_state_provider("serve", serve_state)
 
     # -- request API -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None) -> str:
@@ -232,8 +256,15 @@ def serve(model, params, tokenizer=None, *, replicas: int = 1,
     queue = RequestQueue(capacity=policy.queue_capacity)
     fleet: List[Replica] = []
     for rank in range(replicas):
-        engine = DecodeEngine(model, params, num_slots=policy.slots,
-                              name=f"r{rank}")
+        if policy.paged:
+            engine = PagedDecodeEngine(
+                model, params, num_slots=policy.slots, name=f"r{rank}",
+                page_tokens=policy.page_tokens,
+                pool_pages=policy.page_pool,
+                prefix_entries=policy.prefix_cache)
+        else:
+            engine = DecodeEngine(model, params, num_slots=policy.slots,
+                                  name=f"r{rank}")
         guard = _serve_guard(rank) if policy.quarantine else None
         fleet.append(Replica(engine, _LocalTransport(queue, rank), policy,
                              rank=rank, guard=guard))

@@ -56,6 +56,9 @@ class ActiveRequest:
     prompt_len: int
     position: int            # absolute index the NEXT token writes at
     max_tokens: int = 0      # 0 → request.max_new_tokens (uncapped)
+    page_cost: int = 0       # committed KV pages charged at admission
+    #                          (paged engines only; 0 under dense)
+    admit_seq: int = 0       # admission order — preemption takes newest
     generated: List[int] = dataclasses.field(default_factory=list)
     first_token_s: float = 0.0
     admitted_s: float = 0.0
@@ -91,17 +94,30 @@ class ContinuousBatcher:
 
     def __init__(self, num_slots: int, max_batch_tokens: int,
                  admission_ms: float, decode_block: int,
-                 max_seq: Optional[int] = None):
+                 max_seq: Optional[int] = None,
+                 page_tokens: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
+                 prefix_probe=None):
         self.num_slots = num_slots
         self.max_batch_tokens = max_batch_tokens
         self.admission_s = admission_ms / 1000.0
         self.decode_block = max(1, decode_block)
         self.max_seq = max_seq   # cache length; None → no generation cap
+        # paged admission (serve/paging.py): when page_tokens is set the
+        # pool — not dense slot rows — is the capacity being committed.
+        # prefix_probe(prompt) -> currently-cached full-block pages, the
+        # admission discount (optimistic: a later eviction shows up as a
+        # PagePoolExhausted the replica answers with preemption).
+        self.page_tokens = page_tokens
+        self.pool_pages = pool_pages
+        self.prefix_probe = prefix_probe
         # guarded-by: <replica-thread>
         self._waiting: deque = deque()   # (Request, offered_monotonic)
         self._active: Dict[int, ActiveRequest] = {}
         self._free: List[int] = sorted(range(num_slots), reverse=True)
         self._steps_since_admission = 0
+        self._admission_seq = 0   # monotonic admission order (preemption)
+        self.preemptions = 0
 
     # -- introspection -----------------------------------------------------
     def waiting(self) -> int:
@@ -115,6 +131,9 @@ class ContinuousBatcher:
 
     def committed_tokens(self) -> int:
         return sum(a.committed_tokens for a in self._active.values())
+
+    def committed_pages(self) -> int:
+        return sum(a.page_cost for a in self._active.values())
 
     def oldest_wait_s(self, now: Optional[float] = None) -> float:
         if not self._waiting:
@@ -148,6 +167,7 @@ class ContinuousBatcher:
         now = time.monotonic() if now is None else now
         admitted: List[ActiveRequest] = []
         budget = self.committed_tokens()
+        pages = self.committed_pages()
         while self._waiting and self._free:
             req, _ = self._waiting[0]
             max_tokens = req.max_new_tokens
@@ -156,19 +176,40 @@ class ContinuousBatcher:
                 # prompt_len + max_tokens - 1 must fit the cache
                 max_tokens = max(
                     1, min(max_tokens, self.max_seq - len(req.prompt) + 1))
+            page_cost = 0
+            if self.page_tokens and self.pool_pages:
+                # a single request must fit the whole pool — the paged
+                # analogue of the max_seq cap, same cache_limit finish
+                cap = self.pool_pages * self.page_tokens \
+                    - len(req.prompt) + 1
+                max_tokens = max(1, min(max_tokens, cap))
+                # committed pages: worst-case written positions
+                # (prompt + generated - 1), discounted by the prefix
+                # pages currently shared in the engine's cache
+                written = len(req.prompt) + max_tokens - 1
+                discount = (self.prefix_probe(req.prompt)
+                            if self.prefix_probe is not None else 0)
+                page_cost = max(
+                    1, -(-written // self.page_tokens) - discount)
+                if pages + page_cost > self.pool_pages:
+                    break   # pool committed — wait for retires
             cost = len(req.prompt) + max_tokens
             if budget + cost > self.max_batch_tokens:
                 break   # hard cap — the deadline never overrides it
             self._waiting.popleft()
             slot = self._free.pop()
+            self._admission_seq += 1
             active = ActiveRequest(slot=slot, request=req,
                                    prompt_len=len(req.prompt),
                                    position=len(req.prompt),
                                    max_tokens=max_tokens,
+                                   page_cost=page_cost,
+                                   admit_seq=self._admission_seq,
                                    admitted_s=now)
             self._active[slot] = active
             admitted.append(active)
             budget += cost
+            pages += page_cost
         self._steps_since_admission = 0
         return admitted
 
@@ -181,6 +222,41 @@ class ContinuousBatcher:
             self._free.append(a.slot)
         self._free.sort(reverse=True)
         return done
+
+    def preempt_slot(self, slot: int,
+                     now: Optional[float] = None) -> Optional[ActiveRequest]:
+        """Pool-exhaustion path (paged engines): push ``slot``'s request
+        back to the FRONT of the waiting line — it is older than
+        anything queued behind it, so FIFO fairness holds — free its
+        slot, and count the requeue. Pages are the ENGINE's to reclaim
+        (``release_slot``); the batcher only schedules. The generated
+        prefix is dropped: greedy decoding regenerates it
+        deterministically on resume, so nothing is lost — the same
+        invariant the quarantine requeue rides."""
+        active = self._active.pop(slot, None)
+        if active is None:
+            return None
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+        active.request.requeues += 1
+        self._waiting.appendleft(
+            (active.request, time.monotonic() if now is None else now))
+        self.preemptions += 1
+        return active
+
+    def preempt_newest(self, exclude_slot: Optional[int] = None,
+                       now: Optional[float] = None
+                       ) -> Optional[ActiveRequest]:
+        """Pick the NEWEST-admitted active request (it has done the
+        least work and, having been admitted last, is the fairest to
+        defer) and preempt it. ``exclude_slot`` protects the request
+        the caller is currently operating on (e.g. mid-prefill)."""
+        candidates = [a for a in self._active.values()
+                      if a.slot != exclude_slot]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda a: a.admit_seq)
+        return self.preempt_slot(victim.slot, now=now)
 
     def evict_all(self) -> List[Request]:
         """Drop every active request (quarantine / worker-loss path) and

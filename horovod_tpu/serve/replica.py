@@ -42,6 +42,7 @@ from horovod_tpu.exceptions import NumericalError, WorkersDownError
 from horovod_tpu.metrics import COUNT_BUCKETS, registry as _metrics
 from horovod_tpu.serve.batcher import ContinuousBatcher
 from horovod_tpu.serve.kv_cache import DecodeEngine
+from horovod_tpu.serve.paging import PagePoolExhausted
 from horovod_tpu.serve.queue import (Completion, KVQueueReplica,
                                      RequestQueue, HEARTBEAT_SECONDS)
 from horovod_tpu.utils import logging as log
@@ -198,17 +199,25 @@ class Replica:
         self.policy = policy
         self.rank = rank
         self.name = name or f"serve-r{rank}"
+        # paged engines (serve/paging.py) switch admission from dense
+        # slot rows to free-page accounting: the batcher commits pool
+        # pages, discounted by the candidate's current prefix hits
+        self.paged = bool(getattr(engine, "paged", False))
         self.batcher = ContinuousBatcher(
             num_slots=engine.num_slots,
             max_batch_tokens=policy.max_batch_tokens,
             admission_ms=policy.admission_ms,
             decode_block=policy.decode_block,
-            max_seq=engine.max_seq)
+            max_seq=engine.max_seq,
+            page_tokens=engine.page_tokens if self.paged else None,
+            pool_pages=engine.pool.allocatable if self.paged else None,
+            prefix_probe=engine.probe_prefix if self.paged else None)
         self.guard = guard
         self.quarantined = False
         self.completed = 0
         self.decode_iterations = 0
         self.occupancy_sum = 0
+        self.page_used_sum = 0   # pool pages in use, summed per step
         self._stop = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
@@ -279,6 +288,10 @@ class Replica:
         _QUARANTINED.inc()
         victims = self.batcher.evict_all()
         victims += self.batcher.drain_waiting()
+        if self.paged:
+            # a dead replica must not pin pool pages: every request-held
+            # page goes back (the chaos cell pins request_held == 0)
+            self.engine.release_all()
         evicted = len(victims)
         requeued = self.transport.requeue_all()
         _REQUESTS.labels(outcome="requeued").inc(max(evicted, requeued))
@@ -289,6 +302,27 @@ class Replica:
         log.error("serve: replica %s QUARANTINED (%s); %d request(s) "
                   "returned for redistribution", self.name, reason,
                   max(evicted, requeued))
+
+    def _preempt_for_pages(self, exclude_slot=None) -> bool:
+        """Page-pool exhaustion (paged engines): bounce the newest-
+        admitted request back to the queue FRONT and reclaim its pages.
+        Returns False when there is no other victim to take."""
+        victim = self.batcher.preempt_newest(exclude_slot=exclude_slot)
+        if victim is None:
+            return False
+        self.engine.release_slot(victim.slot)
+        self.engine.note_preemption()
+        _REQUESTS.labels(outcome="preempted").inc()
+        flight_recorder.emit(
+            "serve_preempt", replica=self.name, rank=self.rank,
+            uid=victim.request.uid, slot=victim.slot,
+            trace_id=victim.request.trace_id,
+            generated=len(victim.generated),
+            requeues=victim.request.requeues)
+        log.warning("serve: replica %s preempted request %s (pool "
+                    "exhausted); requeued at front", self.name,
+                    victim.request.uid)
+        return True
 
     def _guard_ok(self, max_abs: float) -> bool:
         """Non-finite logits always quarantine; the spike guard's EWMA
@@ -325,6 +359,8 @@ class Replica:
                 # elastic driver re-forms us
                 victims = self.batcher.evict_all()
                 victims += self.batcher.drain_waiting()
+                if self.paged:
+                    self.engine.release_all()
                 requeued = self.transport.requeue_all()
                 requeued += len(victims)
                 flight_recorder.emit(
@@ -375,8 +411,26 @@ class Replica:
                     "request.queue_wait", p0 - active.queue_wait_s,
                     active.queue_wait_s, trace_id=req.trace_id,
                     uid=req.uid, requeues=req.requeues)
-                token, max_abs = self.engine.prefill(
-                    active.slot, req.prompt)
+                token = None
+                while True:
+                    try:
+                        token, max_abs = self.engine.prefill(
+                            active.slot, req.prompt)
+                        break
+                    except PagePoolExhausted:
+                        # prefill rolled its partial allocations back;
+                        # preempt the newest OTHER request and retry.
+                        # With nothing left to preempt, the admission
+                        # itself bounces back to the queue front (its
+                        # prefix-hit discount was optimistic)
+                        if not self._preempt_for_pages(
+                                exclude_slot=active.slot):
+                            self.batcher.preempt_slot(active.slot)
+                            self.engine.note_preemption()
+                            _REQUESTS.labels(outcome="preempted").inc()
+                            break
+                if token is None:
+                    continue
                 if not self._guard_ok(max_abs):
                     self._quarantine("non-finite prefill logits")
                     return
@@ -393,6 +447,8 @@ class Replica:
                 _LATENCY.labels(phase="ttft").observe(
                     active.first_token_s - active.request.submitted_s)
             for done in self.batcher.retire_done():  # max_new_tokens == 1
+                if self.paged:
+                    self.engine.release_slot(done.slot)
                 self._finish(done, time.monotonic())
 
         slots, tokens, positions = self.batcher.batch_rows()
@@ -400,6 +456,23 @@ class Replica:
             _OCCUPANCY.labels(replica=self.name).set(0)
             time.sleep(_IDLE_SLEEP_SECONDS)
             return
+
+        if self.paged:
+            # grow tables across block boundaries / COW shared pages
+            # BEFORE the step; exhaustion preempts newest-admitted until
+            # the survivors fit (admission guarantees a sole request
+            # always does)
+            while True:
+                try:
+                    self.engine.prepare_step(slots, positions)
+                    break
+                except PagePoolExhausted:
+                    if not self._preempt_for_pages():
+                        raise   # nothing left to shed: quarantine path
+                    slots, tokens, positions = self.batcher.batch_rows()
+                    if not slots:
+                        _OCCUPANCY.labels(replica=self.name).set(0)
+                        return
 
         # the serving step counter: chaos kills aim at decode step N
         self.decode_iterations += 1
@@ -433,31 +506,45 @@ class Replica:
                 active.block_steps = 0
         occupancy = len(slots)
         self.occupancy_sum += occupancy
+        if self.paged:
+            self.page_used_sum += self.engine.pool.used_count()
         _TOKENS.labels(kind="decode").inc(occupancy)
         _OCCUPANCY.labels(replica=self.name).set(occupancy)
         _OCCUPANCY_HIST.observe(occupancy)
         self.batcher.note_step()
         now = time.monotonic()
         for done in self.batcher.retire_done():
+            if self.paged:
+                self.engine.release_slot(done.slot)
             self._finish(done, now)
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
         steps = max(self.engine.decode_steps, 1)
-        return {"name": self.name, "rank": self.rank,
-                "quarantined": self.quarantined,
-                "completed": self.completed,
-                "active": self.batcher.occupancy(),
-                "waiting": self.batcher.waiting(),
-                "decode_steps": self.engine.decode_steps,
-                "avg_occupancy": round(self.occupancy_sum / steps, 3),
-                # memory plane: resident KV bytes + the slot-occupancy-
-                # weighted share of the cache that did useful work
-                "kv_cache_bytes": self.engine.cache_bytes(),
-                "kv_utilization": round(
-                    self.occupancy_sum
-                    / (steps * max(self.engine.num_slots, 1)), 3),
-                "engine": self.engine.stats()}
+        out = {"name": self.name, "rank": self.rank,
+               "quarantined": self.quarantined,
+               "completed": self.completed,
+               "active": self.batcher.occupancy(),
+               "waiting": self.batcher.waiting(),
+               "decode_steps": self.engine.decode_steps,
+               "avg_occupancy": round(self.occupancy_sum / steps, 3),
+               # memory plane: resident KV bytes + the slot-occupancy-
+               # weighted share of the cache that did useful work
+               "kv_cache_bytes": self.engine.cache_bytes(),
+               "kv_utilization": round(
+                   self.occupancy_sum
+                   / (steps * max(self.engine.num_slots, 1)), 3),
+               "engine": self.engine.stats()}
+        if self.paged:
+            # pool view for /serve and hvd_top's pages row: live pool
+            # stats plus the per-decode-step average occupancy
+            out["pages"] = self.engine.page_stats()
+            out["page_utilization"] = round(
+                self.page_used_sum
+                / (steps * max(self.engine.pool.allocatable, 1)), 3)
+            out["prefix_hit_rate"] = self.engine.prefix_hit_rate()
+            out["preemptions"] = self.engine.preemptions
+        return out
 
 
 def run_kv_replica(model, params, policy, rank: int, addr: str, port: int,
@@ -468,8 +555,16 @@ def run_kv_replica(model, params, policy, rank: int, addr: str, port: int,
     from horovod_tpu.run.rendezvous import KVStoreClient
 
     client = KVStoreClient(addr, port, scope="serve", timeout=10.0)
-    engine = DecodeEngine(model, params, num_slots=policy.slots,
-                          name=f"r{rank}")
+    if getattr(policy, "paged", False):
+        from horovod_tpu.serve.paging import PagedDecodeEngine
+
+        engine = PagedDecodeEngine(
+            model, params, num_slots=policy.slots, name=f"r{rank}",
+            page_tokens=policy.page_tokens, pool_pages=policy.page_pool,
+            prefix_entries=policy.prefix_cache)
+    else:
+        engine = DecodeEngine(model, params, num_slots=policy.slots,
+                              name=f"r{rank}")
     # the transport's heartbeat thread starts beating here, BEFORE the
     # first (slow, compiling) prefill can run — registration is not
     # gated on the serve loop being responsive

@@ -118,6 +118,9 @@ ENV_DIRECT_KNOBS = (
     "HOROVOD_SERVE_QUEUE_CAPACITY", "HOROVOD_SERVE_DECODE_BLOCK",
     "HOROVOD_SERVE_SLOTS", "HOROVOD_SERVE_MAX_NEW_TOKENS",
     "HOROVOD_SERVE_QUARANTINE", "HOROVOD_SERVE_RESULT_TTL_S",
+    # paged KV cache + prefix reuse (serve/paging.py; docs/inference.md)
+    "HOROVOD_SERVE_PAGED", "HOROVOD_SERVE_PAGE_TOKENS",
+    "HOROVOD_SERVE_PAGE_POOL", "HOROVOD_SERVE_PREFIX_CACHE",
     # bucket-wise gradient release (parallel/buckets.py;
     # docs/performance.md "backward overlap")
     "HOROVOD_GRAD_BUCKET_RELEASE", "HOROVOD_GRAD_BUCKET_BYTES",

@@ -345,6 +345,49 @@ def test_bench_compare_comms_rows_clean_pass(bench_compare, tmp_path,
     assert "[comms_utilization]" in out
 
 
+def test_bench_compare_inflated_kv_bytes_fails(bench_compare, tmp_path,
+                                               capsys):
+    """ISSUE 17 satellite: kv_cache_bytes_per_chip is a lower-is-better
+    bytes row. Throughput flat but the candidate's KV footprint doubled
+    (paged engine regressed to dense-sized pools) — the bytes row fails
+    the gate on its own."""
+    base_row = dict(_BASE_ROW, kv_cache_bytes_per_chip=98304.0)
+    base = _artifact(tmp_path / "base.json", [base_row])
+    cand_row = dict(base_row, kv_cache_bytes_per_chip=196608.0)
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    assert bench_compare.main([base, cand]) == 1
+    out = capsys.readouterr().out
+    assert "kv_cache bytes" in out
+    assert "lower is better" in out
+
+
+def test_bench_compare_collapsed_prefix_hit_rate_fails(bench_compare,
+                                                       tmp_path, capsys):
+    """ISSUE 17 satellite: prefix_hit_rate is a higher-is-better
+    fraction — a collapsed hit rate (prefix cache silently disabled)
+    gates like a throughput regression even when latency holds."""
+    base_row = dict(_BASE_ROW, prefix_hit_rate=0.8)
+    base = _artifact(tmp_path / "base.json", [base_row])
+    cand_row = dict(base_row, prefix_hit_rate=0.1)
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    assert bench_compare.main([base, cand]) == 1
+    out = capsys.readouterr().out
+    assert "prefix_hit_rate" in out
+    assert "higher is better" in out
+
+
+def test_bench_compare_paged_rows_clean_pass(bench_compare, tmp_path,
+                                             capsys):
+    row = dict(_BASE_ROW, kv_cache_bytes_per_chip=98304.0,
+               prefix_hit_rate=0.8)
+    base = _artifact(tmp_path / "base.json", [row])
+    cand = _artifact(tmp_path / "cand.json", [dict(row)])
+    assert bench_compare.main([base, cand]) == 0
+    out = capsys.readouterr().out
+    assert "[kv_cache bytes]" in out
+    assert "[prefix_hit_rate]" in out
+
+
 def test_comms_suite_tiny(bench, capsys):
     """ISSUE 16 satellite shape: ``bench.py --comms --tiny`` runs the
     interleaved tracker-off/tracker-on A/B and reports the overhead

@@ -126,6 +126,13 @@ def derived_rows(rows: Dict[str, dict]) -> Dict[str, Tuple[float, str]]:
         if isinstance(obj.get("kv_cache_bytes_per_chip"), (int, float)):
             flat[f"{metric} [kv_cache bytes]"] = (
                 float(obj["kv_cache_bytes_per_chip"]), "bytes")
+        # paged KV cache (bench.py --serve under HOROVOD_SERVE_PAGED /
+        # --prefix-heavy): prefix reuse is a rate — "fraction" makes it
+        # higher-is-better, so a collapsed hit rate gates like a
+        # throughput regression while kv_cache bytes gate growth above
+        if isinstance(obj.get("prefix_hit_rate"), (int, float)):
+            flat[f"{metric} [prefix_hit_rate]"] = (
+                float(obj["prefix_hit_rate"]), "fraction")
         # serving tail latencies (bench.py --serve): "ms" unit makes them
         # lower-is-better, so a p99 blow-up gates even when tokens/s holds
         for key in ("p50_latency_ms", "p99_latency_ms",

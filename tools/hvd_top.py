@@ -29,8 +29,8 @@ from typing import Dict, List, Optional
 POLL_TIMEOUT_SECONDS = 3.0
 
 # ledger columns, widest consumers first; anything else folds into "other"
-COLUMNS = ("params", "grads", "optimizer_shards", "serve_kv", "fusion",
-           "ckpt_staging", "program_cache")
+COLUMNS = ("params", "grads", "optimizer_shards", "serve_kv", "kv_pages",
+           "fusion", "ckpt_staging", "program_cache")
 
 
 def fmt_bytes(n: Optional[float]) -> str:
@@ -132,7 +132,7 @@ def render_slo(endpoints: List[str]) -> str:
     SLO plane (training-only fleet or pre-tracing build) so the memory
     table stays the whole display."""
     header = ["rank", "endpoint", "scored", "burn", "budget", "alerting",
-              "ttft p50/p99", "latency p50/p99", "done", "active"]
+              "ttft p50/p99", "latency p50/p99", "done", "active", "pages"]
     rows: List[List[str]] = []
     any_slo = False
     for ep in endpoints:
@@ -159,12 +159,22 @@ def render_slo(endpoints: List[str]) -> str:
                 p99, (int, float)) else p50)
 
         done = active = None
+        pages = "-"
         serve = fetch_json(ep, "/serve")
         if serve is not None:
             reps = [r for h in serve.get("handles", ())
                     for r in h.get("replicas", ())]
             done = sum(int(r.get("completed", 0)) for r in reps)
             active = sum(int(r.get("active", 0)) for r in reps)
+            # paged KV pool occupancy (serve/paging.py): used/total
+            # summed over the endpoint's paged replicas, "-" for dense
+            pools = [r["pages"] for r in reps
+                     if isinstance(r.get("pages"), dict)]
+            if pools:
+                pages = "%d/%d" % (sum(int(p.get("used", 0))
+                                       for p in pools),
+                                   sum(int(p.get("pages", 0))
+                                       for p in pools))
         rows.append(
             [str(slo.get("rank", "?")), ep,
              str(slo.get("requests_scored", 0)),
@@ -172,7 +182,7 @@ def render_slo(endpoints: List[str]) -> str:
              ("%.2f" % min(budgets)) if budgets else "-",
              alerting, pair(ttft), pair(lat),
              "-" if done is None else str(done),
-             "-" if active is None else str(active)])
+             "-" if active is None else str(active), pages])
     if not any_slo:
         return ""
     widths = [max(len(header[i]), *(len(r[i]) for r in rows), 1)
