@@ -656,6 +656,59 @@ def control_plane_main(fast: bool = False, np_override: int = None):
     return results
 
 
+def hierarchy_main(tiny: bool = False, np_override: int = None):
+    """Flat-vs-hierarchical host collective A/B (ISSUE 18 tentpole
+    evidence; tools/hierarchy_bench.py): per-payload us/op for the seed
+    flat ring vs the two-level decomposition (group size 2) with and
+    without the fp16 slow-hop codec, each with and without a simulated
+    slow cross-group link (``netdelay:...:hop=cross``). The headline is
+    the throttled-hop speedup — unit "x" so tools/bench_compare.py
+    gates it higher-is-better. Full mode adds the rebooted autotuner's
+    convergence ratio vs the hand-tuned configuration.
+
+    ``tiny``: one small size, few steps, no autotune phase — the tier-1
+    smoke mode; numbers are meaningless."""
+    import subprocess
+
+    np_workers = (str(np_override) if np_override is not None
+                  else os.environ.get("BENCH_HIERARCHY_NP", "4"))
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "hierarchy_bench.py"),
+           "--np", np_workers]
+    if tiny:
+        cmd.append("--tiny")
+    raw = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=1800, check=True)
+    r = json.loads(raw.stdout.strip().splitlines()[-1])
+    big = str(r["sizes"][-1])
+    rows = [
+        ("hierarchical+fp16 vs flat, throttled cross hop",
+         r["throttled_hop_speedup_x"], "x"),
+        ("hierarchical vs flat, uniform wire",
+         r["uniform_wire_ratio_x"], "x"),
+        ("flat ring us/op under netdelay",
+         r["us_per_op"]["flat_netdelay"][big], "us/op"),
+        ("hierarchical+fp16 us/op under netdelay",
+         r["us_per_op"]["hier_fp16_netdelay"][big], "us/op"),
+    ]
+    if not tiny and r.get("autotuned_vs_hand_tuned_x") is not None:
+        rows.append(("autotuned vs hand-tuned, throttled cross hop",
+                     r["autotuned_vs_hand_tuned_x"], "x"))
+    results = []
+    for metric, value, unit in rows:
+        results.append({
+            "metric": (f"{metric} (np={r['world']}, "
+                       f"g={r['group_size']}"
+                       f"{', tiny' if tiny else ''})"),
+            "value": value, "unit": unit, "vs_baseline": None,
+        })
+        if tiny:
+            results[-1]["tiny"] = True
+        print(json.dumps(results[-1]), flush=True)
+    return results
+
+
 def collectives_main(tiny: bool = False):
     """Data-plane microbench: steady-state fused allreduce through the
     background runtime — pipelined dispatch, size-bucketed program cache
@@ -1733,6 +1786,12 @@ if __name__ == "__main__":
     parser.add_argument("--control-plane", action="store_true",
                         help="benchmark the control plane (negotiation/"
                              "cache/fusion/autotune) at np=4 on host")
+    parser.add_argument("--hierarchy", action="store_true",
+                        help="A/B flat vs hierarchical host collectives "
+                             "(group size 2) with/without a throttled "
+                             "cross-group hop and the fp16 slow-hop "
+                             "codec, at np=4 on host; full mode adds "
+                             "the autotuner convergence ratio")
     parser.add_argument("--collectives", action="store_true",
                         help="microbench the data plane: steady-state "
                              "fused allreduce latency vs payload size + "
@@ -1805,6 +1864,8 @@ if __name__ == "__main__":
         sharded_optimizer_main(tiny=cli.tiny)
     elif cli.control_plane:
         control_plane_main()
+    elif cli.hierarchy:
+        hierarchy_main(tiny=cli.tiny)
     elif cli.model is not None and not cli.all:
         if cli.model in ("bert", "bert-large", "gpt2"):
             transformer_main(cli.model)

@@ -37,9 +37,18 @@ from horovod_tpu.autotune.bayesian_optimization import BayesianOptimization
 
 SAMPLES_PER_POINT = 5  # reference: parameter_manager.cc five-sample medians
 
-# continuous search box: fusion threshold (MB), cycle time (ms)
+# continuous search box: fusion threshold (MB), cycle time (ms),
+# grad-bucket payload (MB), cycle pipeline depth
 FUSION_MB_BOUNDS = (0.0, 64.0)
 CYCLE_MS_BOUNDS = (1.0, 25.0)
+BUCKET_MB_BOUNDS = (1.0, 64.0)
+DEPTH_BOUNDS = (1.0, 4.0)
+
+# Slow-hop wire codecs for the hierarchical cross-group exchange, in
+# packed-byte order (index = the byte in the sync blob). Must stay
+# append-only: renumbering would desynchronize mixed-commit workers
+# mid-rolling-restart.
+COMPRESSION_CODECS = ("none", "fp16", "ieee_fp16")
 
 
 @dataclasses.dataclass
@@ -53,25 +62,89 @@ class Params:
     hierarchical_allreduce: bool
     hierarchical_allgather: bool
     active: bool = True  # still tuning?
+    # hierarchy split + slow-hop codec + the throughput knobs the rebooted
+    # tuner drives; defaulted so pre-reboot call sites construct unchanged
+    hierarchy_group_size: int = 0        # 0 = host-derived grouping
+    hierarchy_compression: str = "none"  # cross-group wire codec
+    grad_bucket_bytes: int = 0           # 0 = keep the configured value
+    cycle_pipeline_depth: int = 0        # 0 = keep the configured value
 
-    _FMT = "<qdBBBB"
+    _FMT = "<qdBBBBBBqB"
 
     def pack(self) -> bytes:
+        codec = COMPRESSION_CODECS.index(
+            normalize_codec(self.hierarchy_compression))
         return struct.pack(
             self._FMT, self.fusion_threshold_bytes, self.cycle_time_ms,
             int(self.cache_enabled), int(self.hierarchical_allreduce),
-            int(self.hierarchical_allgather), int(self.active))
+            int(self.hierarchical_allgather), int(self.active),
+            min(255, max(0, int(self.hierarchy_group_size))), codec,
+            int(self.grad_bucket_bytes),
+            min(255, max(0, int(self.cycle_pipeline_depth))))
 
     @classmethod
     def unpack(cls, blob: bytes) -> "Params":
-        f, c, ce, ha, hg, act = struct.unpack(cls._FMT, blob)
-        return cls(f, c, bool(ce), bool(ha), bool(hg), bool(act))
+        (f, c, ce, ha, hg, act, gsz, codec, bkt,
+         depth) = struct.unpack(cls._FMT, blob)
+        codec_name = (COMPRESSION_CODECS[codec]
+                      if codec < len(COMPRESSION_CODECS) else "none")
+        return cls(f, c, bool(ce), bool(ha), bool(hg), bool(act),
+                   hierarchy_group_size=gsz,
+                   hierarchy_compression=codec_name,
+                   grad_bucket_bytes=bkt, cycle_pipeline_depth=depth)
 
 
 # Default swept categorical knobs. The hierarchical flags join the sweep
 # only when the runtime's data plane actually consults them (two-level
-# mesh) — sweeping a no-op knob would just burn sample windows on noise.
+# mesh, or a host ring wide enough to split into >= 2 groups of >= 2) —
+# sweeping a no-op knob would just burn sample windows on noise.
 _CATEGORICAL = ("cache_enabled",)
+
+# env.py accepts spelling variants for the codec knob; the packed blob
+# and the sweep work over the canonical names only
+_CODEC_ALIASES = {"": "none", "off": "none", "bf16": "fp16",
+                  "bfloat16": "fp16", "float16": "ieee_fp16",
+                  "f16": "ieee_fp16"}
+
+
+def normalize_codec(name) -> str:
+    """Canonical ``COMPRESSION_CODECS`` member for any accepted codec
+    spelling; unknown names fail open to ``"none"``."""
+    name = str(name or "none").strip().lower()
+    name = _CODEC_ALIASES.get(name, name)
+    return name if name in COMPRESSION_CODECS else "none"
+
+
+# Value pairs per categorical knob; knobs not listed sweep (False, True).
+# The codec sweep tries the bf16-wire codec only: ieee_fp16 has the same
+# wire width, so on throughput it is indistinguishable and scoring it
+# separately would double the sample cost of the phase for nothing.
+_CATEGORICAL_VALUES = {"hierarchy_compression": ("none", "fp16")}
+
+
+def search_box_from_roofline(roofline) -> list:
+    """Seed the Bayesian search box from a probe-cache artifact.
+
+    With measured hop bandwidth the payload-sized boxes shrink to what
+    the slowest lane can actually move in one maximum-length cycle
+    (GB/s x ms = MB), so early BO samples don't burn cycles probing
+    bucket/fusion sizes the wire provably cannot drain in time. Without
+    an artifact (or a pre-hierarchy schema) the static defaults stand.
+    """
+    box = [FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS, BUCKET_MB_BOUNDS,
+           DEPTH_BOUNDS]
+    if not roofline:
+        return box
+    bw = (roofline.get("hier_cross_busbw_gbps")
+          or roofline.get("allreduce_busbw_gbps"))
+    if not bw or bw <= 0:
+        return box
+    cap_mb = bw * CYCLE_MS_BOUNDS[1]
+    cap_mb = max(BUCKET_MB_BOUNDS[0] * 2.0,
+                 min(BUCKET_MB_BOUNDS[1], cap_mb))
+    box[0] = (FUSION_MB_BOUNDS[0], min(FUSION_MB_BOUNDS[1], cap_mb))
+    box[2] = (BUCKET_MB_BOUNDS[0], cap_mb)
+    return box
 
 
 class ParameterManager:
@@ -80,7 +153,8 @@ class ParameterManager:
     def __init__(self, initial: Params, warmup_samples: int = 3,
                  steps_per_sample: int = 10, bayes_opt_max_samples: int = 20,
                  gp_noise: float = 0.8, log_path: str = "",
-                 rank: int = 0, sweep: tuple = _CATEGORICAL):
+                 rank: int = 0, sweep: tuple = _CATEGORICAL,
+                 bounds: Optional[list] = None):
         # an empty sweep (e.g. cache disabled via capacity 0 and no
         # two-level mesh) skips the categorical phase entirely
         self._sweep = tuple(sweep)
@@ -97,22 +171,29 @@ class ParameterManager:
         self._step_count = 0
         self._bytes = 0
         self._seconds = 0.0
+        self._busbw: List[float] = []  # per-step comms busbw hints (GB/s)
         self._scores: List[float] = []
 
         # tuning schedule state
         self._phase = "categorical"
         self._cat_index = 0       # which categorical knob
-        self._cat_value = False   # which value is being scored
+        self._cat_pos = 0         # which of the knob's values is scored
         self._cat_scores: dict = {}
         if self._sweep:
             # the first scored point must actually RUN the value it is
             # labeled with — apply it now rather than scoring the default
             # under a mismatched label
-            setattr(self.current, self._sweep[0], False)
+            knob = self._sweep[0]
+            setattr(self.current, knob, self._values_of(knob)[0])
         else:
             self._phase = "bayesian"
+        # search box: caller-seeded (probe-cache rooflines via
+        # search_box_from_roofline) or the static defaults
+        self._bounds = list(bounds) if bounds else [
+            FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS, BUCKET_MB_BOUNDS,
+            DEPTH_BOUNDS]
         self._bo = BayesianOptimization(
-            bounds=[FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS],
+            bounds=self._bounds,
             alpha=max(gp_noise, 1e-6) * 1e-2)
         self._bo_remaining = bayes_opt_max_samples
 
@@ -123,8 +204,9 @@ class ParameterManager:
         # param vector, parameter_manager.cc:256-307). Continuous knobs
         # are always swept by the Bayesian phase; categoricals only when
         # the data plane consults them.
-        self.swept_knobs = ("fusion_threshold_mb",
-                            "cycle_time_ms") + self._sweep
+        self.swept_knobs = ("fusion_threshold_mb", "cycle_time_ms",
+                            "grad_bucket_mb",
+                            "pipeline_depth") + self._sweep
         if self._rank == 0:  # coordinator only, like the CSV below
             from horovod_tpu.utils.logging import get_logger
             get_logger().info(
@@ -136,12 +218,27 @@ class ParameterManager:
                 f.write("# swept: " + ",".join(self.swept_knobs) + "\n")
                 f.write("timestamp,fusion_threshold_mb,cycle_time_ms,"
                         "cache_enabled,hierarchical_allreduce,"
-                        "hierarchical_allgather,score_bytes_per_us\n")
+                        "hierarchical_allgather,hierarchy_group_size,"
+                        "hierarchy_compression,grad_bucket_mb,"
+                        "pipeline_depth,score_bytes_per_us\n")
+
+    @staticmethod
+    def _values_of(knob: str) -> tuple:
+        return _CATEGORICAL_VALUES.get(knob, (False, True))
 
     # ------------------------------------------------------------------
-    def update(self, nbytes: int, seconds: float) -> bool:
+    def update(self, nbytes: int, seconds: float,
+               busbw_gbs: Optional[float] = None) -> bool:
         """Record one cycle's traffic; returns True when params changed
         (reference: ParameterManager::Update, parameter_manager.cc:142-176).
+
+        ``busbw_gbs`` is the comms plane's smoothed bus bandwidth for the
+        cycle (GB/s). When provided, the sample score blends end-to-end
+        throughput with wire utilization equally — both are
+        bytes-per-microsecond-dimensioned (1 GB/s = 1000 B/us), and a
+        knob change that genuinely helps moves both the same direction,
+        while one that merely shifts cost between negotiation and the
+        wire shows up as the two components disagreeing.
         """
         if not self.active:
             return False
@@ -153,15 +250,20 @@ class ParameterManager:
             return False
         self._bytes += int(nbytes)
         self._seconds += float(seconds)
+        if busbw_gbs is not None and busbw_gbs > 0:
+            self._busbw.append(float(busbw_gbs))
         self._step_count += 1
         if self._step_count < self._steps_per_sample:
             return False
         # one sample
         score = (self._bytes / (self._seconds * 1e6)
                  if self._seconds > 0 else 0.0)
+        if self._busbw:
+            score = 0.5 * score + 0.5 * float(np.mean(self._busbw)) * 1000.0
         self._step_count = 0
         self._bytes = 0
         self._seconds = 0.0
+        self._busbw.clear()
 
         if self._warmup_remaining > 0:
             self._warmup_remaining -= 1
@@ -183,7 +285,11 @@ class ParameterManager:
                     f"{c.fusion_threshold_bytes / (1024 * 1024):.3f},"
                     f"{c.cycle_time_ms:.3f},{int(c.cache_enabled)},"
                     f"{int(c.hierarchical_allreduce)},"
-                    f"{int(c.hierarchical_allgather)},{score:.3f}\n")
+                    f"{int(c.hierarchical_allgather)},"
+                    f"{int(c.hierarchy_group_size)},"
+                    f"{c.hierarchy_compression},"
+                    f"{c.grad_bucket_bytes / (1024 * 1024):.3f},"
+                    f"{int(c.cycle_pipeline_depth)},{score:.3f}\n")
 
     def _record(self, score: float) -> None:
         self._log(score)
@@ -198,30 +304,37 @@ class ParameterManager:
 
         if self._phase == "categorical":
             knob = self._sweep[self._cat_index]
-            self._cat_scores[(knob, self._cat_value)] = score
-            if not self._cat_value:
-                # score the other value next
-                self._cat_value = True
-                setattr(self.current, knob, True)
+            values = self._values_of(knob)
+            self._cat_scores[(knob, values[self._cat_pos])] = score
+            if self._cat_pos + 1 < len(values):
+                # score the next value
+                self._cat_pos += 1
+                setattr(self.current, knob, values[self._cat_pos])
                 return True
-            # both values scored — keep the better, move to next knob
-            better = (self._cat_scores[(knob, True)]
-                      >= self._cat_scores[(knob, False)])
-            setattr(self.current, knob, better)
+            # all values scored — keep the best, move to next knob
+            best_val = max(values,
+                           key=lambda v: self._cat_scores[(knob, v)])
+            setattr(self.current, knob, best_val)
             self._cat_index += 1
-            self._cat_value = False
+            self._cat_pos = 0
             if self._cat_index >= len(self._sweep):
                 self._phase = "bayesian"
                 nxt = self._bo.next_sample()
                 self._apply_continuous(nxt)
             else:
-                setattr(self.current, self._sweep[self._cat_index], False)
+                nxt_knob = self._sweep[self._cat_index]
+                setattr(self.current, nxt_knob,
+                        self._values_of(nxt_knob)[0])
             return True
 
         if self._phase == "bayesian":
             x = np.array([
                 self.current.fusion_threshold_bytes / (1024.0 * 1024.0),
-                self.current.cycle_time_ms])
+                self.current.cycle_time_ms,
+                max(self._bounds[2][0],
+                    self.current.grad_bucket_bytes / (1024.0 * 1024.0)),
+                max(self._bounds[3][0],
+                    float(self.current.cycle_pipeline_depth))])
             self._bo.add_sample(x, score)
             self._bo_remaining -= 1
             if self._bo_remaining <= 0:
@@ -237,6 +350,11 @@ class ParameterManager:
             max(0.0, float(x[0])) * 1024 * 1024)
         self.current.cycle_time_ms = float(np.clip(
             x[1], CYCLE_MS_BOUNDS[0], CYCLE_MS_BOUNDS[1]))
+        self.current.grad_bucket_bytes = int(float(np.clip(
+            x[2], self._bounds[2][0],
+            self._bounds[2][1])) * 1024 * 1024)
+        self.current.cycle_pipeline_depth = int(round(float(np.clip(
+            x[3], DEPTH_BOUNDS[0], DEPTH_BOUNDS[1]))))
 
     def _finish(self) -> None:
         """Freeze at the best configuration seen (reference: tuning ends and

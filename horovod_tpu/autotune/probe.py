@@ -36,8 +36,12 @@ log = logging.getLogger("horovod_tpu")
 HOROVOD_PROBE_CACHE = "HOROVOD_PROBE_CACHE"
 
 # persisted roofline artifact schema (bumped on incompatible change;
-# a mismatched schema simply re-probes)
-_CACHE_SCHEMA = 1
+# a mismatched schema simply re-probes). v2: the hierarchy's two socket
+# hops are probed separately (``hier_intra_busbw_gbps`` /
+# ``hier_cross_busbw_gbps``) — a v1 artifact knows nothing about the
+# split, so reloading it would leave the new lanes unseeded while
+# claiming a cache hit.
+_CACHE_SCHEMA = 2
 
 
 def _timed_scalar(fn, *args) -> float:
@@ -234,6 +238,10 @@ def probe_and_seed(config, mesh=None) -> dict:
         mesh = basics._ensure_init().mesh
     world = int(mesh.size)
     cached = load_cached_roofline(world=world)
+    if cached is not None and "hbm_gbps" not in cached:
+        # a hier-hop-only artifact (host-ring probe wrote this path):
+        # says nothing about the mesh lanes — probe them live
+        cached = None
     if cached is not None:
         measured = {
             "hbm_gbps": float(cached["hbm_gbps"]),
@@ -290,4 +298,96 @@ def probe_and_seed(config, mesh=None) -> dict:
         for lane in ("device", "spmd"):
             comms.tracker().seed_roofline(
                 lane, measured["allreduce_busbw_gbps"], source=source)
+    return measured
+
+
+# -- host-hierarchy hop probes (socket data plane) ----------------------------
+
+def probe_hier_hops(net, plan, size_mb: int = 4,
+                    iters: int = 6) -> dict:
+    """Probe the two hops of the socket hierarchy SEPARATELY: a timed
+    subgroup ring allreduce inside each group (``hier_intra``) and one
+    over each cross-group slot ring (``hier_cross``). The two lanes can
+    differ by an order of magnitude (intra-host loopback vs a throttled
+    DCN hop), so one blended number would mis-bound both.
+
+    Collective: every rank of the plan must call this at the same
+    execution point. The intra rings (one per group) and the cross rings
+    (one per slot) are each disjoint over ranks, so all ranks probe both
+    hops concurrently. Returns busbw GB/s per hop.
+    """
+    from horovod_tpu import comms
+    from horovod_tpu.runtime import hierarchy
+
+    n = max(1, size_mb * (1 << 20) // 4)
+    buf = np.ones((n,), np.float32)
+
+    def timed(ring, pos) -> float:
+        # "max" keeps values fixed across iterations (an iterated "sum"
+        # would overflow); 2 warmup rounds double as a ring barrier so
+        # the timed window starts aligned
+        for _ in range(2):
+            hierarchy._ring_allreduce(net, ring, pos, buf, "max")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hierarchy._ring_allreduce(net, ring, pos, buf, "max")
+        dt = (time.perf_counter() - t0) / iters
+        algbw = buf.nbytes / dt / 1e9
+        return algbw * comms.bus_factor("allreduce", len(ring))
+
+    intra = timed(plan.members, plan.local_index)
+    cross = timed(plan.cross_members, plan.group_index)
+    return {"hier_intra_busbw_gbps": intra,
+            "hier_cross_busbw_gbps": cross}
+
+
+def probe_host_hier_and_seed(net, config) -> Optional[dict]:
+    """Host-ring analogue of :func:`probe_and_seed` for the hierarchy
+    lanes: reuse a matching schema-2 artifact when present, otherwise
+    probe both hops over the live sockets, persist (rank 0 only — the
+    write is atomic but there is no reason for N ranks to race on one
+    path), and seed the ``hier_intra``/``hier_cross`` comms rooflines.
+    Returns None when the world cannot form a hierarchy (the flat ring
+    keeps its self-calibrating peak-observed roofline). Collective:
+    every rank must call this at the same execution point."""
+    from horovod_tpu import comms
+    from horovod_tpu.runtime import hierarchy
+
+    plan = hierarchy.build_plan(
+        net, getattr(config, "hierarchy_group_size", 0))
+    if not plan.enabled:
+        return None
+    cached = load_cached_roofline(world=net.world)
+    if cached is not None and cached.get("hier_intra_busbw_gbps") \
+            and cached.get("hier_cross_busbw_gbps"):
+        measured = {
+            "hier_intra_busbw_gbps": float(
+                cached["hier_intra_busbw_gbps"]),
+            "hier_cross_busbw_gbps": float(
+                cached["hier_cross_busbw_gbps"]),
+            "cached": True,
+        }
+    else:
+        measured = probe_hier_hops(net, plan)
+        measured["cached"] = False
+        path = _cache_path()
+        if path and net.rank == 0:
+            try:
+                _persist_roofline(path, {
+                    "schema": _CACHE_SCHEMA,
+                    "hier_intra_busbw_gbps":
+                        measured["hier_intra_busbw_gbps"],
+                    "hier_cross_busbw_gbps":
+                        measured["hier_cross_busbw_gbps"],
+                    "world": net.world,
+                    "wall_time": time.time(),
+                })
+            except OSError as exc:
+                log.warning("probe cache not persisted to %s: %s",
+                            path, exc)
+    source = "probe_cache" if measured["cached"] else "probe"
+    comms.tracker().seed_roofline(
+        "hier_intra", measured["hier_intra_busbw_gbps"], source=source)
+    comms.tracker().seed_roofline(
+        "hier_cross", measured["hier_cross_busbw_gbps"], source=source)
     return measured

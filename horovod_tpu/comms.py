@@ -88,7 +88,8 @@ _SAMPLE_RING = 512   # bounded per-record trail for the trace counter track
 _WARMUP_OPS = 8      # lane records before the degradation detector arms
 _TOP_KEYS = 32       # (op, lane, bucket) rows surfaced in the ledger
 
-LANES = ("device", "host_ring", "spmd", "zero", "bucket_wire", "kv")
+LANES = ("device", "host_ring", "spmd", "zero", "bucket_wire", "kv",
+         "hier_intra", "hier_cross")
 
 _ALGBW = _metrics().gauge(
     "horovod_comms_algbw_gbs",
@@ -421,6 +422,17 @@ def configure(rank: Optional[int] = None,
             for lane in ("device", "spmd"):
                 t.seed_roofline(lane, roofline["allreduce_busbw_gbps"],
                                 source="probe_cache")
+        if roofline:
+            # schema-2 artifacts carry separately-probed hierarchy hops:
+            # the fast intra-group lane and the (possibly throttled)
+            # cross-group lane have very different rooflines, and folding
+            # both under one number would blind the degradation detector
+            # on whichever hop it mis-bounds
+            for lane, key in (("hier_intra", "hier_intra_busbw_gbps"),
+                              ("hier_cross", "hier_cross_busbw_gbps")):
+                if roofline.get(key):
+                    t.seed_roofline(lane, roofline[key],
+                                    source="probe_cache")
     except Exception:
         pass  # a stale/corrupt artifact must not break init
     from horovod_tpu import flight_recorder
@@ -429,6 +441,30 @@ def configure(rank: Optional[int] = None,
         flight_recorder.set_state_provider("comms", t.ledger)
     else:
         flight_recorder.set_state_provider("comms", None)
+
+
+_DATA_LANES = frozenset(("device", "host_ring", "spmd", "zero",
+                         "bucket_wire", "hier_intra", "hier_cross"))
+
+
+def data_lane_busbw_gbs() -> Optional[float]:
+    """Byte-weighted smoothed bus bandwidth (GB/s) across the training
+    data-plane lanes (the serving ``kv`` lane is excluded). This is the
+    autotuner's wire-utilization score component; ``None`` until a
+    data-plane collective has been recorded."""
+    t = _tracker
+    with t._lock:
+        lane_bytes: Dict[str, float] = {}
+        for (op, lane), tot in t._totals.items():
+            if lane in _DATA_LANES:
+                lane_bytes[lane] = lane_bytes.get(lane, 0.0) + tot[0]
+        num = den = 0.0
+        for lane, nbytes in lane_bytes.items():
+            ewma = t._lane_ewma.get(lane)
+            if ewma and nbytes > 0:
+                num += ewma * nbytes
+                den += nbytes
+    return (num / den) if den > 0 else None
 
 
 def comms_state() -> dict:

@@ -756,7 +756,9 @@ extern "C" {
 // refuses to drive a stale prebuilt .so whose symbols still resolve but
 // whose ABI differs — e.g. the op argument added to the ring kernels).
 // v3: full data mesh + true reduce-scatter / pairwise alltoall kernels.
-int hvdnet_abi_version() { return 4; }
+// v5: generic point-to-point sendrecv over the mesh (the hierarchical
+// host collectives compose subgroup rings from it in Python).
+int hvdnet_abi_version() { return 5; }
 
 void* hvdnet_init(int rank, int world, const char* coord_host, int coord_port,
                   int timeout_ms) {
@@ -909,6 +911,35 @@ int hvdnet_reducescatter_i64(void* h, int64_t* data, uint64_t count, int op,
                              int64_t* out) {
   return ring_reducescatter_t<int64_t>(static_cast<Comm*>(h), data, count,
                                        op, out);
+}
+
+// Generic point-to-point exchange over the full data mesh: send `sn`
+// bytes to `send_peer` while receiving `rn` bytes from `recv_peer`
+// (full-duplex, same progress engine as the ring steps — a blocking
+// one-direction-at-a-time send would deadlock symmetric exchanges whose
+// payload exceeds the kernel socket buffers). Either direction may be
+// zero-length (pure send / pure recv). Both sides of a transfer must
+// agree on the byte count; framing is the caller's contract, exactly as
+// in the ring kernels. The hierarchical host collectives compose
+// intra-group and cross-group rings from this verb in Python so the
+// slow hop can be compressed and fault-injected independently.
+int hvdnet_sendrecv(void* h, int send_peer, const void* sbuf, uint64_t sn,
+                    int recv_peer, void* rbuf, uint64_t rn) {
+  Comm* c = static_cast<Comm*>(h);
+  const int w = c->world;
+  int sfd = -1, rfd = -1;
+  if (sn > 0) {
+    if (send_peer < 0 || send_peer >= w || send_peer == c->rank) return -1;
+    sfd = c->mesh[send_peer];
+    if (sfd < 0) return -1;
+  }
+  if (rn > 0) {
+    if (recv_peer < 0 || recv_peer >= w || recv_peer == c->rank) return -1;
+    rfd = c->mesh[recv_peer];
+    if (rfd < 0) return -1;
+  }
+  if (sn == 0 && rn == 0) return 0;
+  return duplex_exchange(&c->counters, sfd, sbuf, sn, rfd, rbuf, rn);
 }
 
 // Pairwise all-to-all: `in` holds world equal chunks of chunk_bytes

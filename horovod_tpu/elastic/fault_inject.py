@@ -96,21 +96,30 @@ def parse_spec(text: str) -> FaultSpec:
                      generation=fields["gen"])
 
 
-def spec_from_env() -> Optional[FaultSpec]:
-    """First process-fault clause of the (possibly composite) env spec.
+def specs_from_env() -> tuple:
+    """Every process-fault clause of the (possibly composite) env spec —
+    a multi-rank chaos cell arms one kill per target rank, and each
+    worker must see the clause naming ITS rank, not just the first.
     Network-fault clauses (partition/kv_outage/flaky/netdelay) belong to
     ``utils.resilience`` and data-corruption clauses (bitflip/nan) to
     ``integrity.inject``; both are skipped here, not rejected."""
     from horovod_tpu.integrity import inject as _integrity_inject
     from horovod_tpu.utils import resilience
 
+    specs = []
     for clause in os.environ.get(HOROVOD_FAULT_INJECT, "").split(";"):
         clause = clause.strip()
         if not clause or resilience.is_net_clause(clause) \
                 or _integrity_inject.is_integrity_clause(clause):
             continue
-        return parse_spec(clause)
-    return None
+        specs.append(parse_spec(clause))
+    return tuple(specs)
+
+
+def spec_from_env() -> Optional[FaultSpec]:
+    """First process-fault clause (see :func:`specs_from_env`)."""
+    specs = specs_from_env()
+    return specs[0] if specs else None
 
 
 def initial_rank() -> int:
@@ -128,12 +137,14 @@ def maybe_inject(step: int, rank: Optional[int] = None,
 
     ``kill`` and ``hang`` fire exactly at ``spec.step``; ``slow`` fires at
     every step >= ``spec.step`` (a persistent straggler)."""
-    global _slow_announced
-    spec = spec_from_env()
-    if spec is None:
-        return
     if rank is None:
         rank = initial_rank()
+    for spec in specs_from_env():
+        _fire(spec, step, rank, generation)
+
+
+def _fire(spec: FaultSpec, step: int, rank: int, generation: int) -> None:
+    global _slow_announced
     if rank != spec.rank or generation != spec.generation:
         return
     if spec.action == "slow":

@@ -112,12 +112,24 @@ def release_enabled() -> bool:
     return env_mod._get_bool("HOROVOD_GRAD_BUCKET_RELEASE", False)
 
 
+# Autotuner override (runtime._autotune_sync): applies to release plans
+# built AFTER the commit — an existing plan keeps its partition, since
+# repartitioning mid-training would recompile every bucket program.
+_autotuned_bucket_bytes = 0
+
+
+def set_autotuned_bucket_bytes(nbytes: int) -> None:
+    global _autotuned_bucket_bytes
+    _autotuned_bucket_bytes = max(0, int(nbytes))
+
+
 def bucket_bytes_from_env() -> int:
-    """Target bucket payload: ``HOROVOD_GRAD_BUCKET_BYTES`` rounded up
+    """Target bucket payload: ``HOROVOD_GRAD_BUCKET_BYTES`` (or the
+    autotuner's committed override, which wins while set) rounded up
     to a whole number of fusion quanta so bucket payloads land on the
     PR-3 size-bucket grid (zero steady-state compiles)."""
-    raw = env_mod._get_int("HOROVOD_GRAD_BUCKET_BYTES",
-                           DEFAULT_GRAD_BUCKET_BYTES)
+    raw = _autotuned_bucket_bytes or env_mod._get_int(
+        "HOROVOD_GRAD_BUCKET_BYTES", DEFAULT_GRAD_BUCKET_BYTES)
     quantum = env_mod._get_int(env_mod.HOROVOD_FUSION_BUCKET_QUANTUM,
                                env_mod.DEFAULT_FUSION_BUCKET_QUANTUM_BYTES)
     quantum = max(1, quantum)

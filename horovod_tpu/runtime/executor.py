@@ -363,6 +363,11 @@ class Executor:
                                if quantum is not None
                                else FusionBufferManager())
         self._ag_staging = bytearray()  # allgather wire staging (reused)
+        # two-level host-collective group plan, memoized per (net,
+        # world, rank, knob) — elastic re-forms swap the NetComm, which
+        # invalidates the key so groups are recomputed for the new world
+        self._hier_plan = None       # guarded-by: <cycle-thread>
+        self._hier_plan_key = None   # guarded-by: <cycle-thread>
         with _executors_lock:
             _executors.add(self)
         # Multi-process with a global mesh (jax.distributed): the hot op
@@ -502,10 +507,80 @@ class Executor:
         return total
 
     def hierarchical_available(self) -> bool:
-        """Two-level collectives need both mesh axes populated (reference
-        gates hierarchical on topology, nccl_operations.cc:348-355)."""
+        """Two-level collectives need both topology axes populated
+        (reference gates hierarchical on topology,
+        nccl_operations.cc:348-355). On the multiprocess host-ring data
+        plane the topology is the rank grouping, NOT the stacked device
+        mesh — the old mesh-only check meant a two-host host-ring job
+        never saw its hierarchical knobs join the autotune sweep. This
+        is a static predicate (no wire traffic): an explicit group size
+        must tile the world into >= 2 groups of >= 2; with auto (host-
+        derived) grouping any world >= 4 COULD split, so the knob is
+        sweepable and a flat-resolving plan simply makes it a no-op."""
+        if self.net is not None and not self._spmd_world:
+            w = self.net.world
+            if w < 4:
+                return False
+            g = self._hier_group_size()
+            return g == 0 or (g >= 2 and w % g == 0 and w // g >= 2)
         cross, local = self.mesh.devices.shape
         return cross > 1 and local > 1
+
+    def _hier_group_size(self) -> int:
+        """The HOROVOD_HIERARCHY_GROUP_SIZE knob (0 = host-derived),
+        autotuner-writable through the synced config."""
+        try:
+            from horovod_tpu.core import state as state_mod
+
+            return int(state_mod.global_state()
+                       .config.hierarchy_group_size or 0)
+        except Exception:
+            return 0
+
+    def _hierarchy_plan(self):
+        """Memoized group plan for the host-ring data plane; None when
+        hierarchy is off (knob disabled) or the plan resolves flat.
+        Host-derived formation runs one roster allgatherv — safe here
+        because dispatch order is negotiated, so every rank builds the
+        plan at the same point in its wire-op sequence."""
+        net = self.net
+        if net is None:
+            return None
+        from horovod_tpu.core import state as state_mod
+
+        cfg = state_mod.global_state().config
+        if not cfg.hierarchical_allreduce:
+            return None
+        gsize = int(cfg.hierarchy_group_size or 0)
+        key = (id(net), net.world, net.rank, gsize)
+        if self._hier_plan_key != key:
+            from horovod_tpu.runtime import hierarchy
+
+            plan = hierarchy.build_plan(net, gsize)
+            self._hier_plan = plan
+            self._hier_plan_key = key
+            if plan.enabled:
+                flight_recorder.emit(
+                    "hierarchy_plan", groups=plan.num_groups,
+                    group_size=plan.group_size, source=plan.source,
+                    world=plan.world)
+        plan = self._hier_plan
+        return plan if (plan is not None and plan.enabled) else None
+
+    def _hier_wire_dtype(self):
+        """Numpy wire dtype for the compressed cross-group hop (None =
+        full precision), from HOROVOD_HIERARCHY_COMPRESSION."""
+        from horovod_tpu.core import state as state_mod
+        from horovod_tpu.runtime import hierarchy
+
+        try:
+            name = state_mod.global_state().config.hierarchy_compression
+        except Exception:
+            return None
+        try:
+            return hierarchy.wire_dtype_from_name(name)
+        except ValueError:
+            return None
 
     def execute(self, response, entries: List[types.TensorTableEntry],
                 timeline=None) -> None:
@@ -791,12 +866,17 @@ class Executor:
         bucket-sized and sliced to the exact payload."""
         import numpy as np
 
-        # chaos seam on the DATA plane (the ctrl/kv seams cover only the
-        # control plane): HOROVOD_FAULT_INJECT=netdelay:... slows the
-        # ring pass itself, so the comms plane's host_ring busbw visibly
-        # degrades (docs/comms.md, docs/robustness.md)
-        resilience.inject("ring", "allreduce")
         world = self.net.world
+        hier_plan = self._hierarchy_plan()
+        if hier_plan is None:
+            # chaos seam on the DATA plane (the ctrl/kv seams cover only
+            # the control plane): HOROVOD_FAULT_INJECT=netdelay:... slows
+            # the ring pass itself, so the comms plane's host_ring busbw
+            # visibly degrades (docs/comms.md, docs/robustness.md). A
+            # flat ring's 2(w-1) exchange steps each cross the slow
+            # group boundary, so a hop=cross netdelay taxes all of them.
+            resilience.inject("ring", "allreduce",
+                              crossings=2 * (world - 1))
         arrays = [np.asarray(e.tensor) for e in entries]
         # narrow types have no native host-ring kernels; widen for the wire
         wire = [_widen_for_ring(a) for a in arrays]
@@ -827,7 +907,21 @@ class Executor:
                 timeline.activity_start(entries[0].name,
                                         "NET_RING_ALLREDUCE")
             reduce_op = entries[0].reduce_op
-            self.net.allreduce(buf, _RING_OP[reduce_op])
+            if hier_plan is not None:
+                # two-level path: intra reduce-scatter -> cross exchange
+                # over 1/g of the bytes (optionally 16-bit on the wire)
+                # -> intra allgather. nf_in above was computed on the
+                # uncompressed input and checksum below on the
+                # decompressed result, so integrity verdicts are
+                # independent of the wire precision (pre-compression
+                # digests, the PR 10 contract).
+                from horovod_tpu.runtime import hierarchy
+
+                hierarchy.hier_allreduce(
+                    self.net, hier_plan, buf, _RING_OP[reduce_op],
+                    wire_dtype=self._hier_wire_dtype())
+            else:
+                self.net.allreduce(buf, _RING_OP[reduce_op])
             if timeline is not None:
                 timeline.activity_end(entries[0].name)
             if reduce_op == types.REDUCE_AVERAGE:
@@ -1010,8 +1104,13 @@ class Executor:
         coincide exactly with the leading-axis shards."""
         import numpy as np
 
-        resilience.inject("ring", "reducescatter")
         world = self.net.world
+        hier_plan = self._hierarchy_plan()
+        if hier_plan is None:
+            # flat half-ring: (w-1) steps, each crossing the slow group
+            # boundary (see _execute_allreduce_host on the seam)
+            resilience.inject("ring", "reducescatter",
+                              crossings=world - 1)
         from horovod_tpu.integrity import digest as integ_digest
 
         if self._integrity_due():
@@ -1027,8 +1126,21 @@ class Executor:
         for e in entries:
             a = np.asarray(e.tensor)
             wire = _widen_for_ring(a, copy=True)  # consumed as scratch
-            chunk = self.net.reducescatter(wire.ravel(),
-                                           _RING_OP[e.reduce_op])
+            if hier_plan is not None and wire.size % world == 0:
+                # two-level reduce-scatter: j-major permutation + intra
+                # RS + cross RS over 1/g of the bytes, same flat-chunk
+                # output convention as the native kernel (ZeRO's shard
+                # streams keep size % world == 0; ragged payloads fall
+                # back to the flat ring per entry)
+                from horovod_tpu.runtime import hierarchy
+
+                chunk = hierarchy.hier_reducescatter(
+                    self.net, hier_plan, wire.ravel(),
+                    _RING_OP[e.reduce_op],
+                    wire_dtype=self._hier_wire_dtype())
+            else:
+                chunk = self.net.reducescatter(wire.ravel(),
+                                               _RING_OP[e.reduce_op])
             shard = a.shape[0] // world
             out = chunk.reshape((shard,) + a.shape[1:])
             if e.reduce_op == types.REDUCE_AVERAGE:

@@ -1,0 +1,317 @@
+"""Topology-aware two-level host collectives (hierarchical ring).
+
+Reference Horovod's cross-node scaling trick is hierarchical allreduce
+(NCCLHierarchicalAllreduce, nccl_operations.cc): reduce-scatter inside
+the fast intra-node domain, run the only cross-node exchange over 1/g of
+the bytes, then allgather the result back inside the node. This module
+is the host-ring port of that decomposition: ranks are grouped into
+slices — an explicit ``HOROVOD_HIERARCHY_GROUP_SIZE`` of contiguous
+ranks, or host-derived from the rendezvous roster's hostnames — and the
+three phases are composed from the native mesh's point-to-point
+``sendrecv`` verb (every rank pair already holds a socket), so the slow
+cross-group hop can be independently
+
+  * compressed: the seed's ``compression.py`` wire dtypes (bf16 / IEEE
+    f16) applied to JUST the cross hop — 1/g of the bytes at half
+    precision on the slow link, full precision on the fast one
+    (reference: fp16 compression halves MPI bytes, half.cc), and
+  * fault-injected: ``HOROVOD_FAULT_INJECT=netdelay:<ms>:hop=cross``
+    taxes only seams that declare slow-link crossings, so a simulated
+    DCN penalizes each path by the traffic it actually puts there.
+
+Numerical contract: with compression OFF the two-level sum is exact
+whenever the flat ring's is (integer payloads; floats whose partial sums
+are exactly representable) — fp addition is non-associative, so on
+general float data the two paths agree only to rounding error, and the
+parity tests pin bit-equality on exactly-representable values only.
+With compression ON, every rank still ends bit-identical to its peers
+(the cross hop's allgather phase distributes one set of wire bytes per
+chunk), so the PR 10 cross-rank checksum agreement stays meaningful;
+the error vs the uncompressed result is bounded by the wire dtype's
+rounding (asserted in tests/test_hierarchy_plan.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from horovod_tpu import comms
+from horovod_tpu.utils import logging as log
+from horovod_tpu.utils import resilience
+
+# ring-kernel op name -> in-place numpy combiner (matches RedOp in
+# cpp/net.cc; "average" never reaches here — the executor divides after
+# assembly, exactly as on the flat ring)
+_COMBINE = {
+    "sum": np.add,
+    "min": np.minimum,
+    "max": np.maximum,
+    "product": np.multiply,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class HierarchyPlan:
+    """One rank's view of the two-level grouping.
+
+    ``members`` is this rank's slice in ring order; ``cross_members``
+    holds the one rank per slice sharing this rank's ``local_index`` —
+    the slow-hop ring. A degenerate plan (``group_size`` or
+    ``num_groups`` of 1) means the topology offers no hierarchy and the
+    flat ring should be used; ``enabled`` gates that."""
+
+    world: int
+    rank: int
+    group_size: int          # g: ranks per slice
+    num_groups: int          # G: slices
+    members: Tuple[int, ...]         # my slice, ring order
+    cross_members: Tuple[int, ...]   # same-local-index ranks, ring order
+    group_index: int         # which slice I'm in = my cross-ring position
+    local_index: int         # my position within the slice
+    source: str              # "env" | "hosts" | "flat"
+
+    @property
+    def enabled(self) -> bool:
+        return self.group_size > 1 and self.num_groups > 1
+
+    def describe(self) -> str:
+        return (f"{self.num_groups}x{self.group_size} ({self.source}); "
+                f"rank {self.rank} = group {self.group_index} "
+                f"slot {self.local_index}")
+
+
+def _flat(world: int, rank: int) -> HierarchyPlan:
+    return HierarchyPlan(world, rank, 1, world, (rank,),
+                         tuple(range(world)), rank, 0, "flat")
+
+
+def build_plan(net, group_size: int = 0) -> HierarchyPlan:
+    """Form groups for ``net``'s world. An explicit ``group_size`` takes
+    contiguous rank blocks with no wire traffic; ``group_size == 0``
+    derives groups from the rendezvous roster's hostnames (one
+    allgatherv — the launcher exports ``HOROVOD_HOSTNAME`` to every
+    rank, run/hosts.py). Uneven or degenerate topologies fall back to a
+    flat plan with a warning: the decomposition needs equal-size groups
+    (the cross ring pairs one member per slice at each slot)."""
+    w, r = net.world, net.rank
+    if w < 4:
+        return _flat(w, r)  # two levels need >= 2 groups of >= 2
+    if group_size:
+        g = int(group_size)
+        if g < 2 or w % g or w // g < 2:
+            log.warning(
+                "hierarchy: HOROVOD_HIERARCHY_GROUP_SIZE=%d does not "
+                "tile world %d into >=2 equal groups of >=2 — flat ring",
+                g, w)
+            return _flat(w, r)
+        gi, j = divmod(r, g)
+        return HierarchyPlan(
+            w, r, g, w // g, tuple(range(gi * g, (gi + 1) * g)),
+            tuple(k * g + j for k in range(w // g)), gi, j, "env")
+    # host-derived: group ranks sharing a hostname (the real slow-link
+    # boundary). One collective, memoized by the executor per (net,
+    # world) so elastic re-forms recompute it for the new roster.
+    host = os.environ.get("HOROVOD_HOSTNAME") or socket.gethostname()
+    hosts = [b.decode("utf-8", "replace") for b in
+             net.allgatherv(host.encode())]
+    by_host = {}
+    for rr, h in enumerate(hosts):
+        by_host.setdefault(h, []).append(rr)
+    groups = sorted(by_host.values(), key=lambda m: m[0])
+    sizes = {len(m) for m in groups}
+    if len(groups) < 2 or len(sizes) != 1 or next(iter(sizes)) < 2:
+        return _flat(w, r)
+    g = len(groups[0])
+    gi = next(i for i, m in enumerate(groups) if r in m)
+    j = groups[gi].index(r)
+    return HierarchyPlan(
+        w, r, g, len(groups), tuple(groups[gi]),
+        tuple(m[j] for m in groups), gi, j, "hosts")
+
+
+# ---------------------------------------------------------------------------
+# ring primitives over sendrecv (subgroup analogues of the cpp/net.cc
+# full-world kernels; same chunk conventions)
+# ---------------------------------------------------------------------------
+
+def _cb(n: int, k: int, i: int) -> int:
+    """Chunk boundary i of n elements over k near-equal chunks — the
+    same split as the native ring kernels, so empty chunks (n < k)
+    no-op consistently on both ends of every exchange."""
+    return n * i // k
+
+
+def _ring_reduce_scatter(net, ring: Sequence[int], pos: int,
+                         buf: np.ndarray, op: str) -> Tuple[int, int]:
+    """In-place ring reduce-scatter over ``ring``; afterwards chunk
+    ``pos`` of ``buf`` holds the fully ring-reduced values (the native
+    kernel's shifted-by-one convention). Returns the owned chunk's
+    [begin, end). Non-owned chunks are left holding partial sums."""
+    k = len(ring)
+    n = buf.size
+    if k == 1:
+        return 0, n
+    comb = _COMBINE[op]
+    nxt, prv = ring[(pos + 1) % k], ring[(pos - 1) % k]
+    max_chunk = max(_cb(n, k, i + 1) - _cb(n, k, i) for i in range(k))
+    recv = np.empty(max_chunk, buf.dtype)
+    for step in range(k - 1):
+        sc = (pos - step - 1) % k
+        rc = (pos - step - 2) % k
+        sb, se = _cb(n, k, sc), _cb(n, k, sc + 1)
+        rb, re = _cb(n, k, rc), _cb(n, k, rc + 1)
+        net.sendrecv(nxt, buf[sb:se], prv, recv[:re - rb])
+        if re > rb:
+            comb(buf[rb:re], recv[:re - rb], out=buf[rb:re])
+    return _cb(n, k, pos), _cb(n, k, pos + 1)
+
+
+def _ring_allgather(net, ring: Sequence[int], pos: int,
+                    buf: np.ndarray) -> None:
+    """In-place ring allgather over ``ring``: chunk ``pos`` (this rank's,
+    per the reduce-scatter convention) is distributed until every member
+    holds all k chunks. Receives land directly in ``buf``."""
+    k = len(ring)
+    if k == 1:
+        return
+    n = buf.size
+    nxt, prv = ring[(pos + 1) % k], ring[(pos - 1) % k]
+    for step in range(k - 1):
+        sc = (pos - step) % k
+        rc = (pos - step - 1) % k
+        sb, se = _cb(n, k, sc), _cb(n, k, sc + 1)
+        rb, re = _cb(n, k, rc), _cb(n, k, rc + 1)
+        net.sendrecv(nxt, buf[sb:se], prv, buf[rb:re])
+
+
+def _ring_allreduce(net, ring: Sequence[int], pos: int,
+                    buf: np.ndarray, op: str) -> None:
+    """In-place ring allreduce (reduce-scatter + allgather) over
+    ``ring`` — 2(k-1) exchange steps, the cross hop's kernel."""
+    _ring_reduce_scatter(net, ring, pos, buf, op)
+    _ring_allgather(net, ring, pos, buf)
+
+
+# ---------------------------------------------------------------------------
+# two-level collectives
+# ---------------------------------------------------------------------------
+
+def hier_allreduce(net, plan: HierarchyPlan, buf: np.ndarray, op: str,
+                   wire_dtype=None) -> np.ndarray:
+    """Two-level in-place allreduce on a contiguous 1-D host array:
+    intra-group reduce-scatter -> cross-group ring allreduce over only
+    this rank's 1/g chunk (cast to ``wire_dtype`` for the slow hop when
+    given and the payload is floating) -> intra-group allgather.
+    Averaging stays with the caller — the executor divides after
+    assembly, exactly as on the flat ring."""
+    g, big_g = plan.group_size, plan.num_groups
+    t0 = time.perf_counter()
+    resilience.inject("hier_intra", "reducescatter", crossings=0)
+    b, e = _ring_reduce_scatter(net, plan.members, plan.local_index,
+                                buf, op)
+    t1 = time.perf_counter()
+    comms.record("reducescatter", "hier_intra", buf.nbytes, t1 - t0,
+                 world=g)
+    chunk = buf[b:e]
+    # every step of the cross ring crosses the slow group boundary:
+    # 2(G-1) exchanges for the allreduce
+    resilience.inject("hier_cross", "allreduce",
+                      crossings=2 * (big_g - 1))
+    if wire_dtype is not None and chunk.dtype.kind == "f" \
+            and chunk.size and np.dtype(wire_dtype) != chunk.dtype:
+        # the compression hop: wire bytes halve; accumulation happens in
+        # the wire dtype (the reference's fp16-MPI semantics, half.cc) —
+        # all cross peers end with identical wire bytes, so cross-rank
+        # digests still agree after decompression
+        wire = np.ascontiguousarray(chunk.astype(wire_dtype))
+        _ring_allreduce(net, plan.cross_members, plan.group_index,
+                        wire, op)
+        chunk[...] = wire.astype(chunk.dtype)
+        cross_bytes = wire.nbytes
+    else:
+        _ring_allreduce(net, plan.cross_members, plan.group_index,
+                        chunk, op)
+        cross_bytes = chunk.nbytes
+    t2 = time.perf_counter()
+    if cross_bytes:
+        comms.record("allreduce", "hier_cross", cross_bytes, t2 - t1,
+                     world=big_g)
+    resilience.inject("hier_intra", "allgather", crossings=0)
+    _ring_allgather(net, plan.members, plan.local_index, buf)
+    comms.record("allgather", "hier_intra", buf.nbytes,
+                 time.perf_counter() - t2, world=g)
+    return buf
+
+
+def hier_reducescatter(net, plan: HierarchyPlan, arr: np.ndarray,
+                       op: str, wire_dtype=None) -> np.ndarray:
+    """Two-level reduce-scatter with the flat ring's output convention:
+    rank r receives flat chunk r. Requires ``arr.size % world == 0``
+    (ZeRO's shard streams guarantee it; the executor falls back to the
+    flat ring otherwise).
+
+    Layout: flat chunk i belongs to rank i = (i // g, i % g). The
+    j-major permutation ``reshape(G, g, c).transpose(1, 0, 2)`` makes
+    the G chunks destined for slice slot j contiguous, so the intra
+    reduce-scatter hands slot j its superchunk and the cross
+    reduce-scatter (G-1 slow-link steps over 1/g of the bytes) carves
+    out exactly flat chunk ``group_index * g + local_index``."""
+    w, g, big_g = plan.world, plan.group_size, plan.num_groups
+    n = arr.size
+    if n % w:
+        raise ValueError(
+            f"hier_reducescatter needs size % world == 0, got {n} % {w}")
+    c = n // w
+    work = np.ascontiguousarray(
+        arr.reshape(big_g, g, c).transpose(1, 0, 2)).reshape(-1)
+    t0 = time.perf_counter()
+    resilience.inject("hier_intra", "reducescatter", crossings=0)
+    b, e = _ring_reduce_scatter(net, plan.members, plan.local_index,
+                                work, op)
+    t1 = time.perf_counter()
+    comms.record("reducescatter", "hier_intra", work.nbytes, t1 - t0,
+                 world=g)
+    sup = work[b:e]
+    resilience.inject("hier_cross", "reducescatter",
+                      crossings=big_g - 1)
+    if wire_dtype is not None and sup.dtype.kind == "f" \
+            and sup.size and np.dtype(wire_dtype) != sup.dtype:
+        wire = np.ascontiguousarray(sup.astype(wire_dtype))
+        b2, e2 = _ring_reduce_scatter(net, plan.cross_members,
+                                      plan.group_index, wire, op)
+        out = wire[b2:e2].astype(sup.dtype)
+        cross_bytes = wire.nbytes
+    else:
+        b2, e2 = _ring_reduce_scatter(net, plan.cross_members,
+                                      plan.group_index, sup, op)
+        out = sup[b2:e2].copy()
+        cross_bytes = sup.nbytes
+    if cross_bytes:
+        comms.record("reducescatter", "hier_cross", cross_bytes,
+                     time.perf_counter() - t1, world=big_g)
+    return out
+
+
+def wire_dtype_from_name(name: str) -> Optional[np.dtype]:
+    """Map the ``HOROVOD_HIERARCHY_COMPRESSION`` knob to the numpy wire
+    dtype for the slow hop (the host-side counterparts of
+    ``compression.Compression``'s jnp wire dtypes). ``none``/empty
+    disables compression; unknown names raise."""
+    name = (name or "none").strip().lower()
+    if name in ("", "none", "off", "0", "false"):
+        return None
+    if name in ("fp16", "bf16", "bfloat16"):
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if name in ("ieee_fp16", "float16", "f16"):
+        return np.dtype(np.float16)
+    raise ValueError(
+        f"unknown HOROVOD_HIERARCHY_COMPRESSION {name!r} "
+        "(expected none | fp16 | ieee_fp16)")

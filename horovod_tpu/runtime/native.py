@@ -101,7 +101,7 @@ def _load_locked(build_if_missing: bool):
     return lib
 
 
-_ABI_VERSION = 4  # must match hvdnet_abi_version() in cpp/net.cc
+_ABI_VERSION = 5  # must match hvdnet_abi_version() in cpp/net.cc
 
 
 def _bind_symbols(lib) -> None:
@@ -147,6 +147,9 @@ def _bind_symbols(lib) -> None:
                        ctypes.c_int, ctypes.c_void_p]
     lib.hvdnet_alltoall.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                     ctypes.c_void_p, ctypes.c_uint64]
+    lib.hvdnet_sendrecv.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64]
     lib.hvdnet_data_bytes_sent.restype = ctypes.c_uint64
     lib.hvdnet_data_bytes_sent.argtypes = [ctypes.c_void_p]
     lib.hvdnet_exchange_calls.restype = ctypes.c_uint64
@@ -466,6 +469,32 @@ class NetComm:
                 "alltoall failed (peer closed or "
                 "transport lost)")
         return out
+
+    def sendrecv(self, send_peer: int, send_buf: Optional[np.ndarray],
+                 recv_peer: int, recv_buf: Optional[np.ndarray]) -> None:
+        """Full-duplex point-to-point exchange over the data mesh: send
+        ``send_buf``'s bytes to ``send_peer`` while filling ``recv_buf``
+        from ``recv_peer``. Either side may be ``None``/empty (pure send
+        or pure recv). Both ends of a transfer must agree on the byte
+        count — framing is the caller's contract, as in the ring kernels.
+        The hierarchical host collectives (runtime/hierarchy.py) compose
+        subgroup rings from this verb."""
+        sn = 0 if send_buf is None else send_buf.nbytes
+        rn = 0 if recv_buf is None else recv_buf.nbytes
+        if sn:
+            send_buf = np.ascontiguousarray(send_buf)
+        sp = (send_buf.ctypes.data_as(ctypes.c_void_p) if sn else None)
+        if rn and not recv_buf.flags["C_CONTIGUOUS"]:
+            raise ValueError("sendrecv recv_buf must be contiguous "
+                             "(received bytes land in place)")
+        rp = (recv_buf.ctypes.data_as(ctypes.c_void_p) if rn else None)
+        with self._lock:
+            rc = self._lib.hvdnet_sendrecv(
+                self._h, send_peer, sp, sn, recv_peer, rp, rn)
+        if rc != 0:
+            raise WorkerLostError(
+                "sendrecv failed (peer closed or "
+                "transport lost)")
 
     def _allgatherv_raw(self, blob: bytes, cap: int) -> List[bytes]:
         lens = (ctypes.c_uint64 * self.world)()

@@ -52,6 +52,16 @@ _PIPELINE_DEPTH = _metrics().gauge(
     "horovod_cycle_pipeline_depth",
     "Responses currently in flight on the pipelined data plane (bounded "
     "by HOROVOD_CYCLE_PIPELINE_DEPTH).")
+_AUTOTUNE_PARAM = _metrics().gauge(
+    "horovod_autotune_param",
+    "Runtime parameter value most recently committed by the per-cycle "
+    "autotune sync, by knob (string-valued knobs are encoded: the "
+    "hierarchy codec reports its COMPRESSION_CODECS index).",
+    labelnames=("knob",))
+_AUTOTUNE_COMMITS = _metrics().counter(
+    "horovod_autotune_commits_total",
+    "Parameter-blob changes applied at a cycle boundary (the broadcast "
+    "blob differed from the previously applied one).")
 
 
 class RuntimeHandle:
@@ -201,12 +211,30 @@ class Runtime:
             host_ring_data_plane = (net is not None
                                     and not self.executor._spmd_world)
             if host_ring_data_plane:
+                # the XLA-mesh probe cannot measure the socket plane,
+                # but the hierarchy hops CAN be probed over the live
+                # sockets themselves (collective: every rank takes this
+                # branch — the predicate is env-derived and identical
+                # fleet-wide)
+                from horovod_tpu.autotune.probe import (
+                    probe_host_hier_and_seed)
+
+                hier_probe = probe_host_hier_and_seed(net, st.config)
                 if self.controller.is_coordinator:
-                    log.warning(
-                        "HOROVOD_AUTOTUNE_PROBE ignored: the host TCP "
-                        "data plane is active and the XLA-mesh probe "
-                        "does not measure it; tuning starts from the "
-                        "default threshold")
+                    if hier_probe is not None:
+                        log.info(
+                            "autotune probe (socket hierarchy): intra "
+                            "%.2f GB/s, cross %.2f GB/s busbw%s",
+                            hier_probe["hier_intra_busbw_gbps"],
+                            hier_probe["hier_cross_busbw_gbps"],
+                            " (cached)" if hier_probe["cached"] else "")
+                    else:
+                        log.warning(
+                            "HOROVOD_AUTOTUNE_PROBE ignored: the host "
+                            "TCP data plane is active, the XLA-mesh "
+                            "probe does not measure it, and the world "
+                            "cannot form a hierarchy to probe; tuning "
+                            "starts from the default threshold")
             else:
                 from horovod_tpu.autotune.probe import probe_and_seed
 
@@ -235,22 +263,50 @@ class Runtime:
                     st.config.fusion_threshold_bytes = agreed
         if self._autotune_active and self.controller.is_coordinator:
             from horovod_tpu.autotune.parameter_manager import (
-                ParameterManager, Params)
+                ParameterManager, Params, normalize_codec,
+                search_box_from_roofline)
+            from horovod_tpu.parallel import buckets as buckets_mod
 
             initial = Params(
                 fusion_threshold_bytes=st.config.fusion_threshold_bytes,
                 cycle_time_ms=st.config.cycle_time_ms,
                 cache_enabled=self.controller.cache_enabled,
                 hierarchical_allreduce=st.config.hierarchical_allreduce,
-                hierarchical_allgather=st.config.hierarchical_allgather)
+                hierarchical_allgather=st.config.hierarchical_allgather,
+                hierarchy_group_size=st.config.hierarchy_group_size,
+                hierarchy_compression=normalize_codec(
+                    st.config.hierarchy_compression),
+                grad_bucket_bytes=buckets_mod.bucket_bytes_from_env(),
+                cycle_pipeline_depth=st.config.cycle_pipeline_depth)
             # hierarchical knobs join the sweep only where the data plane
-            # consults them: the XLA mesh path with a two-level mesh; the
-            # cache knob only when a cache exists to toggle
+            # consults them; the cache knob only when a cache exists to
+            # toggle. hierarchical_available() is a static predicate on
+            # BOTH planes now — the old gate additionally required
+            # ``controller.net is None`` (single-controller mesh), so
+            # host-ring jobs, the plane that actually grew a hierarchical
+            # lane, never swept these knobs.
             sweep = (["cache_enabled"] if st.config.cache_capacity > 0
                      else [])
-            if (getattr(self.controller, "net", None) is None
-                    and self.executor.hierarchical_available()):
-                sweep += ["hierarchical_allreduce", "hierarchical_allgather"]
+            host_ring = getattr(self.controller, "net", None) is not None
+            if self.executor.hierarchical_available():
+                sweep += ["hierarchical_allreduce"]
+                if host_ring:
+                    # the slow-hop codec only exists on the socket
+                    # hierarchy's cross-group exchange
+                    sweep += ["hierarchy_compression"]
+                else:
+                    # the allgather decomposition is mesh-plane-only
+                    sweep += ["hierarchical_allgather"]
+            # seed the continuous search box from the persisted probe
+            # rooflines (PR 16 artifact; schema 2 adds the per-hop
+            # hierarchy numbers) so BO starts inside the feasible region
+            try:
+                from horovod_tpu.autotune import probe
+
+                roofline = probe.load_cached_roofline(
+                    world=getattr(self.controller, "world", 1))
+            except Exception:
+                roofline = None
             self.param_manager = ParameterManager(
                 initial,
                 warmup_samples=st.config.autotune_warmup_samples,
@@ -258,7 +314,9 @@ class Runtime:
                 bayes_opt_max_samples=st.config.autotune_bayes_opt_max_samples,
                 gp_noise=st.config.autotune_gaussian_process_noise,
                 log_path=st.config.autotune_log, rank=st.rank,
-                sweep=tuple(sweep))
+                sweep=tuple(sweep),
+                bounds=search_box_from_roofline(roofline))
+        self._applied_params_blob: Optional[bytes] = None
         # enqueued-but-not-completed count, for the ordered-lane misuse
         # guard (ops/collectives._lane_check): covers both queued entries
         # and entries popped for execution
@@ -752,22 +810,69 @@ class Runtime:
         """Coordinator scores the cycle and broadcasts current params;
         every worker applies them at the same cycle boundary (reference:
         SynchronizeParameters, controller.cc:32-46)."""
-        from horovod_tpu.autotune.parameter_manager import Params
+        from horovod_tpu import comms
+        from horovod_tpu.autotune.parameter_manager import (
+            COMPRESSION_CODECS, Params, normalize_codec)
 
         if self.param_manager is not None:
-            self.param_manager.update(nbytes, seconds)
+            self.param_manager.update(
+                nbytes, seconds, busbw_gbs=comms.data_lane_busbw_gbs())
             blob = self.param_manager.params().pack()
             blob = self.controller.bcast_blob(blob)
         else:
             blob = self.controller.bcast_blob(None)
-        params = Params.unpack(bytes(blob))
+        blob = bytes(blob)
+        params = Params.unpack(blob)
         cfg = self._st.config
         cfg.fusion_threshold_bytes = params.fusion_threshold_bytes
         cfg.cycle_time_ms = params.cycle_time_ms
         cfg.hierarchical_allreduce = params.hierarchical_allreduce
         cfg.hierarchical_allgather = params.hierarchical_allgather
+        cfg.hierarchy_group_size = params.hierarchy_group_size
+        cfg.hierarchy_compression = params.hierarchy_compression
+        if params.cycle_pipeline_depth > 0:
+            cfg.cycle_pipeline_depth = params.cycle_pipeline_depth
+        if params.grad_bucket_bytes > 0:
+            from horovod_tpu.parallel import buckets as buckets_mod
+
+            buckets_mod.set_autotuned_bucket_bytes(params.grad_bucket_bytes)
         self._cycle_time_s = params.cycle_time_ms / 1000.0
         self.controller.cache_enabled = params.cache_enabled
+        if blob != self._applied_params_blob:
+            # commit telemetry: one flight event + a gauge refresh per
+            # applied change, on EVERY rank (the postmortem question is
+            # "what params was THIS worker running", not just rank 0's)
+            self._applied_params_blob = blob
+            codec_idx = COMPRESSION_CODECS.index(
+                normalize_codec(params.hierarchy_compression))
+            for knob, val in (
+                    ("fusion_threshold_bytes",
+                     params.fusion_threshold_bytes),
+                    ("cycle_time_ms", params.cycle_time_ms),
+                    ("cache_enabled", int(params.cache_enabled)),
+                    ("hierarchical_allreduce",
+                     int(params.hierarchical_allreduce)),
+                    ("hierarchical_allgather",
+                     int(params.hierarchical_allgather)),
+                    ("hierarchy_group_size", params.hierarchy_group_size),
+                    ("hierarchy_compression_codec", codec_idx),
+                    ("grad_bucket_bytes", params.grad_bucket_bytes),
+                    ("cycle_pipeline_depth", params.cycle_pipeline_depth),
+                    ("active", int(params.active))):
+                _AUTOTUNE_PARAM.labels(knob=knob).set(float(val))
+            _AUTOTUNE_COMMITS.inc()
+            flight_recorder.emit(
+                "autotune_commit",
+                fusion_threshold_bytes=params.fusion_threshold_bytes,
+                cycle_time_ms=round(params.cycle_time_ms, 3),
+                cache_enabled=params.cache_enabled,
+                hierarchical_allreduce=params.hierarchical_allreduce,
+                hierarchical_allgather=params.hierarchical_allgather,
+                hierarchy_group_size=params.hierarchy_group_size,
+                hierarchy_compression=params.hierarchy_compression,
+                grad_bucket_bytes=params.grad_bucket_bytes,
+                cycle_pipeline_depth=params.cycle_pipeline_depth,
+                active=params.active)
         if not params.active:
             self._autotune_active = False
 

@@ -31,6 +31,12 @@ HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+# two-level host collectives (runtime/hierarchy.py; docs/performance.md):
+# ranks per slice (0 = derive groups from the rendezvous roster's
+# hostnames) and the wire dtype of the slow cross-group hop
+# (none | fp16 (bf16 on TPU) | ieee_fp16)
+HOROVOD_HIERARCHY_GROUP_SIZE = "HOROVOD_HIERARCHY_GROUP_SIZE"
+HOROVOD_HIERARCHY_COMPRESSION = "HOROVOD_HIERARCHY_COMPRESSION"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
 HOROVOD_MESH_SHAPE = "HOROVOD_MESH_SHAPE"
@@ -237,6 +243,11 @@ class Config:
     stall_shutdown_time_seconds: float = 0.0
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # two-level host collectives: ranks per slice (0 = host-derived
+    # grouping from the rendezvous roster) and the slow-hop wire dtype
+    # (none | fp16 | ieee_fp16); autotuner-writable via the synced blob
+    hierarchy_group_size: int = 0
+    hierarchy_compression: str = "none"
     # elastic mode: stall shutdown and peer loss raise catchable
     # WorkersDownError instead of tearing the process down
     elastic: bool = False
@@ -297,6 +308,9 @@ class Config:
             ),
             hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_get_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
+            hierarchy_group_size=_get_int(HOROVOD_HIERARCHY_GROUP_SIZE, 0),
+            hierarchy_compression=os.environ.get(
+                HOROVOD_HIERARCHY_COMPRESSION, "none"),
             elastic=_get_bool(HOROVOD_ELASTIC),
             cycle_pipeline_depth=_get_int(
                 HOROVOD_CYCLE_PIPELINE_DEPTH, DEFAULT_CYCLE_PIPELINE_DEPTH

@@ -246,6 +246,13 @@ class NetFault:
     delay_ms: float = 0.0  # netdelay: per-op latency
     after: float = 0.0  # window start, seconds after arming
     on: str = ""  # kv_outage trigger: "" (timer) | "reform"
+    # netdelay scope: "" = every wire op (legacy), "cross" = only the
+    # slow inter-group hop — the sleep scales with the number of
+    # group-boundary crossings the seam declares (a flat ring crosses
+    # 2(w-1) times per allreduce, the hierarchical cross hop 2(G-1),
+    # the intra hop 0), so a simulated DCN penalizes each path by the
+    # bytes it actually puts on the slow link.
+    hop: str = ""
 
 
 def is_net_clause(clause: str) -> bool:
@@ -295,11 +302,15 @@ def parse_net_faults(text: Optional[str]) -> List[NetFault]:
                     seconds=float(named.pop("seconds", float("inf"))),
                     after=after))
             elif kind == "netdelay":
+                hop = named.pop("hop", "").lower()
+                if hop not in ("", "cross"):
+                    raise ValueError(f"unknown hop {hop!r} "
+                                     "(expected hop=cross)")
                 faults.append(NetFault(
                     kind, delay_ms=float(positional[0]),
                     rank=(int(named.pop("rank")) if "rank" in named
                           else None),
-                    after=after))
+                    after=after, hop=hop))
         except (IndexError, ValueError) as exc:
             raise ValueError(
                 f"HOROVOD_FAULT_INJECT: malformed net-fault clause "
@@ -353,10 +364,21 @@ def reload_chaos() -> None:
     _chaos_loaded = False
 
 
-def inject(transport: str, phase: str = "") -> None:
+def inject(transport: str, phase: str = "",
+           crossings: Optional[int] = None) -> None:
     """The chaos seam: called inside the real transports before each
     control-plane wire op. Applies netdelay/flaky/partition faults whose
-    window covers now; a no-op when no chaos is armed."""
+    window covers now; a no-op when no chaos is armed.
+
+    ``crossings``: how many times this wire op crosses the hierarchy
+    group boundary (the simulated slow DCN link). Data-plane seams that
+    model topology declare it — flat ring allreduce ``2*(w-1)``, the
+    hierarchical cross hop ``2*(G-1)``, the intra hop ``0``. A
+    ``netdelay:...:hop=cross`` fault sleeps ``delay_ms`` PER crossing and
+    skips seams that declare none (or don't model topology at all), so
+    the injected DCN taxes each path proportionally to the traffic it
+    actually puts on the slow link. Plain ``netdelay`` ignores
+    ``crossings`` (legacy per-op latency)."""
     ch = _chaos()
     if ch is None:
         return
@@ -365,14 +387,21 @@ def inject(transport: str, phase: str = "") -> None:
         in_window = f.after <= now <= f.after + f.seconds
         targeted = f.rank is None or f.rank == ch.rank
         if f.kind == "netdelay" and targeted and in_window:
+            if f.hop == "cross":
+                if not crossings:  # seam off the slow link (or untyped)
+                    continue
+                _CHAOS_INJECTED.labels(kind="netdelay").inc()
+                time.sleep(f.delay_ms * crossings / 1000.0)
+                continue
             _CHAOS_INJECTED.labels(kind="netdelay").inc()
             time.sleep(f.delay_ms / 1000.0)
-        elif transport == "ring":
-            # the data-plane seam (executor host-ring ops) carries delay
-            # faults only: flaky resets and partitions model CONTROL
-            # traffic loss, which the retry/elastic layers own — raising
-            # them mid-ring would fail collectives no real transport
-            # fault produces (the ring retries at the message layer)
+        elif transport in ("ring", "hier_intra", "hier_cross"):
+            # the data-plane seams (executor host-ring ops and the
+            # hierarchical intra/cross hops) carry delay faults only:
+            # flaky resets and partitions model CONTROL traffic loss,
+            # which the retry/elastic layers own — raising them mid-ring
+            # would fail collectives no real transport fault produces
+            # (the ring retries at the message layer)
             continue
         elif f.kind == "flaky" and targeted and in_window:
             if ch.rng.random() < f.prob:

@@ -135,8 +135,9 @@ class TestParameterManager:
         pm = _mk_manager(log_path=str(log), sweep=("cache_enabled",))
         header = log.read_text().splitlines()[0]
         assert header == ("# swept: fusion_threshold_mb,cycle_time_ms,"
-                          "cache_enabled")
+                          "grad_bucket_mb,pipeline_depth,cache_enabled")
         assert pm.swept_knobs == ("fusion_threshold_mb", "cycle_time_ms",
+                                  "grad_bucket_mb", "pipeline_depth",
                                   "cache_enabled")
 
     def test_params_blob_roundtrip(self):

@@ -309,9 +309,12 @@ class TestConfigure:
 
     def test_configure_seeds_rooflines_from_probe_cache(
             self, singleton, monkeypatch, tmp_path):
+        from horovod_tpu.autotune import probe
+
         path = tmp_path / "roofline.json"
         path.write_text(json.dumps({
-            "schema": 1, "hbm_gbps": 100.0, "allreduce_gbps": 30.0,
+            "schema": probe._CACHE_SCHEMA, "hbm_gbps": 100.0,
+            "allreduce_gbps": 30.0,
             "allreduce_busbw_gbps": 45.0, "world": 4,
             "fusion_threshold_bytes": 1 << 20, "wall_time": 0.0}))
         monkeypatch.setenv("HOROVOD_PROBE_CACHE", str(path))
@@ -324,6 +327,35 @@ class TestConfigure:
         # host ring stays self-calibrating
         with singleton._lock:
             assert "host_ring" not in singleton._roofline
+
+    def test_configure_seeds_hier_lane_rooflines(
+            self, singleton, monkeypatch, tmp_path):
+        """A schema-2 artifact with per-hop hierarchy numbers seeds the
+        hier_intra/hier_cross lanes (separately — the two hops can
+        differ by an order of magnitude)."""
+        from horovod_tpu.autotune import probe
+
+        path = tmp_path / "roofline.json"
+        path.write_text(json.dumps({
+            "schema": probe._CACHE_SCHEMA, "world": 4,
+            "hier_intra_busbw_gbps": 12.0,
+            "hier_cross_busbw_gbps": 0.75, "wall_time": 0.0}))
+        monkeypatch.setenv("HOROVOD_PROBE_CACHE", str(path))
+        monkeypatch.setenv("HOROVOD_COMMS", "1")
+        with singleton._lock:
+            # rooflines survive reset() by design; an earlier test (or a
+            # runtime init elsewhere in the suite) may have seeded the
+            # XLA lanes — start clean so the no-device assertion below
+            # tests THIS artifact, not suite history
+            singleton._roofline.clear()
+            singleton._roofline_source.clear()
+        comms.configure(rank=0, world=4)
+        with singleton._lock:
+            assert singleton._roofline["hier_intra"] == pytest.approx(12.0)
+            assert singleton._roofline["hier_cross"] == pytest.approx(0.75)
+            assert singleton._roofline_source["hier_cross"] == "probe_cache"
+            # no mesh keys in this artifact: XLA lanes stay unseeded
+            assert "device" not in singleton._roofline
 
     def test_comms_state_document(self, singleton):
         singleton.record("allreduce", "device", 1 << 20, 0.001, world=2)
@@ -417,7 +449,10 @@ class TestMergedTraceCounterTrack:
 
 class TestProbeCache:
     def _artifact(self, world=4):
-        return {"schema": 1, "hbm_gbps": 123.0, "allreduce_gbps": 30.0,
+        from horovod_tpu.autotune import probe
+
+        return {"schema": probe._CACHE_SCHEMA, "hbm_gbps": 123.0,
+                "allreduce_gbps": 30.0,
                 "allreduce_busbw_gbps": 45.0, "world": world,
                 "fusion_threshold_bytes": 1 << 20, "wall_time": 1.0}
 
@@ -451,6 +486,20 @@ class TestProbeCache:
         bad.write_text(json.dumps({"schema": 99, "world": 4}))
         assert probe.load_cached_roofline(path=str(bad)) is None
         assert probe.load_cached_roofline(path=None) is None  # knob unset
+
+    def test_schema_1_artifact_invalidated(self, tmp_path):
+        """Regression: a pre-hierarchy (schema 1) artifact must NOT
+        reload under schema 2 — it knows nothing about the per-hop
+        hierarchy split, so a 'cache hit' would leave the hier lanes
+        unseeded while skipping the probes that would seed them."""
+        from horovod_tpu.autotune import probe
+
+        path = tmp_path / "roofline.json"
+        path.write_text(json.dumps({
+            "schema": 1, "hbm_gbps": 123.0, "allreduce_gbps": 30.0,
+            "allreduce_busbw_gbps": 45.0, "world": 4,
+            "fusion_threshold_bytes": 1 << 20, "wall_time": 1.0}))
+        assert probe.load_cached_roofline(path=str(path), world=4) is None
 
     def test_probe_and_seed_reuses_cache(self, tmp_path, monkeypatch,
                                          singleton):

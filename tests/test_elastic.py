@@ -114,6 +114,24 @@ class TestFaultInject:
         monkeypatch.setenv("HOROVOD_FAULT_INJECT", "kill:rank=0:step=3:gen=2")
         fault_inject.maybe_inject(step=3, rank=0, generation=0)
 
+    def test_multiple_process_clauses_all_armed(self, monkeypatch):
+        # a multi-rank chaos cell arms one kill per target rank; the
+        # worker whose rank is named only by the SECOND clause must
+        # still see it (spec_from_env's first-clause view used to drop
+        # every later process fault on the floor)
+        monkeypatch.setenv(
+            "HOROVOD_FAULT_INJECT",
+            "netdelay:5:hop=cross;"
+            "kill:rank=4:step=3:code=17;kill:rank=5:step=5:code=19:gen=1")
+        specs = fault_inject.specs_from_env()
+        assert [(s.rank, s.step, s.code, s.generation) for s in specs] \
+            == [(4, 3, 17, 0), (5, 5, 19, 1)]
+        assert fault_inject.spec_from_env() == specs[0]
+        # rank 5 consults both clauses but matches neither here
+        # (wrong step / wrong generation) — still alive proves no fire
+        fault_inject.maybe_inject(step=5, rank=5, generation=0)
+        fault_inject.maybe_inject(step=4, rank=5, generation=1)
+
 
 # ---------------------------------------------------------------------------
 # state commit / restore

@@ -196,6 +196,28 @@ def test_inject_netdelay_sleeps(chaos_env):
     assert time.monotonic() - t0 >= 0.07
 
 
+def test_parse_netdelay_hop_cross():
+    (delay,) = resilience.parse_net_faults("netdelay:5:hop=cross")
+    assert (delay.kind, delay.delay_ms, delay.hop) == ("netdelay", 5.0,
+                                                       "cross")
+    with pytest.raises(ValueError):
+        resilience.parse_net_faults("netdelay:5:hop=intra")
+
+
+def test_inject_netdelay_hop_cross_scales_with_crossings(chaos_env):
+    chaos_env("netdelay:40:hop=cross")
+    # a seam off the slow link (or one that doesn't model topology at
+    # all) declares no crossings and must not pay the delay
+    t0 = time.monotonic()
+    resilience.inject("hier_intra", "reducescatter", crossings=0)
+    resilience.inject("ctrl", "unit")
+    assert time.monotonic() - t0 < 0.03
+    # the cross hop pays per declared crossing: 2(G-1) = 2 at G=2
+    t0 = time.monotonic()
+    resilience.inject("hier_cross", "allreduce", crossings=2)
+    assert time.monotonic() - t0 >= 0.07
+
+
 def test_inject_partition_blocks_window(chaos_env):
     chaos_env("partition:0:0.3")
     t0 = time.monotonic()

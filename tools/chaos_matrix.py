@@ -23,6 +23,11 @@ Scenarios (docs/robustness.md has the failure-model table):
   mid-run: survivors trip HOROVOD_COLLECTIVE_TIMEOUT, re-form within
   the deadline, finish, and the merged flight-recorder postmortem names
   the partitioned rank.
+* ``hier_cross_kill``   — ISSUE 18: two ranks of a six-rank
+  hierarchical world (3 groups of 2, netdelay-throttled cross hop)
+  killed mid-run; survivors re-form at world 4, the executor recomputes
+  the groups (2x2) for the new world, and training finishes with zero
+  lost steps.
 
 Checkpoint crash-consistency scenarios (ISSUE 9; docs/checkpointing.md):
 
@@ -127,6 +132,31 @@ SCENARIOS = {
         "require_retries": True,
         "require_reform": True,
         "timeout": 240,
+    },
+    # ISSUE 18: ranks killed while the hierarchical allreduce is inside
+    # its (netdelay-throttled) cross-group exchange. The six-rank world
+    # runs 3 groups of 2; after the two kills the survivors re-form at
+    # world 4 and the executor must RECOMPUTE the groups (2x2, not the
+    # stale 3x2 plan keyed to the dead transport) and finish with zero
+    # lost steps. The intermediate world of 5 exercises the flat
+    # fallback (5 % 2 != 0) on the way down.
+    "hier_cross_kill": {
+        "world": 6,
+        "env": {
+            "HOROVOD_FAULT_INJECT":
+                "netdelay:5:hop=cross;"
+                "kill:rank=4:step=3:code=17;"
+                "kill:rank=5:step=5:code=19:gen=1",
+            "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+            "HOROVOD_HIERARCHY_GROUP_SIZE": "2",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "4",
+        },
+        "expected_exit": {4: 17, 5: 19},
+        "require_injections": True,
+        "require_reform": True,
+        "require_true": ("hier_enabled",),
+        "require_hier_groups": 2,
+        "timeout": 300,
     },
     "partition_collective_timeout": {
         "world": 3,
@@ -393,6 +423,14 @@ def run_scenario(name, spec):
                     failures.append(
                         f"rank {r['rank']}: expected {field}=true, "
                         f"got {r.get(field)!r}")
+        want_groups = spec.get("require_hier_groups")
+        if want_groups is not None:
+            for r in survivors:
+                if r.get("hier_groups") != want_groups:
+                    failures.append(
+                        f"rank {r['rank']}: expected the re-formed plan "
+                        f"to run {want_groups} groups, got "
+                        f"{r.get('hier_groups')!r}")
         retries = sum(r["net_retries_total"] for r in survivors)
         injections = sum(r["chaos_injected_total"] for r in survivors)
         if spec.get("require_retries") and retries <= 0:

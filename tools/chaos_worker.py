@@ -91,6 +91,21 @@ def main() -> int:
         "skipped_steps": _metric_total(
             snap, "horovod_integrity_skipped_steps_total"),
     }
+    # hierarchy-plan visibility (ISSUE 18 chaos cell): after an elastic
+    # re-form the executor must have recomputed the groups for the NEW
+    # world size — report what the survivors actually ended up running.
+    # Safe here: the explicit-group-size plan is wire-free and the cycle
+    # thread is idle after train().
+    try:
+        from horovod_tpu.core import state as state_mod
+
+        plan = state_mod.global_state().runtime.executor._hierarchy_plan()
+        result["hier_enabled"] = plan is not None
+        if plan is not None:
+            result["hier_groups"] = plan.num_groups
+            result["hier_group_size"] = plan.group_size
+    except Exception:
+        result["hier_enabled"] = False
     try:  # the postmortem needs post-reform events (elastic_reform)
         flight_recorder.dump_debug_state(reason="chaos_run_complete")
     except Exception:
