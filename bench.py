@@ -202,6 +202,22 @@ def comms_rows():
         return {"busbw_gbs": None, "comms_utilization": None}
 
 
+def goodput_rows():
+    """Headline goodput fields (docs/goodput.md): the productive
+    fraction of wall-clock from the tracker ledger, gated
+    higher-is-better by bench_compare. None when the tracker is off or
+    the epoch never started (pre-init entry points)."""
+    try:
+        from horovod_tpu import goodput
+
+        led = goodput.tracker().ledger()
+        if not led.get("wall_seconds"):
+            return {"goodput_fraction": None}
+        return {"goodput_fraction": led["goodput_fraction"]}
+    except Exception:
+        return {"goodput_fraction": None}
+
+
 def bucket_overlap_probe(model, optimizer, state, image_size,
                          batch=8, steps=4):
     """Bytes-weighted hidden fraction of the release plan's wire traffic.
@@ -348,6 +364,7 @@ def main(model_name: str = "resnet50", allow_env: bool = True):
         "comm_hidden_fraction_bytes": hidden_bytes,
         **memory_rows(params),
         **comms_rows(),
+        **goodput_rows(),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -602,6 +619,7 @@ def transformer_main(family: str, allow_env: bool = True,
         "comm_hidden_fraction_bytes": hidden_bytes,
         **memory_rows(params),
         **comms_rows(),
+        **goodput_rows(),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -858,6 +876,7 @@ def collectives_main(tiny: bool = False):
         "program_cache_hits_total": executor_mod._PROGRAM_CACHE_HITS.value,
         "flight_recorder": fr_overhead,
         **comms_rows(),
+        **goodput_rows(),
     }
     if tiny:
         result["tiny"] = True
@@ -1167,12 +1186,109 @@ def comms_main(tiny: bool = False):
         "steady_state_compiles": int(steady_compiles),
         "lane_busbw_gbs": lanes,
         **comms_rows(),
+        **goodput_rows(),
     }
     if tiny:
         result["tiny"] = True
     log(f"comms: p50 off {result['p50_ms_comms_off']} ms, "
         f"on {result['p50_ms_comms_on']} ms ({overhead}%); "
         f"compiles(timed)={steady_compiles}; lanes={lanes}")
+    print(json.dumps(result), flush=True)
+    return result
+
+
+def goodput_main(tiny: bool = False):
+    """Goodput-ledger microbench (ISSUE 19): steady-state cost of the
+    productive-time accounting on the profiled step path, at BERT-Large
+    gradient shapes.
+
+    Two interleaved phases over identical named tensors (the --comms
+    protocol, so dispatch drift cannot masquerade as tracker cost), each
+    step bracketed by ``profiler.step`` so the goodput hook at the step
+    boundary actually fires: ledger OFF (record_step returns at the
+    guard) and ON (every step pays the category bookkeeping + fraction
+    sample). Headline ``value``: added p50 step %, goal < 1%. The timed
+    phases must add ZERO new XLA program compiles — the ledger only ever
+    watches the clock, never touches programs.
+
+    ``tiny`` (--tiny / the tier-1 smoke test): toy shapes + 2 steps."""
+    hvd.init()
+    from horovod_tpu import goodput, profiler
+    from horovod_tpu.runtime import executor as executor_mod
+
+    world = hvd.size()
+    if tiny:
+        shapes = [(256,), (64, 8)]
+        warmup_steps, timed_steps = 3, 2
+    else:
+        shapes = [(1024, 1024), (1024, 1024), (1024, 4096), (4096, 1024),
+                  (1024,)]
+        warmup_steps, timed_steps = 6, 7
+    rng = np.random.RandomState(0)
+    payloads = [rng.randn(world, *s).astype(np.float32) for s in shapes]
+    n_elems = sum(int(np.prod(s)) for s in shapes)
+    log(f"goodput bench: {len(shapes)} tensors, "
+        f"{n_elems * 4 / 1e6:.1f} MB/step/worker, np={world}"
+        f"{' (tiny)' if tiny else ''}")
+
+    t = goodput.tracker()
+    was_enabled = t.enabled
+    prof = profiler._profiler
+    prof_was_enabled = prof.enabled
+    prof.enabled = True  # the goodput step hook rides the profiler
+
+    def one_step(step):
+        with profiler.step(f"goodput/s{step}"):
+            hs = [hvd.allreduce_async(
+                hvd.stack_per_worker(list(payloads[j] + np.float32(step))),
+                name=f"goodput/t{j}") for j in range(len(shapes))]
+            for h in hs:
+                hvd.synchronize(h)
+
+    try:
+        t.enabled = True
+        t.start_epoch()
+        for s in range(warmup_steps):
+            one_step(s)
+
+        compiles0 = executor_mod._PROGRAM_COMPILES.value
+        phases = {"off": (False, []), "on": (True, [])}
+        for s in range(timed_steps):
+            for name, (on, lat) in phases.items():
+                t.enabled = on
+                t0 = time.perf_counter()
+                one_step(1000 + s * len(phases))
+                lat.append(time.perf_counter() - t0)
+        steady_compiles = executor_mod._PROGRAM_COMPILES.value - compiles0
+        t.enabled = True
+        led = t.ledger()
+    finally:
+        t.enabled = was_enabled
+        prof.enabled = prof_was_enabled
+
+    p50 = {name: float(np.median(lat)) for name, (_, lat) in phases.items()}
+    overhead = (round(100.0 * (p50["on"] - p50["off"]) / p50["off"], 2)
+                if p50["off"] > 0 else None)
+    result = {
+        "metric": f"goodput tracker steady-state step overhead "
+                  f"(per-step productive-time accounting, "
+                  f"{'toy' if tiny else 'BERT-Large layer'} gradient "
+                  f"shapes, np={world})",
+        "value": overhead,
+        "unit": "%",
+        "goal": "< 1%",
+        "p50_ms_goodput_off": round(p50["off"] * 1e3, 3),
+        "p50_ms_goodput_on": round(p50["on"] * 1e3, 3),
+        "steady_state_compiles": int(steady_compiles),
+        "steps_productive": led["steps_productive"],
+        "goodput_fraction": led["goodput_fraction"],
+    }
+    if tiny:
+        result["tiny"] = True
+    log(f"goodput: p50 off {result['p50_ms_goodput_off']} ms, "
+        f"on {result['p50_ms_goodput_on']} ms ({overhead}%); "
+        f"compiles(timed)={steady_compiles}; "
+        f"fraction={led['goodput_fraction']}")
     print(json.dumps(result), flush=True)
     return result
 
@@ -1312,6 +1428,7 @@ def sharded_optimizer_main(tiny: bool = False):
         "steady_state_program_builds": int(steady_builds),
         **memory_rows(),
         **comms_rows(),
+        **goodput_rows(),
     }
     if tiny:
         result["tiny"] = True
@@ -1667,6 +1784,7 @@ def serve_main(tiny: bool = False, prefix_heavy: bool = False):
             "tiny": tiny,
             **memory_rows(params),
             **comms_rows(),
+            **goodput_rows(),
         }
         if handle.policy.paged:
             # paged-cache headline (serve/paging.py): pool occupancy per
@@ -1764,6 +1882,7 @@ def tiny_main():
         "tiny": True,
         **memory_rows(params),
         **comms_rows(),
+        **goodput_rows(),
     }
     print(json.dumps(result), flush=True)
     return result
@@ -1834,6 +1953,12 @@ if __name__ == "__main__":
                              "accounting overhead at BERT-Large gradient "
                              "shapes, interleaved A/B + compile-count "
                              "canary (one JSON line)")
+    parser.add_argument("--goodput", action="store_true",
+                        help="microbench the goodput ledger: per-step "
+                             "productive-time accounting overhead at "
+                             "BERT-Large gradient shapes, interleaved "
+                             "A/B + compile-count canary (one JSON "
+                             "line)")
     parser.add_argument("--tiny", action="store_true",
                         help="toy sizes + a couple of steps for "
                              "--collectives/--sharded-optimizer/"
@@ -1854,6 +1979,8 @@ if __name__ == "__main__":
         memory_main(tiny=cli.tiny)
     elif cli.comms:
         comms_main(tiny=cli.tiny)
+    elif cli.goodput:
+        goodput_main(tiny=cli.tiny)
     elif cli.collectives:
         collectives_main(tiny=cli.tiny)
     elif cli.integrity:

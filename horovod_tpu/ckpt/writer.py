@@ -320,6 +320,15 @@ class CheckpointManager:
         else:
             self._write_commit(pending)
         stats.SNAPSHOT_SECONDS.observe(time.monotonic() - t0)
+        try:
+            # goodput ledger: only the inline training-thread seconds are
+            # checkpoint badput — in async mode that is the slab copy +
+            # handoff (including any full-slot block), not the write
+            from horovod_tpu import goodput
+
+            goodput.record_span("ckpt_stall", time.monotonic() - t0)
+        except Exception:
+            pass  # accounting must never fail a commit
 
     def _slab_copy(self, items: List[_Item]
                    ) -> Tuple[List[_Item], List[Any]]:

@@ -165,6 +165,13 @@ def init(
 
         comms.configure(rank=st.rank, world=st.size)
 
+        # goodput ledger: adopt rank/world, pin the wall-clock epoch
+        # (first init only — elastic re-inits keep the original clock),
+        # register the "goodput" state provider (HOROVOD_GOODPUT_*)
+        from horovod_tpu import goodput
+
+        goodput.configure(rank=st.rank, world=st.size)
+
         if st.config.timeline_file:
             from horovod_tpu.timeline import Timeline
 

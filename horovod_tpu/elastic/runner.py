@@ -222,9 +222,11 @@ def _scan_members(client, scope: str, settle: float,
         time.sleep(0.1)
 
 
-def _reform(min_workers: int, backoff: Backoff) -> None:
+def _reform(min_workers: int, backoff: Backoff) -> List[int]:
     """Re-form membership for generation ``_generation + 1`` and
-    re-initialize the framework from the rewritten env."""
+    re-initialize the framework from the rewritten env. Returns the old
+    ranks that did NOT make it into the new generation (the goodput
+    incident's culprit candidates)."""
     global _generation
     client = _kv_client()
     if client is None:
@@ -335,6 +337,7 @@ def _reform(min_workers: int, backoff: Backoff) -> None:
                          size=new_size, members=members,
                          old_size=old_size)
     basics.reinit()
+    return sorted(set(range(old_size)) - set(members))
 
 
 def _shutdown_jax_distributed() -> None:
@@ -367,7 +370,13 @@ def run(func):
         while True:
             if rollback is not False:
                 backoff = Backoff.from_env()
-                _reform(min_workers, backoff)
+                # goodput bracket: everything from quiesce through the
+                # post-re-form sync is elastic_reform badput, and steps
+                # rolled back to the last commit will be replayed —
+                # charged to this incident, not to productive time
+                t_reform = time.monotonic()
+                step_before = getattr(state, "step", None)
+                missing = _reform(min_workers, backoff)
                 if rollback:  # failure path: roll back to the last commit
                     state.on_reset()
                 # either way the new rank 0's copy becomes authoritative
@@ -381,6 +390,23 @@ def run(func):
                     pass
                 if rollback:
                     _RESTARTS_TOTAL.inc()
+                try:
+                    from horovod_tpu import goodput
+
+                    step_after = getattr(state, "step", None)
+                    replay = 0
+                    if isinstance(step_before, int) \
+                            and isinstance(step_after, int):
+                        replay = max(0, step_before - step_after)
+                    goodput.note_incident(
+                        "elastic_reform",
+                        time.monotonic() - t_reform,
+                        generation=_generation,
+                        culprit_rank=missing[0] if missing else None,
+                        replay_steps=replay,
+                        linked_events=["elastic_reform", "workers_down"])
+                except Exception:
+                    pass  # accounting must never fail a re-form
                 rollback = False
             try:
                 return func(state, *args, **kwargs)

@@ -139,6 +139,16 @@ class State:
         _COMMIT_DURATION.observe(time.monotonic() - t0)
         flight_recorder.emit("state_commit", step=step,
                              seconds=round(time.monotonic() - t0, 6))
+        try:
+            # goodput ledger: a commit is THE committed-step boundary —
+            # claim the gap since the last accounted step as productive
+            # (minus any badput spans inside it). The tracker frontier
+            # dedups against the profiler step source when both run.
+            from horovod_tpu import goodput
+
+            goodput.record_step(step=step)
+        except Exception:
+            pass  # accounting must never fail a commit
         if self._ckpt_dir:
             self._ckpt_commit(step, _runner.restarts())
         elif self._spill_dir:

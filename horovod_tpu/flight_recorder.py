@@ -225,9 +225,12 @@ class FlightRecorder:
         payload = None
         target = path or self.dir
         with self._dump_lock:
+            # history carries the EARLIER dumps only — the current
+            # reason is already in snap["reason"], and including it
+            # here would make every dump read as its own predecessor
+            snap["dump_history"] = list(self._dump_history)
             self._dump_history.append(
                 {"reason": reason, "t": snap["wall_time"]})
-            snap["dump_history"] = list(self._dump_history)
             if target:
                 # File write stays serialized so concurrent dumps are
                 # last-wins whole files, never interleaved.
@@ -556,4 +559,17 @@ def format_postmortem(dumps: List[dict], last_n: int = 40,
             lines.append(report)
     except Exception:
         pass  # likewise if the comms plane is broken
+    try:
+        # cross-rank goodput report from the dumps' "goodput" state
+        # (goodput.py; empty for pre-goodput dumps): fleet goodput %,
+        # the dominant badput category, and the costliest incident with
+        # its culprit rank. Lazy: goodput.py imports this module.
+        from horovod_tpu import goodput
+
+        report = goodput.format_goodput_report(dumps)
+        if report:
+            lines.append("")
+            lines.append(report)
+    except Exception:
+        pass  # likewise if the goodput plane is broken
     return "\n".join(lines)

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from typing import Optional
 
 from horovod_tpu import flight_recorder
@@ -103,6 +104,8 @@ def handle_failure(state, exc: Exception) -> Optional[int]:
         raise exc
     _replays += 1
     _ROLLBACKS.inc()
+    failing_step = getattr(state, "step", None)
+    t_restore = time.monotonic()
     restored_step = None
     # prefer the durable PR-9 cut (bit-identical, survives a poisoned
     # in-memory snapshot); fall back to the commit-time memory snapshot
@@ -121,4 +124,23 @@ def handle_failure(state, exc: Exception) -> Optional[int]:
                          suspect=getattr(exc, "suspect_rank", None),
                          error="%s: %s" % (type(exc).__name__,
                                            str(exc)[:200]))
+    try:
+        # goodput ledger: the restore is rollback badput, and the steps
+        # between the restored cut and the failure will be re-run —
+        # charged to this incident, not counted productive twice
+        from horovod_tpu import goodput
+
+        # +1: the step that was IN FLIGHT at the failure is re-executed
+        # too — its aborted first attempt is wasted work even when the
+        # restore lands exactly on the last commit
+        replay_steps = 1
+        if isinstance(failing_step, int) and isinstance(restored_step, int):
+            replay_steps = max(0, failing_step - restored_step) + 1
+        goodput.note_incident(
+            "rollback", time.monotonic() - t_restore,
+            culprit_rank=getattr(exc, "suspect_rank", None),
+            replay_steps=replay_steps,
+            linked_events=["rollback", "integrity_violation"])
+    except Exception:
+        pass  # accounting must never fail a rollback
     return restored_step

@@ -59,6 +59,28 @@ CKPT_COMMIT_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                        10.0, 30.0, 60.0, 120.0, 300.0)
 
 
+# Every route the HTTP endpoint serves, with a one-line description —
+# the payload of GET / and GET /debug/routes, so tooling (hvd_top)
+# discovers which panels this endpoint can back instead of probing 404s.
+HTTP_ROUTES: Dict[str, str] = {
+    "/metrics": "Prometheus text exposition of every registered family",
+    "/debug": "flight-recorder ring events, in-flight ops, metrics",
+    "/debug/routes": "this route index",
+    "/serve": "serving-plane replica sets, queue depths, cache warmth",
+    "/profile": "step-profiler phase breakdowns and summary",
+    "/memory": "memory-plane ledger: live bytes, watermarks, drift",
+    "/comms": "collective-transport busbw vs roofline per lane",
+    "/slo": "SLO burn rates, latency percentiles, slow exemplars",
+    "/goodput": "goodput ledger: productive vs badput, incidents",
+    "/healthz": "readiness gate (200 once init ran / replica alive)",
+}
+
+
+def route_index() -> dict:
+    """The JSON document served at ``GET /`` and ``/debug/routes``."""
+    return {"routes": dict(HTTP_ROUTES)}
+
+
 class Counter:
     """Monotonic counter; ``inc`` is the whole hot path."""
 
@@ -275,12 +297,22 @@ class MetricsRegistry:
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API)
                 route = self.path.split("?")[0].rstrip("/")
-                if route in ("", "/metrics"):
+                if route == "/metrics":
                     body = reg.prometheus_text().encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
                         "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif route in ("", "/debug/routes"):
+                    # route index: which surfaces THIS endpoint serves,
+                    # so tooling (hvd_top) discovers panels instead of
+                    # hardcoding them — the bare root used to 404
+                    body = json.dumps(route_index(), default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -360,6 +392,20 @@ class MetricsRegistry:
 
                     body = json.dumps(
                         tracing.slo_state(),
+                        default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif route == "/goodput":
+                    # goodput ledger: wall-clock partition into
+                    # productive vs badput categories, incident records
+                    # (goodput.goodput_state; docs/goodput.md)
+                    from horovod_tpu import goodput
+
+                    body = json.dumps(
+                        goodput.goodput_state(),
                         default=repr).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

@@ -372,6 +372,16 @@ class StepProfiler:
             "profiler_step", step=rec.index,
             wall_ms=round(wall * 1e3, 3),
             hidden_fraction=round(hidden_fraction, 4))
+        try:
+            # goodput ledger: the measured step wall is productive time,
+            # the exposed-comm phase is badput. The tracker's own frontier
+            # guard dedups against the State.commit step source.
+            from horovod_tpu import goodput
+
+            goodput.record_step(wall, exposed_comm=exposed_phase,
+                                step=rec.index)
+        except Exception:
+            pass  # accounting must never fail a step
 
     # -- introspection ------------------------------------------------------
     def history(self) -> List[dict]:
@@ -463,6 +473,18 @@ class StepProfiler:
             comms_samples = comms.tracker().samples()
         except Exception:
             pass
+        # goodput plane: the goodput-fraction trail + incident ledger
+        # ride the dump so the merged trace gets a per-rank "goodput
+        # fraction" counter track and an incident instant lane
+        goodput_samples: list = []
+        goodput_incidents: list = []
+        try:
+            from horovod_tpu import goodput
+
+            goodput_samples = goodput.tracker().samples()
+            goodput_incidents = goodput.tracker().incidents()
+        except Exception:
+            pass
         return {
             "schema": SCHEMA,
             "rank": self.rank,
@@ -478,6 +500,8 @@ class StepProfiler:
             "memory_samples": memory_samples,
             "request_spans": request_spans,
             "comms_samples": comms_samples,
+            "goodput_samples": goodput_samples,
+            "goodput_incidents": goodput_incidents,
             "flight_events": flight_recorder.recorder().events()
             [-_FLIGHT_TRACE_EVENTS:],
         }
@@ -669,6 +693,37 @@ def _comms_trace_events(dump: dict) -> List[dict]:
     return out
 
 
+def _goodput_trace_events(dump: dict) -> List[dict]:
+    """The goodput tracker's fraction trail as a Chrome counter ("C")
+    track plus its incident ledger as an instant ("i") lane — a goodput
+    sag lines up visually with the incident that caused it
+    (docs/goodput.md)."""
+    out = []
+    for row in dump.get("goodput_samples", ()):
+        try:
+            t, frac = row[0], float(row[1])
+        except (TypeError, ValueError, IndexError):
+            continue
+        if not isinstance(t, (int, float)):
+            continue
+        out.append({"ph": "C", "pid": 0, "tid": 0, "ts": t * 1e6,
+                    "name": "goodput fraction",
+                    "args": {"productive": round(frac, 4)}})
+    for inc in dump.get("goodput_incidents", ()):
+        if not isinstance(inc, dict):
+            continue
+        t = inc.get("wall_time")
+        if not isinstance(t, (int, float)):
+            continue
+        out.append({"ph": "i", "pid": 0, "tid": 1, "ts": t * 1e6,
+                    "s": "t",
+                    "name": "incident: %s" % inc.get("cause", "?"),
+                    "args": {k: inc.get(k) for k in
+                             ("duration_s", "generation", "culprit_rank",
+                              "steps_replayed")}})
+    return out
+
+
 def _device_trace_files(directory: str) -> List[str]:
     """jax.profiler output below the profile dir: TensorBoard's profile
     plugin writes ``*.trace.json.gz`` under a nested run directory."""
@@ -721,6 +776,7 @@ def merge_profile_dir(directory: str,
         events += _flight_trace_events(d)
         events += _memory_trace_events(d)
         events += _comms_trace_events(d)
+        events += _goodput_trace_events(d)
         if events:
             lanes.append((f"rank {rank} steps", events, offset))
         spans = [s for s in d.get("request_spans", ())

@@ -36,7 +36,7 @@ import threading
 import time
 from typing import List, Optional
 
-from horovod_tpu import flight_recorder, tracing
+from horovod_tpu import flight_recorder, goodput, tracing
 from horovod_tpu.elastic import fault_inject
 from horovod_tpu.exceptions import NumericalError, WorkersDownError
 from horovod_tpu.metrics import COUNT_BUCKETS, registry as _metrics
@@ -313,6 +313,10 @@ class Replica:
         self.engine.release_slot(victim.slot)
         self.engine.note_preemption()
         _REQUESTS.labels(outcome="preempted").inc()
+        # goodput ledger: the victim's decoded-so-far tokens are work the
+        # preemption threw away — re-attributed from productive to
+        # serve_preempted badput at the EWMA per-token decode cost
+        goodput.note_serve_preempted(len(victim.generated))
         flight_recorder.emit(
             "serve_preempt", replica=self.name, rank=self.rank,
             uid=victim.request.uid, slot=victim.slot,
@@ -441,6 +445,9 @@ class Replica:
                     "request.prefill", p0, active.prefill_s,
                     trace_id=req.trace_id, uid=req.uid,
                     slot=active.slot, prompt_len=active.prompt_len)
+                # prefill is productive serve time too (tokens=0: the
+                # preemption exchange rate stays a pure decode cost)
+                goodput.record_serve_step(active.prefill_s)
                 # open the first decode-block span
                 active.block_t0 = p0 + active.prefill_s
                 _TOKENS.labels(kind="prefill").inc(active.prompt_len)
@@ -455,6 +462,8 @@ class Replica:
         if not slots:
             _OCCUPANCY.labels(replica=self.name).set(0)
             time.sleep(_IDLE_SLEEP_SECONDS)
+            # goodput ledger: an empty loop iteration is queue-idle badput
+            goodput.record_span("serve_queue_idle", _IDLE_SLEEP_SECONDS)
             return
 
         if self.paged:
@@ -477,6 +486,7 @@ class Replica:
         # the serving step counter: chaos kills aim at decode step N
         self.decode_iterations += 1
         fault_inject.maybe_inject(self.decode_iterations)
+        t_decode0 = time.monotonic()
         ids, max_abs = self.engine.decode(slots, tokens, positions)
         # no short-circuit: the guard's EWMA/skip-budget state must see
         # EVERY slot's observation, not a prefix that stops at the
@@ -513,6 +523,10 @@ class Replica:
         _OCCUPANCY_HIST.observe(occupancy)
         self.batcher.note_step()
         now = time.monotonic()
+        # goodput ledger: one decoded token per occupied slot is the
+        # serve plane's productive unit; the step wall also refreshes the
+        # EWMA per-token cost that prices preempted work
+        goodput.record_serve_step(now - t_decode0, tokens=occupancy)
         for done in self.batcher.retire_done():
             if self.paged:
                 self.engine.release_slot(done.slot)

@@ -71,6 +71,16 @@ class StragglerTracker:
         t_first = min(arrivals.values())
         skew = max(arrivals.values()) - t_first
         _NEGOTIATE_SKEW.observe(skew)
+        if skew > 0:
+            try:
+                # goodput ledger: the arrival skew is how long the
+                # fastest rank's tensor sat waiting for the last one —
+                # straggler badput on the coordinator's ledger
+                from horovod_tpu import goodput
+
+                goodput.record_span("straggler_wait", skew)
+            except Exception:
+                pass
         for rank, t in arrivals.items():
             lag = t - t_first
             prev = self.lag_ewma.get(rank)

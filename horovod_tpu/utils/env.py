@@ -141,6 +141,9 @@ ENV_DIRECT_KNOBS = (
     "HOROVOD_COMMS", "HOROVOD_COMMS_WINDOW",
     "HOROVOD_COMMS_EWMA_ALPHA", "HOROVOD_COMMS_DEGRADED_FRACTION",
     "HOROVOD_PROBE_CACHE",
+    # goodput ledger (goodput.py; docs/goodput.md)
+    "HOROVOD_GOODPUT", "HOROVOD_GOODPUT_INCIDENTS",
+    "HOROVOD_GOODPUT_REPORT_SECONDS",
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379

@@ -123,6 +123,15 @@ def note_retry(transport: str, phase: str, attempt: int, delay: float,
     _NET_BACKOFF.labels(transport=transport).inc(delay)
     _emit("net_retry", transport=transport, phase=phase, attempt=attempt,
           delay=round(delay, 4), error=str(exc)[:120])
+    try:
+        # goodput ledger: the backoff sleep about to happen is collective
+        # stall badput (deferred import: utils must not pull upper layers
+        # at module scope)
+        from horovod_tpu import goodput
+
+        goodput.record_span("collective_stall", delay)
+    except Exception:
+        pass
     log.debug("net retry: %s/%s attempt %d in %.3fs (%s)",
               transport, phase, attempt, delay, exc)
 

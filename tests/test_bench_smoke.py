@@ -81,7 +81,12 @@ def test_tiny_flagship_emits_step_breakdown(bench, capsys, monkeypatch):
     function — emits a headline carrying step_breakdown +
     comm_hidden_fraction from the step profiler."""
     result = bench.tiny_main()
+    # tiny_main enables the step profiler via os.environ + configure();
+    # undo BOTH the env var and the module state, or every later test in
+    # the session sees profiler.enabled() == True
     monkeypatch.delenv("HOROVOD_PROFILE", raising=False)
+    from horovod_tpu import profiler
+    profiler.configure()
     assert result["tiny"] is True
     phases = result["step_breakdown"]
     assert set(phases) == {"host", "compute", "exposed_comm", "optimizer"}

@@ -28,6 +28,29 @@ WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "profiler_worker.py")
 
 
+@pytest.fixture(autouse=True)
+def _pristine_profiler_state(monkeypatch):
+    """These tests assert against the module-global profiler's enabled
+    state; start each from a known-disabled baseline so an earlier test
+    that enabled profiling (e.g. via bench.enable_profiler) can't leak
+    into the assertions here. Runs before ``prof``, which re-enables."""
+    from horovod_tpu import profiler
+
+    monkeypatch.delenv("HOROVOD_PROFILE", raising=False)
+    monkeypatch.delenv("HOROVOD_PROFILE_DIR", raising=False)
+    profiler.configure()
+    # drain the bounded history rings too: the relative-slicing idiom
+    # (n0 = len(history()); history()[n0:]) silently returns [] once the
+    # deque hits maxlen (64) — which it always has by this point of a
+    # full-suite run
+    p = profiler._profiler
+    p._steps.clear()
+    p._trace_events.clear()
+    p._mfu_window.clear()
+    p._auto_rec = None
+    yield
+
+
 @pytest.fixture
 def prof(monkeypatch):
     """Profiler enabled for the test, disabled (and ring-isolated via

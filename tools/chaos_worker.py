@@ -106,6 +106,21 @@ def main() -> int:
             result["hier_group_size"] = plan.group_size
     except Exception:
         result["hier_enabled"] = False
+    # goodput ledger (goodput_attribution chaos cell): the matrix asserts
+    # that survivors account their wall-clock — replayed steps charged to
+    # the rollback incident, re-form downtime in elastic_reform — so the
+    # ledger fields ride the result line like the hier_* fields above
+    try:
+        from horovod_tpu import goodput
+
+        led = goodput.tracker().ledger()
+        result["goodput_fraction"] = led["goodput_fraction"]
+        result["goodput_accounted"] = led["accounted_fraction"]
+        result["goodput_badput"] = led["badput_seconds"]
+        result["goodput_replayed"] = led["steps_replayed"]
+        result["goodput_incidents"] = led["incident_counts"]
+    except Exception:
+        pass
     try:  # the postmortem needs post-reform events (elastic_reform)
         flight_recorder.dump_debug_state(reason="chaos_run_complete")
     except Exception:

@@ -83,6 +83,27 @@ def normalize(endpoint: str) -> str:
     return endpoint
 
 
+def discover_routes(endpoints: List[str]) -> Optional[set]:
+    """Union of the routes advertised by the endpoints' ``GET /`` route
+    index (metrics.py). ``None`` when no endpoint serves an index (an
+    older build whose bare root 404s) — callers then probe panels the
+    old way instead of skipping them all."""
+    routes: set = set()
+    any_index = False
+    for ep in endpoints:
+        idx = fetch_json(ep, "/")
+        if isinstance(idx, dict) and isinstance(idx.get("routes"), dict):
+            any_index = True
+            routes.update(idx["routes"])
+    return routes if any_index else None
+
+
+def panel_wanted(routes: Optional[set], route: str) -> bool:
+    """Render the panel backed by ``route``? Yes when some endpoint
+    advertises it, or when no route index exists to consult."""
+    return routes is None or route in routes
+
+
 def render(endpoints: List[str]) -> str:
     header = ["rank", "endpoint", "device", "peak", "limit", "drift"]
     header += list(COLUMNS) + ["other", "rss", "oom"]
@@ -247,6 +268,57 @@ def render_comms(endpoints: List[str]) -> str:
     return "\n".join(out)
 
 
+def render_goodput(endpoints: List[str]) -> str:
+    """Goodput panel: productive fraction of wall-clock, top badput
+    category and incident counts per rank (``GET /goodput``,
+    docs/goodput.md), plus the most recent incident across the fleet.
+    Returns "" when no endpoint exposes the goodput plane (pre-goodput
+    build or HOROVOD_GOODPUT=0)."""
+    header = ["rank", "endpoint", "wall", "goodput", "accounted",
+              "top badput", "steps", "replayed", "incidents"]
+    rows: List[List[str]] = []
+    latest = None  # (wall_time, rank, incident)
+    for ep in endpoints:
+        gp = fetch_json(ep, "/goodput")
+        if gp is None or "goodput_fraction" not in gp:
+            continue
+        badput: Dict[str, float] = gp.get("badput_seconds") or {}
+        top = max(badput, key=badput.get) if badput else None
+        incidents = gp.get("incidents") or []
+        for inc in incidents:
+            if not isinstance(inc, dict):
+                continue
+            t = inc.get("wall_time")
+            if isinstance(t, (int, float)) and \
+                    (latest is None or t > latest[0]):
+                latest = (t, gp.get("rank", "?"), inc)
+        rows.append(
+            [str(gp.get("rank", "?")), ep,
+             "%.0fs" % gp.get("wall_seconds", 0.0),
+             "%.1f%%" % (100.0 * gp.get("goodput_fraction", 0.0)),
+             "%.1f%%" % (100.0 * gp.get("accounted_fraction", 0.0)),
+             ("%s %.1fs" % (top, badput[top])) if top else "-",
+             str(gp.get("steps_productive", 0)),
+             str(gp.get("steps_replayed", 0)),
+             str(sum((gp.get("incident_counts") or {}).values()))])
+    if not rows:
+        return ""
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows), 1)
+              for i in range(len(header))]
+    out = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        out.append("  ".join(r[i].ljust(widths[i])
+                             for i in range(len(header))))
+    if latest is not None:
+        _, rank, inc = latest
+        out.append("last incident: %s on rank %s — %.1fs%s" % (
+            inc.get("cause", "?"), rank,
+            float(inc.get("duration_s", 0.0)),
+            (", culprit rank %s" % inc["culprit_rank"])
+            if inc.get("culprit_rank") is not None else ""))
+    return "\n".join(out)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="live per-rank memory ledger (polls /memory)")
@@ -263,14 +335,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("hvd_top  %s  (%d endpoint%s)" % (
             stamp, len(endpoints), "" if len(endpoints) == 1 else "s"))
         print(render(endpoints))
-        comms_panel = render_comms(endpoints)
-        if comms_panel:
-            print()
-            print(comms_panel)
-        slo_panel = render_slo(endpoints)
-        if slo_panel:
-            print()
-            print(slo_panel)
+        # the GET / route index says which panels this fleet can back;
+        # with no index (older build) every panel probes as before
+        routes = discover_routes(endpoints)
+        if panel_wanted(routes, "/comms"):
+            comms_panel = render_comms(endpoints)
+            if comms_panel:
+                print()
+                print(comms_panel)
+        if panel_wanted(routes, "/goodput"):
+            goodput_panel = render_goodput(endpoints)
+            if goodput_panel:
+                print()
+                print(goodput_panel)
+        if panel_wanted(routes, "/slo"):
+            slo_panel = render_slo(endpoints)
+            if slo_panel:
+                print()
+                print(slo_panel)
         if args.once:
             return 0
         sys.stdout.flush()
