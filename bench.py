@@ -1319,21 +1319,27 @@ def _bert_large_param_shapes():
 
 
 def sharded_optimizer_main(tiny: bool = False):
-    """ZeRO-1 sharded-optimizer microbench: the optimizer UPDATE phase
+    """ZeRO sharded-training microbench: the optimizer UPDATE phase
     (gradient reduction + AdamW + new params on every chip) at the
-    BERT-Large parameter shape, replicated vs sharded.
+    BERT-Large parameter shape, replicated vs sharded stages 1/2/3.
 
     Replicated: ``allreduce_gradients`` + jitted f32 optax adamw —
-    every chip holds the full mu/nu. Sharded: ``hvd.sharded_adamw`` —
+    every chip holds the full mu/nu. Stage 1: ``hvd.sharded_adamw`` —
     reduce-scatter, fused flat-buffer AdamW on the local fp32
-    master/moment shards, allgather. Reports p50 update ms for both,
-    optimizer-state bytes/chip for both (sharded ≈ replicated/N), and
-    the steady-state program-build count over the timed phase (must be
-    zero — same invariant as the data-plane microbench).
+    master/moment shards, allgather. Stage 2: gradients pre-scattered
+    (``hvd.scatter_gradients``), so only the scatter half of the
+    allreduce rides the wire. Stage 3: params sharded at rest
+    (``hvd.shard_params``) and re-gathered bucket-by-bucket with the
+    prefetch window, as a forward pass would. Each stage reports p50
+    update ms, ``bytes_per_chip`` for params/grads/optimizer state,
+    gradient and total wire bytes per step, and the steady-state
+    program-build count over the timed phase (must be zero — same
+    invariant as the data-plane microbench).
 
     ``tiny`` (--tiny / the tier-1 smoke test): a toy shape + 2 steps."""
     import optax as _optax
 
+    from horovod_tpu.parallel import zero as zero_mod
     from horovod_tpu.parallel.dp import allreduce_gradients
 
     hvd.init()
@@ -1408,8 +1414,97 @@ def sharded_optimizer_main(tiny: bool = False):
     sharded_bytes = _metric_value("horovod_sharded_state_bytes",
                                   _tree_bytes(sh_state))
 
+    # --- per-stage rows: stage 2 (grads pre-scattered) and stage 3
+    # (params sharded at rest + bucket-wise prefetched gather), with wire
+    # bytes per step read off the zero-lane RS/AG counters
+    _RS = "horovod_sharded_reducescatter_bytes_total"
+    _AG = "horovod_sharded_allgather_bytes_total"
+
+    def _spec_shard_bytes(spec):
+        return sum(g.shard_elems * np.dtype(g.dtype).itemsize
+                   for g in spec.groups)
+
+    def _timed_stage(step_fn, p0, s0):
+        lat, marks = [], None
+        p_, s_ = p0, s0
+        for step in range(warmup_steps + timed_steps):
+            if step == warmup_steps:
+                marks = (_metric_value(
+                    "horovod_sharded_program_builds_total", 0),
+                    _metric_value(_RS, 0), _metric_value(_AG, 0))
+            t0 = time.perf_counter()
+            p_, s_ = step_fn(p_, s_)
+            jax.block_until_ready(jax.tree_util.tree_leaves(p_)[0])
+            if step >= warmup_steps:
+                lat.append(time.perf_counter() - t0)
+        builds = (_metric_value("horovod_sharded_program_builds_total", 0)
+                  - marks[0])
+        rs = (_metric_value(_RS, 0) - marks[1]) / timed_steps
+        ag = (_metric_value(_AG, 0) - marks[2]) / timed_steps
+        return float(np.median(lat)), rs, ag, builds, s_
+
+    params_full = _tree_bytes(params)
+    grads_full = _tree_bytes(grads)
+
+    def _stage_row(p50_s, rs, ag, builds, pbytes, gbytes):
+        return {
+            "update_p50_ms": round(p50_s * 1e3, 2),
+            "bytes_per_chip": {
+                "params": int(pbytes), "grads": int(gbytes),
+                "optimizer_state": int(sharded_bytes)},
+            "grad_wire_bytes_per_step": int(rs),
+            "wire_bytes_per_step": int(rs + ag),
+            "steady_state_builds": int(builds),
+        }
+
+    # stage 2: scatter each step's gradients, feed the shard to the
+    # partition-aligned optimizer; the trailing AG rebuilds full params
+    sopt2 = hvd.sharded_adamw(1e-4)
+    s2_state = sopt2.init(params)
+
+    def _step2(p_, s_):
+        sg = zero_mod.scatter_gradients(grads, spec=s_.spec)
+        return sopt2.apply(p_, s_, sg)
+
+    p50_s2, rs2, ag2, builds2, s2_state = _timed_stage(
+        _step2, params, s2_state)
+    grad_shard_bytes = _spec_shard_bytes(s2_state.spec)
+    stage2 = _stage_row(p50_s2, rs2, ag2, builds2,
+                        params_full, grad_shard_bytes)
+
+    # stage 3: params sharded at rest; the update keeps them sharded and
+    # each step re-gathers bucket-by-bucket under the prefetch window,
+    # standing in for the forward pass's on-demand consumption
+    sopt3 = hvd.sharded_adamw(1e-4)
+    sp3 = hvd.shard_params(params)
+    s3_state = sopt3.init(sp3)
+    param_shard_bytes = _spec_shard_bytes(sp3.spec)
+
+    def _step3(p_, s_):
+        sg = zero_mod.scatter_gradients(grads, spec=s_.spec)
+        p_, s_ = sopt3.apply(p_, s_, sg)
+        for _gi, _bucket in hvd.iter_param_buckets(p_):
+            pass
+        return p_, s_
+
+    p50_s3, rs3, ag3, builds3, _ = _timed_stage(_step3, sp3, s3_state)
+    stage3 = _stage_row(p50_s3, rs3, ag3, builds3,
+                        param_shard_bytes, grad_shard_bytes)
+    stage3["gather_hidden_fraction"] = round(
+        zero_mod.gather_hidden_fraction(), 4)
+
     p50_rep = float(np.median(lat_rep))
     p50_sh = float(np.median(lat_sh))
+    stage1 = {
+        "update_p50_ms": round(p50_sh * 1e3, 2),
+        "bytes_per_chip": {
+            "params": int(params_full), "grads": int(grads_full),
+            "optimizer_state": int(sharded_bytes)},
+        # stage 1 exchanges the full gradient: RS + AG = one allreduce
+        "grad_wire_bytes_per_step": int(rs2 + ag2),
+        "wire_bytes_per_step": int(rs2 + ag2),
+        "steady_state_builds": int(steady_builds),
+    }
     result = {
         "metric": f"sharded optimizer update p50 (ZeRO-1 fused AdamW, "
                   f"BERT-Large shape {n_params / 1e6:.0f}M params, "
@@ -1426,6 +1521,7 @@ def sharded_optimizer_main(tiny: bool = False):
         "state_bytes_reduction_x": (
             round(rep_bytes / sharded_bytes, 2) if sharded_bytes else None),
         "steady_state_program_builds": int(steady_builds),
+        "stages": {"stage1": stage1, "stage2": stage2, "stage3": stage3},
         **memory_rows(),
         **comms_rows(),
         **goodput_rows(),
@@ -1437,6 +1533,14 @@ def sharded_optimizer_main(tiny: bool = False):
         f"{rep_bytes} -> {sharded_bytes} "
         f"({result['state_bytes_reduction_x']}x); steady-state program "
         f"builds {steady_builds}")
+    for sname, row in result["stages"].items():
+        log(f"  {sname}: update p50 {row['update_p50_ms']} ms, "
+            f"bytes/chip params={row['bytes_per_chip']['params']} "
+            f"grads={row['bytes_per_chip']['grads']} "
+            f"opt={row['bytes_per_chip']['optimizer_state']}, grad wire "
+            f"{row['grad_wire_bytes_per_step']} B/step, total wire "
+            f"{row['wire_bytes_per_step']} B/step, steady-state builds "
+            f"{row['steady_state_builds']}")
     print(json.dumps(result), flush=True)
     return result
 

@@ -94,7 +94,13 @@ from horovod_tpu.parallel.dp import (
 from horovod_tpu.parallel.buckets import GradReleasePlan
 from horovod_tpu.parallel.zero import (
     FlatAdamState,
+    ShardedGrads,
     ShardedOptState,
+    ShardedParams,
+    gather_params,
+    iter_param_buckets,
+    scatter_gradients,
+    shard_params,
     sharded_adamw,
     sharded_update,
 )
@@ -169,8 +175,10 @@ __all__ = [
     "Compression",
     # bucket-wise gradient release (overlap allreduce with backward)
     "GradReleasePlan",
-    # ZeRO-1 sharded optimizer states (TPU-first extension)
+    # ZeRO-1/2/3 sharded training (TPU-first extension)
     "sharded_update", "sharded_adamw", "ShardedOptState", "FlatAdamState",
+    "ShardedGrads", "ShardedParams", "scatter_gradients", "shard_params",
+    "gather_params", "iter_param_buckets",
     # sparse/embedding gradients
     "SparseGrad", "sparse_allgather", "with_sparse_embedding_grad",
     # long-context / sequence parallelism (TPU-first extensions)

@@ -43,6 +43,9 @@ FUSION_MB_BOUNDS = (0.0, 64.0)
 CYCLE_MS_BOUNDS = (1.0, 25.0)
 BUCKET_MB_BOUNDS = (1.0, 64.0)
 DEPTH_BOUNDS = (1.0, 4.0)
+# ZeRO-3 gather prefetch window (buckets in flight ahead of consumption);
+# deeper hides more gather latency, shallower bounds transient HBM
+PREFETCH_BOUNDS = (1.0, 8.0)
 
 # Slow-hop wire codecs for the hierarchical cross-group exchange, in
 # packed-byte order (index = the byte in the sync blob). Must stay
@@ -68,8 +71,9 @@ class Params:
     hierarchy_compression: str = "none"  # cross-group wire codec
     grad_bucket_bytes: int = 0           # 0 = keep the configured value
     cycle_pipeline_depth: int = 0        # 0 = keep the configured value
+    zero_prefetch_buckets: int = 0       # 0 = keep the configured value
 
-    _FMT = "<qdBBBBBBqB"
+    _FMT = "<qdBBBBBBqBB"
 
     def pack(self) -> bytes:
         codec = COMPRESSION_CODECS.index(
@@ -80,18 +84,20 @@ class Params:
             int(self.hierarchical_allgather), int(self.active),
             min(255, max(0, int(self.hierarchy_group_size))), codec,
             int(self.grad_bucket_bytes),
-            min(255, max(0, int(self.cycle_pipeline_depth))))
+            min(255, max(0, int(self.cycle_pipeline_depth))),
+            min(255, max(0, int(self.zero_prefetch_buckets))))
 
     @classmethod
     def unpack(cls, blob: bytes) -> "Params":
         (f, c, ce, ha, hg, act, gsz, codec, bkt,
-         depth) = struct.unpack(cls._FMT, blob)
+         depth, prefetch) = struct.unpack(cls._FMT, blob)
         codec_name = (COMPRESSION_CODECS[codec]
                       if codec < len(COMPRESSION_CODECS) else "none")
         return cls(f, c, bool(ce), bool(ha), bool(hg), bool(act),
                    hierarchy_group_size=gsz,
                    hierarchy_compression=codec_name,
-                   grad_bucket_bytes=bkt, cycle_pipeline_depth=depth)
+                   grad_bucket_bytes=bkt, cycle_pipeline_depth=depth,
+                   zero_prefetch_buckets=prefetch)
 
 
 # Default swept categorical knobs. The hierarchical flags join the sweep
@@ -132,7 +138,7 @@ def search_box_from_roofline(roofline) -> list:
     an artifact (or a pre-hierarchy schema) the static defaults stand.
     """
     box = [FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS, BUCKET_MB_BOUNDS,
-           DEPTH_BOUNDS]
+           DEPTH_BOUNDS, PREFETCH_BOUNDS]
     if not roofline:
         return box
     bw = (roofline.get("hier_cross_busbw_gbps")
@@ -191,7 +197,10 @@ class ParameterManager:
         # search_box_from_roofline) or the static defaults
         self._bounds = list(bounds) if bounds else [
             FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS, BUCKET_MB_BOUNDS,
-            DEPTH_BOUNDS]
+            DEPTH_BOUNDS, PREFETCH_BOUNDS]
+        if len(self._bounds) < 5:
+            # pre-ZeRO-3 caller-seeded box — extend rather than crash
+            self._bounds.append(PREFETCH_BOUNDS)
         self._bo = BayesianOptimization(
             bounds=self._bounds,
             alpha=max(gp_noise, 1e-6) * 1e-2)
@@ -205,8 +214,8 @@ class ParameterManager:
         # are always swept by the Bayesian phase; categoricals only when
         # the data plane consults them.
         self.swept_knobs = ("fusion_threshold_mb", "cycle_time_ms",
-                            "grad_bucket_mb",
-                            "pipeline_depth") + self._sweep
+                            "grad_bucket_mb", "pipeline_depth",
+                            "zero_prefetch_buckets") + self._sweep
         if self._rank == 0:  # coordinator only, like the CSV below
             from horovod_tpu.utils.logging import get_logger
             get_logger().info(
@@ -220,7 +229,8 @@ class ParameterManager:
                         "cache_enabled,hierarchical_allreduce,"
                         "hierarchical_allgather,hierarchy_group_size,"
                         "hierarchy_compression,grad_bucket_mb,"
-                        "pipeline_depth,score_bytes_per_us\n")
+                        "pipeline_depth,zero_prefetch_buckets,"
+                        "score_bytes_per_us\n")
 
     @staticmethod
     def _values_of(knob: str) -> tuple:
@@ -289,7 +299,8 @@ class ParameterManager:
                     f"{int(c.hierarchy_group_size)},"
                     f"{c.hierarchy_compression},"
                     f"{c.grad_bucket_bytes / (1024 * 1024):.3f},"
-                    f"{int(c.cycle_pipeline_depth)},{score:.3f}\n")
+                    f"{int(c.cycle_pipeline_depth)},"
+                    f"{int(c.zero_prefetch_buckets)},{score:.3f}\n")
 
     def _record(self, score: float) -> None:
         self._log(score)
@@ -334,7 +345,9 @@ class ParameterManager:
                 max(self._bounds[2][0],
                     self.current.grad_bucket_bytes / (1024.0 * 1024.0)),
                 max(self._bounds[3][0],
-                    float(self.current.cycle_pipeline_depth))])
+                    float(self.current.cycle_pipeline_depth)),
+                max(self._bounds[4][0],
+                    float(self.current.zero_prefetch_buckets))])
             self._bo.add_sample(x, score)
             self._bo_remaining -= 1
             if self._bo_remaining <= 0:
@@ -355,6 +368,8 @@ class ParameterManager:
             self._bounds[2][1])) * 1024 * 1024)
         self.current.cycle_pipeline_depth = int(round(float(np.clip(
             x[3], DEPTH_BOUNDS[0], DEPTH_BOUNDS[1]))))
+        self.current.zero_prefetch_buckets = int(round(float(np.clip(
+            x[4], PREFETCH_BOUNDS[0], PREFETCH_BOUNDS[1]))))
 
     def _finish(self) -> None:
         """Freeze at the best configuration seen (reference: tuning ends and

@@ -336,11 +336,13 @@ class ArrayState(State):
         """Re-broadcast from ``root_rank`` (after a re-form: the lowest
         surviving rank, renumbered 0 — see runner._reform).
 
-        ZeRO-sharded optimizer leaves (``zero.is_sharded_state``) are NOT
-        broadcast — rank 0's shard would clobber every other rank's
+        ZeRO-sharded leaves (``zero.is_sharded_state``: stage-1 optimizer
+        states, stage-2 ``ShardedGrads``, stage-3 ``ShardedParams``) are
+        NOT broadcast — rank 0's shard would clobber every other rank's
         distinct shard; they re-shard collectively via ``zero.resync``
-        against the just-broadcast params (``_tree_names`` orders params
-        first, so the fp32-master refill sees synced values)."""
+        against the just-synced params (``_tree_names`` orders params
+        first, so the fp32-master refill sees synced values; a stage-3
+        params tree re-shards first and later states gather from it)."""
         import jax
 
         from horovod_tpu.ckpt import replica

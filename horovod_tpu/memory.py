@@ -9,6 +9,10 @@ live-bytes and high-watermarks per byte-holding subsystem:
   update path (:mod:`horovod_tpu.parallel.dp`);
 * ``optimizer_shards`` — pushed by the ZeRO-1 state accounting
   (:mod:`horovod_tpu.parallel.zero`);
+* ``grad_shards`` / ``param_shards`` — pushed by the ZeRO-2/3 shard
+  accounting (:mod:`horovod_tpu.parallel.zero`): gradients living only
+  as the local 1/N shard after a reduce-scatter release, and parameters
+  sharded at rest between on-demand gathers;
 * ``fusion`` / ``ckpt_staging`` — pulled from the fusion-buffer slab
   registry (:func:`horovod_tpu.runtime.fusion_buffer.bytes_by_purpose`),
   which distinguishes resident slab bytes from *leased* (live) bytes so
@@ -75,8 +79,9 @@ _SAMPLE_RING = 512  # bounded: ~85 min of samples at the default cadence
 
 _BYTES = _metrics().gauge(
     "horovod_memory_bytes",
-    "Live bytes claimed per subsystem (params, grads, optimizer_shards, "
-    "fusion, ckpt_staging, serve_kv, kv_pages, program_cache, host_rss).",
+    "Live bytes claimed per subsystem (params, grads, param_shards, "
+    "grad_shards, optimizer_shards, fusion, ckpt_staging, serve_kv, "
+    "kv_pages, program_cache, host_rss).",
     labelnames=("subsystem",))
 _PEAK_BYTES = _metrics().gauge(
     "horovod_memory_peak_bytes",
@@ -109,8 +114,8 @@ _OOMS = _metrics().counter(
 
 # subsystems whose bytes live in device memory (HBM) — the reconciliation
 # set; everything else (fusion slabs, ckpt staging, host_rss) is host-side
-DEVICE_SUBSYSTEMS = ("params", "grads", "optimizer_shards", "serve_kv",
-                     "kv_pages")
+DEVICE_SUBSYSTEMS = ("params", "grads", "param_shards", "grad_shards",
+                     "optimizer_shards", "serve_kv", "kv_pages")
 
 
 def host_rss_bytes() -> int:

@@ -56,6 +56,14 @@ Correctness contract (mirrors the PR-3 fusion rules):
   :meth:`GradReleasePlan.gather` drains the remaining handles and
   resets, so the next generation starts clean.
 
+ZeRO-2 composition: ``GradReleasePlan(reduce_scatter=True)`` releases
+each bucket as a **reduce-scatter** instead of an allreduce — only the
+local 1/N shard comes back ((N-1)/N bus bytes per payload byte, half an
+allreduce) and ``gather()`` returns a ``zero.ShardedGrads`` that
+``sharded_adamw`` / ``sharded_update`` consume directly. Build the
+optimizer with ``partition=plan.zero_partition(params)`` so the shard
+layouts line up. See ``parallel/zero.py``.
+
 Knobs: ``HOROVOD_GRAD_BUCKET_BYTES`` (target bucket payload, default
 4 MiB, rounded up to the fusion quantum), ``HOROVOD_GRAD_BUCKET_WIRE``
 (``auto``/``off`` — whether single-controller replicated gradients are
@@ -168,7 +176,7 @@ class GradReleasePlan:
 
     def __init__(self, *, bucket_bytes: Optional[int] = None,
                  every_k: int = 1, average: bool = True,
-                 name: str = "grad"):
+                 name: str = "grad", reduce_scatter: bool = False):
         if every_k < 1:
             raise ValueError("every_k must be >= 1")
         self.bucket_bytes = (bucket_bytes if bucket_bytes is not None
@@ -176,6 +184,11 @@ class GradReleasePlan:
         self.every_k = every_k
         self.average = average
         self.name = name
+        # ZeRO-2: release each bucket as a reduce-scatter and keep only
+        # the local 1/N shard — gather() then returns a
+        # zero.ShardedGrads for the sharded optimizer to consume
+        # directly (half the gradient bus bytes of an allreduce)
+        self.reduce_scatter = bool(reduce_scatter)
         # partition (filled by _ensure_partition on first tag)
         self._num_leaves: Optional[int] = None
         self._buckets: List[_Bucket] = []
@@ -191,6 +204,14 @@ class GradReleasePlan:
         # locally-reduced leaves land in _local instead of carrying handles
         self._released: List[tuple] = []
         self._local: Dict[int, Any] = {}
+        # reduce-scatter mode: per-leaf shape/dtype metadata (for the
+        # zero spec + zero-filling partial buckets), the bucket-aligned
+        # ZeroSpec, its bucket->group map, and the per-group results
+        self._leaf_meta: Dict[int, tuple] = {}
+        self._zspec = None
+        self._groups_of_bucket: Dict[int, List[int]] = {}
+        self._rs_released: List[tuple] = []  # (bucket, [(gi, h)], t, B)
+        self._shard_local: Dict[int, Any] = {}  # gi -> (W, shard)
         # traced-lane token for optimization_barrier chaining (valid only
         # within the enclosing trace; reset by tag())
         self._token = None
@@ -230,11 +251,47 @@ class GradReleasePlan:
         for b in self._buckets:
             for i in b.leaves:
                 self._bucket_of[i] = b
+                self._leaf_meta[i] = (tuple(np.shape(leaves[i])),
+                                      np.dtype(leaves[i].dtype))
 
     def buckets(self) -> List[List[int]]:
         """The computed partition (leaf positions per bucket, release
         order) — empty before the first ``tag`` call."""
         return [list(b.leaves) for b in self._buckets]
+
+    def zero_partition(self, params) -> List[List[int]]:
+        """The bucket partition as a ``zero.build_spec`` partition —
+        hand this to ``sharded_adamw(..., partition=...)`` /
+        ``sharded_update(..., partition=...)`` so the optimizer's shard
+        layout lines up 1:1 with the reduce-scatter release buckets."""
+        leaves, _ = jax.tree_util.tree_flatten(params)
+        self._ensure_partition(leaves)
+        return self.buckets()
+
+    def _ensure_zspec(self, st):
+        """Bucket-aligned ZeroSpec (one dtype group per bucket cell),
+        rebuilt when the world re-forms — bucket programs stay keyed on
+        the spec, so a stable world means zero new compiles."""
+        from horovod_tpu.ops import collectives
+        from horovod_tpu.parallel import zero
+
+        rank = (st.rank if collectives._multiprocess_world(st) else 0)
+        spec = self._zspec
+        if (spec is not None and spec.world == st.size
+                and spec.rank == rank):
+            return spec
+        metas = [None] * (self._num_leaves or 0)
+        for i, (shape, dtype) in self._leaf_meta.items():
+            metas[i] = zero.LeafMeta(shape=shape, dtype=dtype)
+        spec = zero.build_spec(metas, st.size, rank,
+                               zero._quantum_bytes(st),
+                               partition=self.buckets())
+        self._groups_of_bucket = {}
+        for gi, g in enumerate(spec.groups):
+            b = self._bucket_of[g.indices[0]]
+            self._groups_of_bucket.setdefault(b.index, []).append(gi)
+        self._zspec = spec
+        return spec
 
     # -- tagging ------------------------------------------------------------
     def _tag_for(self, i: int):
@@ -337,6 +394,8 @@ class GradReleasePlan:
         from horovod_tpu.core import basics
         from horovod_tpu.ops import collectives
 
+        if self.reduce_scatter:
+            return self._release_reduce_scatter(bucket, values)
         st = basics._ensure_init()
         reduce_op = "average" if self.average else "sum"
         wire_idx: List[int] = []
@@ -391,6 +450,78 @@ class GradReleasePlan:
                                list(zip(wire_idx, handles)),
                                time.monotonic(), wire_bytes))
 
+    def _release_reduce_scatter(self, bucket: _Bucket,
+                                values: Dict[int, Any]) -> None:
+        """ZeRO-2 release: pack the bucket's dtype groups and
+        reduce-scatter each one — only the local 1/N shard comes back.
+        Multi-process rides the runtime's reduce-scatter lane under
+        stable per-group names; single-controller replicated takes the
+        same local short-circuit (and the same bits) as the stage-1
+        eager path via a cached worker-sharded program."""
+        from horovod_tpu.core import basics
+        from horovod_tpu.ops import collectives
+        from horovod_tpu.parallel import zero
+
+        st = basics._ensure_init()
+        spec = self._ensure_zspec(st)
+        multiproc = (collectives._multiprocess_world(st)
+                     and collectives._runtime_capable(st))
+        if collectives._multiprocess_world(st) and not multiproc:
+            raise NotImplementedError(
+                "reduce-scatter gradient release in a multi-process "
+                "world needs the enqueue runtime (tpurun / HOROVOD_RANK "
+                "env contract)")
+        pairs: List[tuple] = []
+        wire_bytes = 0
+        for gi in self._groups_of_bucket.get(bucket.index, []):
+            g = spec.groups[gi]
+            vals = {}
+            for li, shape, _size in zip(g.indices, g.shapes, g.sizes):
+                v = values.get(li)
+                if v is None:
+                    # partial bucket (a leaf produced no cotangent):
+                    # zeros are the reduction identity
+                    v = np.zeros(shape, np.dtype(g.dtype))
+                vals[li] = v
+            nbytes = g.padded * np.dtype(g.dtype).itemsize
+            zero._RS_BYTES.inc(int(nbytes))
+            # bucket_wire convention matches the allreduce release: the
+            # multi-process lane counts per-rank tensor bytes; the
+            # single-controller simulated wire counts the whole (W, n)
+            # plane — so the stage-2 bus ratio vs the allreduce baseline
+            # reads exactly 0.5 off the ledger in either mode
+            wire_bytes += int(nbytes) * (1 if multiproc else st.size)
+            if multiproc:
+                op_name = collectives._OP_NAMES[
+                    collectives.Average if self.average
+                    else collectives.Sum]
+                from horovod_tpu.runtime.runtime import get_runtime
+
+                flat = zero._np_pack_group(vals, g)
+                h = get_runtime().enqueue_reducescatter(
+                    f"zero2.{self.name}.b{bucket.index}.g{gi}",
+                    jnp.asarray(flat), reduce_op=op_name,
+                    priority=len(self._buckets) - bucket.index)
+                pairs.append((gi, h))
+            else:
+                stacked_flags = [
+                    collectives._is_worker_stacked(
+                        collectives._to_plane(vals[li]))
+                    for li in g.indices]
+                if any(stacked_flags) and not all(stacked_flags):
+                    raise ValueError(
+                        "reduce-scatter release needs a bucket's leaves "
+                        "uniformly worker-stacked or uniformly "
+                        "replicated, got a mix")
+                self._shard_local[gi] = zero.scatter_bucket_group(
+                    vals, spec, gi, st, average=self.average,
+                    stacked=all(stacked_flags))
+        if pairs:
+            with self._wire_lock:
+                self._wire_released += len(pairs)
+        self._rs_released.append((bucket.index, pairs, time.monotonic(),
+                                  wire_bytes))
+
     def _on_wire_complete(self, ok: bool) -> None:
         # runs on the runtime cycle thread as each entry completes/fails
         with self._wire_lock:
@@ -424,6 +555,8 @@ class GradReleasePlan:
             self._pass_idx += 1
             return None
         self._flush()
+        if self.reduce_scatter:
+            return self._gather_shards()
         from horovod_tpu.ops import collectives
 
         out = list(leaves)
@@ -451,6 +584,57 @@ class GradReleasePlan:
             raise failure
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    def _gather_shards(self):
+        """Drain the per-bucket reduce-scatters in release order and
+        assemble the :class:`zero.ShardedGrads` the sharded optimizer
+        consumes directly — the full-gradient buffer is never
+        reassembled. One ``bucket_wire`` comms record per bucket
+        (op=reducescatter: the ledger's busbw math charges (N-1)/N bus
+        bytes per payload byte — half an allreduce's 2(N-1)/N)."""
+        from horovod_tpu.ops import collectives
+        from horovod_tpu.parallel import zero
+
+        spec = self._zspec
+        if spec is None:  # no bucket ever released (empty tree)
+            from horovod_tpu.core import basics
+
+            spec = self._ensure_zspec(basics._ensure_init())
+        shards: List[Any] = [None] * len(spec.groups)
+        failure = None
+        for _bucket_idx, pairs, t_release, wire_bytes in self._rs_released:
+            bucket_ok = True
+            for gi, h in pairs:
+                try:
+                    out = collectives.synchronize(h)
+                    shards[gi] = jnp.asarray(out).astype(
+                        np.dtype(spec.groups[gi].dtype))
+                except Exception as exc:  # drain the rest first
+                    bucket_ok = False
+                    if failure is None:
+                        failure = exc
+            if bucket_ok and wire_bytes:
+                comms.record("reducescatter", "bucket_wire", wire_bytes,
+                             time.monotonic() - t_release,
+                             world=spec.world)
+        for gi, s in self._shard_local.items():
+            shards[gi] = s
+        from horovod_tpu.core import basics
+
+        mp = collectives._multiprocess_world(basics._ensure_init())
+        for gi, s in enumerate(shards):
+            if s is None:
+                # a whole bucket produced no cotangents and was never
+                # released — its shard is the reduction identity
+                g = spec.groups[gi]
+                shape = ((g.shard_elems,) if mp
+                         else (spec.world, g.shard_elems))
+                shards[gi] = jnp.zeros(shape, np.dtype(g.dtype))
+        self._reset_step()
+        if failure is not None:
+            raise failure
+        zero._set_shard_bytes("grad_shards", shards, spec.world)
+        return zero.ShardedGrads(spec, tuple(shards))
+
     def _flush(self) -> None:
         """Release any buckets whose countdown never hit zero (a leaf
         that produced no cotangent — e.g. an unused parameter). Partial
@@ -474,16 +658,22 @@ class GradReleasePlan:
         self._accum.clear()
         self._released = []
         self._local = {}
+        self._rs_released = []
+        self._shard_local = {}
         self._token = None
 
     def abort(self) -> None:
         """Drain every in-flight handle (ignoring errors) and reset —
         for callers that abandon a step without gathering (elastic
-        re-form paths)."""
-        for _bucket_idx, pairs, _t_release, _wire_bytes in self._released:
+        re-form paths). An elastic reform also invalidates the
+        bucket-aligned zero spec (the world changed), so it is dropped
+        and lazily rebuilt on the next release."""
+        for _bucket_idx, pairs, _t_release, _wire_bytes in (
+                list(self._released) + list(self._rs_released)):
             for _i, h in pairs:
                 try:
                     h.wait()
                 except Exception:
                     pass
+        self._zspec = None
         self._reset_step()

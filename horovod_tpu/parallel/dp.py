@@ -106,7 +106,14 @@ def allreduce_gradients(grads, *, average: bool = True,
     time would divide (or multiply) by the world size twice.
     """
     from horovod_tpu.parallel import buckets as buckets_mod
+    from horovod_tpu.parallel import zero as zero_mod
 
+    if isinstance(grads, zero_mod.ShardedGrads):
+        raise TypeError(
+            "allreduce_gradients got a zero.ShardedGrads: stage-2 gradients "
+            "are already the reduced local shard — feed them straight to a "
+            "partition-aligned zero.sharded_adamw / zero.sharded_update "
+            "instead of re-reducing them")
     if buckets_mod.is_prereduced():
         return grads
     leaves, treedef = jax.tree_util.tree_flatten(
@@ -178,6 +185,14 @@ def DistributedOptimizer(
     updates for elementwise inner transforms. Requires
     ``backward_passes_per_step == 1`` (MultiSteps' internal ``lax.cond``
     would trace the eager sharded data plane).
+
+    Stages 2/3 ride the same wrapper: pass a ``zero.ShardedGrads`` (from
+    ``zero.scatter_gradients`` or a ``GradReleasePlan(reduce_scatter=True)``)
+    as the grads and the reduce-scatter phase is skipped — the wire cost
+    drops to half an allreduce because only the scatter half ran. Params
+    sharded at rest (``zero.shard_params``) make the update return a
+    ``zero.ShardedParams`` and skip the trailing allgather too (stage 3);
+    gather buckets on demand with ``zero.iter_param_buckets``.
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")

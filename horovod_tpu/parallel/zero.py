@@ -1,4 +1,4 @@
-"""ZeRO-1 sharded optimizer state over the Horovod data plane.
+"""ZeRO-1/2/3 sharded training over the Horovod data plane.
 
 Horovod's data-parallel contract replicates optimizer state on every
 worker. ZeRO stage-1 (Rajbhandari et al., 2020) keeps the same contract
@@ -10,6 +10,34 @@ optimizer state 1/N ways, by decomposing the allreduce into
 Same bytes on the wire as an allreduce (a ring allreduce IS a
 reduce-scatter followed by an allgather), but each chip touches only
 1/N of the optimizer state per step and holds only 1/N of it in HBM.
+
+Stages 2 and 3 drop the "same bytes" part:
+
+* **Stage 2** — gradients live only as the local 1/N shard.
+  :func:`scatter_gradients` (or ``GradReleasePlan(reduce_scatter=True)``
+  bucket-by-bucket during backprop) produces a :class:`ShardedGrads`,
+  and the update functions consume it directly, skipping their internal
+  reduce-scatter. A reduce-scatter moves (N-1)/N bytes per payload byte
+  where an allreduce moves 2(N-1)/N — gradient wire bytes per step are
+  halved (visible as busbw on the ``zero``/``bucket_wire`` comms
+  lanes), and gradient HBM drops to 1/N (``grad_shards`` in the memory
+  ledger).
+
+* **Stage 3** — parameters are sharded at rest (:class:`ShardedParams`,
+  built by :func:`shard_params`) and gathered on demand bucket-by-bucket
+  (:func:`iter_param_buckets` / :func:`gather_params`): group k+1's
+  allgather is dispatched while group k is being consumed, with the
+  in-flight window bounded by ``HOROVOD_ZERO_PREFETCH_BUCKETS``.
+  ``sharded_adamw.apply`` given ``ShardedParams`` updates the shards in
+  place of the full tree and returns a new ``ShardedParams`` — no
+  trailing param allgather at all; the forward pass re-gathers under
+  compute. Gather stalls are charged to the goodput tracker's
+  ``exposed_comm`` category, and the hidden (overlapped) fraction is
+  exported as ``horovod_zero_gather_hidden_fraction``.
+
+``HOROVOD_ZERO_STAGE`` selects the stage for the stock training-step
+wiring (:func:`stage_from_env`); the functional API above works at any
+stage explicitly.
 
 The gradient pytree is flattened into one flat buffer per dtype group
 (reusing the PR-3 size-bucket policy: per-rank shard lengths are padded
@@ -108,11 +136,70 @@ _PROGRAM_BUILDS = _metrics().counter(
     "horovod_sharded_program_builds_total",
     "Compiled sharded-step programs built (steady state goes flat: "
     "bucket-stable shapes mean zero new compiles after warmup).")
+_GATHER_STALL_SECONDS = _metrics().counter(
+    "horovod_zero_gather_stall_seconds_total",
+    "Wall seconds the consumer was blocked waiting on a stage-3 "
+    "parameter allgather (exposed communication).")
+_GATHER_HIDDEN_SECONDS = _metrics().counter(
+    "horovod_zero_gather_hidden_seconds_total",
+    "Wall seconds of stage-3 parameter allgather transfer overlapped "
+    "under consumer compute (hidden communication).")
+_GATHER_HIDDEN_FRACTION = _metrics().gauge(
+    "horovod_zero_gather_hidden_fraction",
+    "Cumulative fraction of stage-3 gather transfer time hidden under "
+    "compute: hidden / (hidden + stalled).")
+
+
+# ---------------------------------------------------------------------------
+# Stage selection + stage-3 prefetch window knobs
+# ---------------------------------------------------------------------------
+
+HOROVOD_ZERO_STAGE = "HOROVOD_ZERO_STAGE"
+HOROVOD_ZERO_PREFETCH_BUCKETS = "HOROVOD_ZERO_PREFETCH_BUCKETS"
+DEFAULT_ZERO_PREFETCH_BUCKETS = 2
+
+_autotuned_prefetch_buckets = 0
+
+
+def stage_from_env() -> int:
+    """ZeRO stage for the stock wiring: 1 (optimizer state only, the
+    default), 2 (+ gradient shards via reduce-scatter release), 3
+    (+ params sharded at rest). Clamped to [1, 3]."""
+    raw = env_mod._get_int(HOROVOD_ZERO_STAGE, 1)
+    return max(1, min(3, raw))
+
+
+def set_autotuned_prefetch_buckets(n: int) -> None:
+    """Autotuner commit hook: override the stage-3 prefetch window
+    (``parameter_manager`` sweeps ``zero_prefetch_buckets`` alongside
+    bucket bytes and pipeline depth). 0 clears the override."""
+    global _autotuned_prefetch_buckets
+    _autotuned_prefetch_buckets = max(0, int(n))
+
+
+def prefetch_buckets_from_env() -> int:
+    """Stage-3 prefetch window: how many group allgathers may be in
+    flight ahead of the consumer (bounds transient HBM to roughly
+    window x group bytes). Autotuned value wins over the env knob."""
+    if _autotuned_prefetch_buckets > 0:
+        return _autotuned_prefetch_buckets
+    raw = env_mod._get_int(HOROVOD_ZERO_PREFETCH_BUCKETS,
+                           DEFAULT_ZERO_PREFETCH_BUCKETS)
+    return max(1, raw)
 
 
 # ---------------------------------------------------------------------------
 # Flat layout spec
 # ---------------------------------------------------------------------------
+
+class LeafMeta(NamedTuple):
+    """Shape/dtype stand-in for a pytree leaf — enough for
+    :func:`build_spec` to lay out a flat buffer without holding the
+    (possibly freed) array itself."""
+
+    shape: tuple
+    dtype: Any
+
 
 class GroupSpec(NamedTuple):
     """Flat layout of one same-dtype group of pytree leaves."""
@@ -148,19 +235,34 @@ def _quantum_bytes(st) -> int:
 
 
 def build_spec(leaves, world: int, rank: int,
-               quantum_bytes: int) -> ZeroSpec:
+               quantum_bytes: int, *, partition=None) -> ZeroSpec:
     """Group ``leaves`` by dtype and lay each group out as one flat
     buffer whose per-rank shard is a PR-3 size bucket (identity at or
     under ``quantum_bytes``, next power-of-two multiple above), so the
-    padded total splits evenly into ``world`` bucket-stable shards."""
-    by_dtype: dict = {}
-    for i, leaf in enumerate(leaves):
-        # .name, not .str: extension dtypes (bfloat16) stringify to a
-        # raw void ('<V2') under .str and would not round-trip
-        by_dtype.setdefault(np.dtype(leaf.dtype).name, []).append(i)
+    padded total splits evenly into ``world`` bucket-stable shards.
+
+    ``partition`` — optional ordered list of leaf-index cells (e.g. a
+    ``GradReleasePlan``'s reverse-topological buckets). Each cell
+    becomes its own group (split by dtype if mixed), preserving cell
+    order, so bucket-wise reduce-scatters and the optimizer's shard
+    layout line up 1:1. Omitted leaves form no group."""
+    cells = []
+    if partition is None:
+        by_dtype: dict = {}
+        for i, leaf in enumerate(leaves):
+            # .name, not .str: extension dtypes (bfloat16) stringify to
+            # a raw void ('<V2') under .str and would not round-trip
+            by_dtype.setdefault(np.dtype(leaf.dtype).name, []).append(i)
+        cells = [(dts, by_dtype[dts]) for dts in sorted(by_dtype)]
+    else:
+        for cell in partition:
+            by_dtype = {}
+            for i in cell:
+                by_dtype.setdefault(
+                    np.dtype(leaves[i].dtype).name, []).append(i)
+            cells.extend((dts, by_dtype[dts]) for dts in sorted(by_dtype))
     groups = []
-    for dts in sorted(by_dtype):
-        idxs = by_dtype[dts]
+    for dts, idxs in cells:
         dt = np.dtype(dts)
         shapes = tuple(tuple(leaves[i].shape) for i in idxs)
         sizes = tuple(int(np.prod(s, dtype=np.int64)) for s in shapes)
@@ -291,6 +393,426 @@ def _set_state_bytes(inner_state, world: int) -> None:
     memory.tracker().set_bytes("optimizer_shards", total)
 
 
+def _set_shard_bytes(subsystem: str, shards, world: int) -> int:
+    """Memory-ledger accounting for grad/param shards (PR-13 satellite:
+    ``grad_shards`` / ``param_shards`` are first-class subsystems).
+    Stacked (W, shard) single-controller arrays count 1/W per chip."""
+    total = 0
+    for leaf in shards:
+        if not hasattr(leaf, "shape"):
+            continue
+        nbytes = int(np.prod(leaf.shape, dtype=np.int64)
+                     * np.dtype(leaf.dtype).itemsize)
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == world:
+            nbytes //= world
+        total += nbytes
+    from horovod_tpu import memory
+
+    memory.tracker().set_bytes(subsystem, total)
+    return total
+
+
+_MODULE_PROGS: dict = {}
+
+
+def _module_prog(key, builder):
+    """Module-level cached-program table for the stage-2/3 functional
+    API (scatter_gradients / shard_params / gather) — same
+    zero-steady-state-compile contract as the per-optimizer closures."""
+    fn = _MODULE_PROGS.get(key)
+    if fn is None:
+        _PROGRAM_BUILDS.inc()
+        fn = builder()
+        _MODULE_PROGS[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Stage 2: gradients as shards (reduce-scatter, no full-gradient buffer)
+# ---------------------------------------------------------------------------
+
+class ShardedGrads(NamedTuple):
+    """Gradients living only as the local 1/N shard (ZeRO-2): one flat
+    array per dtype group — ``(shard,)`` local in multi-process/traced
+    mode, ``(W, shard)`` worker-sharded single-controller. Produced by
+    :func:`scatter_gradients` or a reduce-scatter
+    ``GradReleasePlan``; consumed directly by ``sharded_update`` /
+    ``sharded_adamw.apply`` (which then skip their internal
+    reduce-scatter)."""
+
+    spec: ZeroSpec
+    shards: tuple
+
+
+def _check_shard_spec(got: ZeroSpec, want: ZeroSpec, what: str) -> None:
+    if got.groups == want.groups and got.world == want.world:
+        return
+    raise ValueError(
+        f"{what} layout does not match the sharded optimizer state — "
+        "build both from the same partition (e.g. sharded_adamw(..., "
+        "partition=plan.zero_partition(params)) next to a "
+        "reduce-scatter GradReleasePlan), and re-init/resync after an "
+        "elastic reform")
+
+
+def scatter_bucket_group(values: dict, spec: ZeroSpec, gi: int, st, *,
+                         average: bool, stacked: bool):
+    """Single-controller reduce-scatter of one group's leaves (``values``
+    maps leaf index -> array) into a worker-sharded ``(W, shard)`` flat
+    array. Replicated inputs take the same short-circuit (and the same
+    bits) as the replicated allreduce path; worker-stacked inputs
+    reduce across the stack. Cached per (mesh, spec, group)."""
+    g = spec.groups[gi]
+
+    def build():
+        def f(vals):
+            dt = np.dtype(g.dtype)
+            if stacked:
+                flat = _pack_group_stacked(vals, g, spec.world)
+                r = (jnp.mean(flat, axis=0) if average
+                     else jnp.sum(flat, axis=0))
+            else:
+                flat = _pack_group(vals, g)
+                r = flat if average else flat * spec.world
+            return jnp.reshape(r.astype(dt), (spec.world, g.shard_elems))
+
+        return jax.jit(f, out_shardings=mesh_mod.worker_sharding(st.mesh))
+
+    key = ("zb2s", st.mesh, spec, gi, stacked, average)
+    return _module_prog(key, build)(values)
+
+
+def scatter_gradients(grads, *, spec: ZeroSpec = None,
+                      average: bool = True, compression=Compression.none,
+                      axis_name=None, partition=None) -> ShardedGrads:
+    """Reduce-scatter a full gradient pytree into :class:`ShardedGrads`
+    — the stage-2 entry point when gradients arrive whole (for
+    bucket-by-bucket release during backprop use
+    ``GradReleasePlan(reduce_scatter=True)`` instead).
+
+    ``spec`` aligns the shard layout with an existing optimizer state
+    (pass ``state.spec``); otherwise a fresh spec is built (optionally
+    from ``partition``). ``compression`` rides the wire exactly as in
+    the stage-1 reduce-scatter phase."""
+    leaves, _ = jax.tree_util.tree_flatten(grads)
+    _check_dense(leaves)
+    if any(isinstance(x, jax.core.Tracer) for x in leaves):
+        axes = _bound_axes(axis_name)
+        if not axes:
+            raise ValueError(
+                "scatter_gradients traced without a bound mesh axis — "
+                "use shard_map (or run eagerly)")
+        if spec is None:
+            world = int(np.prod([compat.axis_size(a) for a in axes]))
+            spec = build_spec(leaves, world, -1,
+                              _quantum_bytes(basics._ensure_init()),
+                              partition=partition)
+        shards = []
+        for g in spec.groups:
+            flat = _pack_group(leaves, g)
+            wire, ctx = compression.compress(flat)
+            s = lax.psum_scatter(wire, tuple(axes), scatter_dimension=0,
+                                 tiled=True)
+            if average:
+                s = s / spec.world
+            shards.append(compression.decompress(s, ctx)
+                          .astype(np.dtype(g.dtype)))
+        return ShardedGrads(spec, tuple(shards))
+    st = basics._ensure_init()
+    mp = collectives._multiprocess_world(st)
+    if spec is None:
+        spec = build_spec(leaves, st.size, st.rank if mp else 0,
+                          _quantum_bytes(st), partition=partition)
+    if spec.world != st.size:
+        raise ValueError(
+            f"scatter_gradients spec was built for world {spec.world} "
+            f"but the current world is {st.size}")
+    if len(leaves) != spec.num_leaves:
+        raise ValueError(
+            f"gradient tree has {len(leaves)} leaves but the spec was "
+            f"built for {spec.num_leaves}")
+    mode = _mode(leaves, st)
+    if mode == "local":
+        from horovod_tpu.runtime.runtime import get_runtime
+
+        if not collectives._runtime_capable(st):
+            raise NotImplementedError(
+                "scatter_gradients in a multi-process world needs the "
+                "enqueue runtime (tpurun / HOROVOD_RANK env contract)")
+        op_name = collectives._OP_NAMES[
+            collectives.Average if average else collectives.Sum]
+        handles = []
+        for gi, g in enumerate(spec.groups):
+            flat = _np_pack_group(leaves, g)
+            wire, ctx = compression.compress(jnp.asarray(flat))
+            nbytes = int(wire.size * np.dtype(wire.dtype).itemsize)
+            _RS_BYTES.inc(nbytes)
+            flight_recorder.emit(
+                "op_dispatch", op="reducescatter", phase="grad_scatter",
+                shard=spec.rank, group=gi, bytes=nbytes)
+            handles.append((gi, g, ctx, nbytes, time.monotonic(),
+                            get_runtime().enqueue_reducescatter(
+                                f"zero2.grads.g{gi}", wire,
+                                reduce_op=op_name)))
+        shards = [None] * len(spec.groups)
+        for gi, g, ctx, nbytes, t0, h in handles:
+            out = compression.decompress(collectives.synchronize(h), ctx)
+            seconds = time.monotonic() - t0
+            flight_recorder.emit(
+                "op_complete", op="reducescatter", phase="grad_scatter",
+                shard=spec.rank, group=gi, seconds=round(seconds, 6))
+            comms.record("reducescatter", "zero", nbytes, seconds,
+                         world=spec.world)
+            shards[gi] = jnp.asarray(out).astype(np.dtype(g.dtype))
+        shards = tuple(shards)
+    else:
+        stacked = mode == "stacked"
+        rs_bytes = sum(g.padded * np.dtype(g.dtype).itemsize
+                       for g in spec.groups)
+        _RS_BYTES.inc(rs_bytes)
+
+        def build():
+            def f(lvs):
+                outs = []
+                for g in spec.groups:
+                    dt = np.dtype(g.dtype)
+                    if stacked:
+                        flat = _pack_group_stacked(lvs, g, spec.world)
+                        wire, ctx = compression.compress(flat)
+                        r = (jnp.mean(wire, axis=0) if average
+                             else jnp.sum(wire, axis=0))
+                    else:
+                        flat = _pack_group(lvs, g)
+                        wire, ctx = compression.compress(flat)
+                        r = wire if average else wire * spec.world
+                    r = compression.decompress(r, ctx)
+                    outs.append(jnp.reshape(
+                        r.astype(dt), (spec.world, g.shard_elems)))
+                return tuple(outs)
+
+            return jax.jit(
+                f, out_shardings=mesh_mod.worker_sharding(st.mesh))
+
+        key = ("zg2s", st.mesh, spec, stacked, average, compression)
+        shards = _emit_phase(
+            "reducescatter", "grad_scatter", spec.rank, rs_bytes,
+            lambda: _module_prog(key, build)(leaves))
+    _set_shard_bytes("grad_shards", shards, spec.world)
+    return ShardedGrads(spec, tuple(shards))
+
+
+# ---------------------------------------------------------------------------
+# Stage 3: params sharded at rest, gathered on demand with prefetch
+# ---------------------------------------------------------------------------
+
+class ShardedParams:
+    """Parameters sharded at rest (ZeRO-3): one flat array per dtype
+    group (``(shard,)`` local multi-process, ``(W, shard)``
+    worker-sharded single-controller) plus the original tree structure.
+    Registered as a pytree node whose children are the shards, so it
+    rides through ``tree_map`` / checkpoint flattening; the elastic and
+    checkpoint layers stop at it via :func:`is_sharded_state`."""
+
+    __slots__ = ("spec", "treedef", "shards")
+
+    def __init__(self, spec: ZeroSpec, treedef, shards: tuple):
+        self.spec = spec
+        self.treedef = treedef
+        self.shards = tuple(shards)
+
+    def __repr__(self):
+        return (f"ShardedParams(world={self.spec.world}, "
+                f"rank={self.spec.rank}, "
+                f"groups={len(self.spec.groups)})")
+
+
+jax.tree_util.register_pytree_node(
+    ShardedParams,
+    lambda sp: (sp.shards, (sp.spec, sp.treedef)),
+    lambda aux, children: ShardedParams(aux[0], aux[1], tuple(children)))
+
+
+def shard_params(params, *, partition=None) -> ShardedParams:
+    """Shard a full parameter pytree at rest (stage-3 entry): keep only
+    this rank's 1/N flat slice per dtype group and drop the full tree.
+    Eager only — sharding-at-rest is a storage decision, not a traced
+    op. The ``param_shards`` memory-ledger subsystem reflects the
+    resident bytes."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    _check_dense(leaves)
+    if any(isinstance(x, jax.core.Tracer) for x in leaves):
+        raise ValueError(
+            "shard_params is an eager (at-rest) operation; call it "
+            "outside jit/shard_map")
+    st = basics._ensure_init()
+    mp = collectives._multiprocess_world(st)
+    spec = build_spec(leaves, st.size, st.rank if mp else 0,
+                      _quantum_bytes(st), partition=partition)
+    if mp:
+        shards = tuple(
+            jnp.asarray(_np_pack_group(leaves, g)[
+                spec.rank * g.shard_elems:
+                (spec.rank + 1) * g.shard_elems])
+            for g in spec.groups)
+    else:
+        def build():
+            def f(lvs):
+                return tuple(
+                    jnp.reshape(_pack_group(lvs, g),
+                                (spec.world, g.shard_elems))
+                    for g in spec.groups)
+
+            return jax.jit(
+                f, out_shardings=mesh_mod.worker_sharding(st.mesh))
+
+        shards = _module_prog(("zp2s", st.mesh, spec), build)(leaves)
+    sp = ShardedParams(spec, treedef, tuple(shards))
+    _set_shard_bytes("param_shards", sp.shards, spec.world)
+    flight_recorder.emit("zero_shard_params", rank=int(spec.rank),
+                         world=int(spec.world),
+                         groups=len(spec.groups))
+    return sp
+
+
+def _account_gather(stall: float, hidden: float) -> None:
+    _GATHER_STALL_SECONDS.inc(stall)
+    _GATHER_HIDDEN_SECONDS.inc(hidden)
+    stall_total = _GATHER_STALL_SECONDS.value
+    hidden_total = _GATHER_HIDDEN_SECONDS.value
+    if stall_total + hidden_total > 0:
+        _GATHER_HIDDEN_FRACTION.set(
+            hidden_total / (stall_total + hidden_total))
+    if stall > 0:
+        # goodput satellite: a stage-3 gather stall is exposed
+        # communication, not input idleness — the step was compute-ready
+        # and waiting on the wire
+        from horovod_tpu import goodput
+
+        goodput.record_span("exposed_comm", stall)
+
+
+def gather_hidden_fraction() -> float:
+    """Cumulative fraction of stage-3 param-gather transfer time hidden
+    under consumer compute (0.0 before any gather)."""
+    total = _GATHER_STALL_SECONDS.value + _GATHER_HIDDEN_SECONDS.value
+    return (_GATHER_HIDDEN_SECONDS.value / total) if total else 0.0
+
+
+def _iter_group_gathers(sp: ShardedParams, prefetch=None):
+    """Yield ``(group_index, full_flat_buffer)`` in group order, with up
+    to ``prefetch`` group allgathers in flight ahead of the consumer —
+    the PR-3 dispatch/drain split applied to parameter gathering: group
+    k+1's wire time hides under group k's compute. Blocked time is
+    charged to exposed_comm; overlapped time counts as hidden."""
+    spec = sp.spec
+    shards = sp.shards
+    if any(isinstance(x, jax.core.Tracer) for x in shards):
+        axes = _bound_axes(None)
+        if not axes:
+            raise ValueError(
+                "gathering ShardedParams traced without a bound mesh "
+                "axis — use shard_map (or run eagerly)")
+        for gi in range(len(spec.groups)):
+            yield gi, lax.all_gather(shards[gi], tuple(axes), axis=0,
+                                     tiled=True)
+        return
+    st = basics._ensure_init()
+    if spec.world != st.size:
+        raise ValueError(
+            f"ShardedParams were built for world {spec.world} but the "
+            f"current world is {st.size}; re-form via zero.resync")
+    mp = collectives._multiprocess_world(st)
+    if mp and not collectives._runtime_capable(st):
+        raise NotImplementedError(
+            "gathering ShardedParams in a multi-process world needs "
+            "the enqueue runtime (tpurun / HOROVOD_RANK env contract)")
+    window = max(1, int(prefetch if prefetch is not None
+                        else prefetch_buckets_from_env()))
+    n = len(spec.groups)
+    pending: dict = {}
+    stall = hidden = 0.0
+
+    def dispatch(gi):
+        g = spec.groups[gi]
+        nbytes = g.padded * np.dtype(g.dtype).itemsize
+        _AG_BYTES.inc(int(nbytes))
+        flight_recorder.emit(
+            "op_dispatch", op="allgather", phase="param_gather",
+            shard=spec.rank, group=gi, bytes=int(nbytes))
+        if mp:
+            from horovod_tpu.runtime.runtime import get_runtime
+
+            h = get_runtime().enqueue_allgather(
+                f"zero3.params.g{gi}", jnp.asarray(shards[gi]))
+        else:
+            def build():
+                def f(shard):
+                    return jnp.reshape(shard, (g.padded,))
+
+                return jax.jit(
+                    f,
+                    out_shardings=mesh_mod.replicated_sharding(st.mesh))
+
+            h = _module_prog(("zgather", st.mesh, spec, gi),
+                             build)(shards[gi])
+        pending[gi] = (h, time.monotonic(), nbytes)
+
+    nxt = 0
+    while nxt < min(window, n):
+        dispatch(nxt)
+        nxt += 1
+    for gi in range(n):
+        h, t_disp, nbytes = pending.pop(gi)
+        t_wait = time.monotonic()
+        if mp:
+            full = jnp.asarray(collectives.synchronize(h))
+        else:
+            full = h
+            full.block_until_ready()
+        t_done = time.monotonic()
+        if nxt < n:
+            dispatch(nxt)
+            nxt += 1
+        waited = t_done - t_wait
+        total = t_done - t_disp
+        stall += waited
+        hidden += max(0.0, total - waited)
+        flight_recorder.emit(
+            "op_complete", op="allgather", phase="param_gather",
+            shard=spec.rank, group=gi, seconds=round(total, 6))
+        comms.record("allgather", "zero", nbytes, max(total, 1e-9),
+                     world=spec.world)
+        yield gi, full
+    _account_gather(stall, hidden)
+
+
+def gather_params(sp: ShardedParams, *, prefetch=None):
+    """Materialize the full parameter pytree from :class:`ShardedParams`
+    (all groups gathered, prefetch-windowed). For bounded transient HBM
+    consume :func:`iter_param_buckets` instead and release each bucket
+    after use."""
+    out = [None] * sp.spec.num_leaves
+    for gi, full in _iter_group_gathers(sp, prefetch):
+        _unpack_group(full, sp.spec.groups[gi], out)
+    return jax.tree_util.tree_unflatten(sp.treedef, out)
+
+
+def iter_param_buckets(sp: ShardedParams, *, prefetch=None):
+    """Yield ``(group_index, {leaf_index: array})`` bucket-by-bucket in
+    layout order, the next group's allgather already in flight under
+    this group's compute. Transient HBM is bounded by roughly
+    ``prefetch`` (default ``HOROVOD_ZERO_PREFETCH_BUCKETS``) group
+    buffers as long as the consumer drops each dict after use."""
+    for gi, full in _iter_group_gathers(sp, prefetch):
+        g = sp.spec.groups[gi]
+        out = {}
+        off = 0
+        for i, shape, size in zip(g.indices, g.shapes, g.sizes):
+            out[i] = jnp.reshape(full[off:off + size], shape)
+            off += size
+        yield gi, out
+
+
 # ---------------------------------------------------------------------------
 # Generic elementwise wrapper (optax delta contract)
 # ---------------------------------------------------------------------------
@@ -307,8 +829,16 @@ class ShardedOptState(NamedTuple):
 
 def sharded_update(optimizer, *, average: bool = True,
                    compression=Compression.none, axis_name=None,
-                   sparse_as_dense: bool = False):
-    """Wrap an elementwise optax transformation with ZeRO-1 sharding.
+                   sparse_as_dense: bool = False, partition=None):
+    """Wrap an elementwise optax transformation with ZeRO sharding.
+
+    Stage 2: ``update_fn`` also accepts a :class:`ShardedGrads` (from
+    :func:`scatter_gradients` or a reduce-scatter release plan) in
+    place of the gradient pytree — the internal reduce-scatter is
+    skipped and the update runs straight on the shards (``params`` is
+    then required for the output tree structure). ``partition`` aligns
+    the shard layout with a release plan's buckets
+    (``plan.zero_partition(params)``).
 
     Returns an ``optax.GradientTransformationExtraArgs`` whose state is
     :class:`ShardedOptState`. The update reduce-scatters the flat
@@ -434,14 +964,15 @@ def sharded_update(optimizer, *, average: bool = True,
                     "eagerly, or in multi-process mode")
             world = int(np.prod([compat.axis_size(a) for a in axes]))
             spec = build_spec(leaves, world, -1,
-                              _quantum_bytes(basics._ensure_init()))
+                              _quantum_bytes(basics._ensure_init()),
+                              partition=partition)
             shards = _tracer_shards(leaves, spec, axes)
             return ShardedOptState(spec, optimizer.init(shards))
         st = basics._ensure_init()
         spec = build_spec(leaves, st.size,
                           st.rank if collectives._multiprocess_world(st)
                           else 0,
-                          _quantum_bytes(st))
+                          _quantum_bytes(st), partition=partition)
         if collectives._multiprocess_world(st):
             shards = _local_shards(leaves, spec)
         else:
@@ -452,18 +983,20 @@ def sharded_update(optimizer, *, average: bool = True,
 
     # -- update ------------------------------------------------------------
 
-    def _update_tracer(leaves, state, pleaves, extra, axes):
+    def _update_tracer(leaves, state, pleaves, extra, axes,
+                       gshards=None):
         spec = state.spec
-        gshards = []
-        for g in spec.groups:
-            flat = _pack_group(leaves, g)
-            wire, ctx = compression.compress(flat)
-            s = lax.psum_scatter(wire, tuple(axes), scatter_dimension=0,
-                                 tiled=True)
-            if average:
-                s = s / spec.world
-            gshards.append(compression.decompress(s, ctx)
-                           .astype(np.dtype(g.dtype)))
+        if gshards is None:
+            gshards = []
+            for g in spec.groups:
+                flat = _pack_group(leaves, g)
+                wire, ctx = compression.compress(flat)
+                s = lax.psum_scatter(wire, tuple(axes),
+                                     scatter_dimension=0, tiled=True)
+                if average:
+                    s = s / spec.world
+                gshards.append(compression.decompress(s, ctx)
+                               .astype(np.dtype(g.dtype)))
         pshards = (_tracer_shards(pleaves, spec, axes)
                    if pleaves is not None else None)
         deltas, new_inner = optimizer.update(
@@ -475,15 +1008,17 @@ def sharded_update(optimizer, *, average: bool = True,
         return tuple(out), ShardedOptState(spec, new_inner)
 
     def _update_single_controller(leaves, state, pleaves, extra, st,
-                                  stacked: bool):
+                                  stacked: bool, gshards=None):
         spec = state.spec
         mesh = st.mesh
-        rs_bytes = sum(g.padded * np.dtype(g.dtype).itemsize
-                       for g in spec.groups)
-        _RS_BYTES.inc(rs_bytes)
-        gshards = _emit_phase(
-            "reducescatter", "sharded_grads", spec.rank, rs_bytes,
-            lambda: _grads_to_shards_prog(mesh, spec, stacked)(leaves))
+        if gshards is None:
+            rs_bytes = sum(g.padded * np.dtype(g.dtype).itemsize
+                           for g in spec.groups)
+            _RS_BYTES.inc(rs_bytes)
+            gshards = _emit_phase(
+                "reducescatter", "sharded_grads", spec.rank, rs_bytes,
+                lambda: _grads_to_shards_prog(mesh, spec,
+                                              stacked)(leaves))
         pshards = (_params_to_shards_prog(mesh, spec)(pleaves)
                    if pleaves is not None else None)
         deltas, new_inner = _update_prog(mesh, spec)(
@@ -496,7 +1031,8 @@ def sharded_update(optimizer, *, average: bool = True,
             lambda: _shards_to_updates_prog(mesh, spec)(deltas))
         return updates, ShardedOptState(spec, new_inner)
 
-    def _update_multiprocess(leaves, state, pleaves, extra, st):
+    def _update_multiprocess(leaves, state, pleaves, extra, st,
+                             gshards=None):
         from horovod_tpu.runtime.runtime import get_runtime
 
         spec = state.spec
@@ -508,31 +1044,36 @@ def sharded_update(optimizer, *, average: bool = True,
                 "shard_map path")
         op_name = collectives._OP_NAMES[
             collectives.Average if average else collectives.Sum]
-        handles = []
-        for gi, g in enumerate(spec.groups):
-            flat = _np_pack_group(leaves, g)
-            wire, ctx = compression.compress(jnp.asarray(flat))
-            nbytes = (wire.size * np.dtype(wire.dtype).itemsize)
-            _RS_BYTES.inc(int(nbytes))
-            flight_recorder.emit(
-                "op_dispatch", op="reducescatter", phase="sharded_grads",
-                shard=spec.rank, group=gi, bytes=int(nbytes))
-            # stable per-group names: the negotiation response cache and
-            # the timeline see the same tensor lane every step
-            handles.append((gi, g, ctx, int(nbytes), time.monotonic(),
-                            get_runtime().enqueue_reducescatter(
-                                f"sharded.grads.g{gi}", wire,
-                                reduce_op=op_name)))
-        gshards = [None] * len(spec.groups)
-        for gi, g, ctx, nbytes, t0, h in handles:
-            out = compression.decompress(collectives.synchronize(h), ctx)
-            seconds = time.monotonic() - t0
-            flight_recorder.emit(
-                "op_complete", op="reducescatter", phase="sharded_grads",
-                shard=spec.rank, group=gi, seconds=round(seconds, 6))
-            comms.record("reducescatter", "zero", nbytes, seconds,
-                         world=spec.world)
-            gshards[gi] = jnp.asarray(out).astype(np.dtype(g.dtype))
+        if gshards is None:
+            handles = []
+            for gi, g in enumerate(spec.groups):
+                flat = _np_pack_group(leaves, g)
+                wire, ctx = compression.compress(jnp.asarray(flat))
+                nbytes = (wire.size * np.dtype(wire.dtype).itemsize)
+                _RS_BYTES.inc(int(nbytes))
+                flight_recorder.emit(
+                    "op_dispatch", op="reducescatter",
+                    phase="sharded_grads", shard=spec.rank, group=gi,
+                    bytes=int(nbytes))
+                # stable per-group names: the negotiation response cache
+                # and the timeline see the same tensor lane every step
+                handles.append((gi, g, ctx, int(nbytes),
+                                time.monotonic(),
+                                get_runtime().enqueue_reducescatter(
+                                    f"sharded.grads.g{gi}", wire,
+                                    reduce_op=op_name)))
+            gshards = [None] * len(spec.groups)
+            for gi, g, ctx, nbytes, t0, h in handles:
+                out = compression.decompress(
+                    collectives.synchronize(h), ctx)
+                seconds = time.monotonic() - t0
+                flight_recorder.emit(
+                    "op_complete", op="reducescatter",
+                    phase="sharded_grads", shard=spec.rank, group=gi,
+                    seconds=round(seconds, 6))
+                comms.record("reducescatter", "zero", nbytes, seconds,
+                             world=spec.world)
+                gshards[gi] = jnp.asarray(out).astype(np.dtype(g.dtype))
         pshards = (_local_shards(pleaves, spec)
                    if pleaves is not None else None)
         deltas, new_inner = optimizer.update(
@@ -603,27 +1144,41 @@ def sharded_update(optimizer, *, average: bool = True,
             raise TypeError(
                 "sharded_update state must be ShardedOptState (was this "
                 "optimizer initialized with shard_optimizer_states?)")
-        leaves, treedef = jax.tree_util.tree_flatten(
-            grads, is_leaf=sparse_mod.is_sparse)
-        if sparse_as_dense:
-            leaves = _densify(leaves)
-        _check_dense(leaves)
         spec = state.spec
-        if len(leaves) != spec.num_leaves:
-            raise ValueError(
-                f"gradient tree has {len(leaves)} leaves but the sharded "
-                f"state was built for {spec.num_leaves}")
+        pre = None  # stage-2: gradients arrive already reduce-scattered
+        if isinstance(grads, ShardedGrads):
+            _check_shard_spec(grads.spec, spec,
+                              "pre-scattered gradient (ShardedGrads)")
+            if params is None:
+                raise ValueError(
+                    "sharded_update over ShardedGrads needs params= "
+                    "(the update pytree structure)")
+            pre = tuple(grads.shards)
+            leaves = None
+            treedef = jax.tree_util.tree_structure(params)
+            probe = pre
+        else:
+            leaves, treedef = jax.tree_util.tree_flatten(
+                grads, is_leaf=sparse_mod.is_sparse)
+            if sparse_as_dense:
+                leaves = _densify(leaves)
+            _check_dense(leaves)
+            if len(leaves) != spec.num_leaves:
+                raise ValueError(
+                    f"gradient tree has {len(leaves)} leaves but the "
+                    f"sharded state was built for {spec.num_leaves}")
+            probe = leaves
         pleaves = None
         if params is not None:
             pleaves = jax.tree_util.tree_flatten(params)[0]
-        if any(isinstance(x, jax.core.Tracer) for x in leaves):
+        if any(isinstance(x, jax.core.Tracer) for x in probe):
             axes = _bound_axes(axis_name)
             if not axes:
                 raise ValueError(
                     "sharded update traced without a bound mesh axis — "
                     "use shard_map (or run eagerly)")
             out, new_state = _update_tracer(leaves, state, pleaves,
-                                            extra, axes)
+                                            extra, axes, gshards=pre)
             return treedef.unflatten(out), new_state
         st = basics._ensure_init()
         if spec.world != st.size:
@@ -631,15 +1186,22 @@ def sharded_update(optimizer, *, average: bool = True,
                 f"sharded state was built for world {spec.world} but the "
                 f"current world is {st.size}; re-init (elastic re-forms "
                 "go through elastic.ArrayState.sync / zero.resync)")
-        mode = _mode(leaves, st)
-        _integrity_check_leaves(leaves, st, mode)
+        if pre is None:
+            mode = _mode(leaves, st)
+            _integrity_check_leaves(leaves, st, mode)
+        else:
+            # pre-scattered shards carry their own in-band digests
+            # (bucket wire / runtime reduce-scatter lanes)
+            mode = ("local" if collectives._multiprocess_world(st)
+                    else "stacked")
         t0 = time.monotonic()
         if mode == "local":
             out, new_state = _update_multiprocess(leaves, state, pleaves,
-                                                  extra, st)
+                                                  extra, st, gshards=pre)
         else:
             out, new_state = _update_single_controller(
-                leaves, state, pleaves, extra, st, mode == "stacked")
+                leaves, state, pleaves, extra, st, mode == "stacked",
+                gshards=pre)
         _UPDATES.inc()
         _UPDATE_SECONDS.observe(time.monotonic() - t0)
         return treedef.unflatten(out), new_state
@@ -677,12 +1239,20 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
                   b2: float = 0.999, eps: float = 1e-8,
                   weight_decay: float = 1e-4, *, average: bool = True,
                   compression=Compression.none,
-                  axis_name=None) -> ShardedAdamW:
-    """ZeRO-1 fused AdamW: reduce-scatter grads, one fused Pallas pass
-    over the local fp32 master/moment shards
+                  axis_name=None, partition=None) -> ShardedAdamW:
+    """ZeRO-1/2/3 fused AdamW: reduce-scatter grads, one fused Pallas
+    pass over the local fp32 master/moment shards
     (:mod:`horovod_tpu.ops.pallas.fused_optimizer`, gated by
     ``HOROVOD_SHARDED_FUSED_KERNEL``), allgather the updated params
-    back in the parameter dtype."""
+    back in the parameter dtype.
+
+    Stage 2: ``apply`` accepts a :class:`ShardedGrads` in place of the
+    gradient pytree and skips its internal reduce-scatter. Stage 3:
+    ``apply`` given :class:`ShardedParams` (and ``init`` over them)
+    updates the shards and returns a new ``ShardedParams`` — the
+    trailing param allgather disappears entirely; the forward pass
+    re-gathers on demand. ``partition`` aligns the layout with a
+    reduce-scatter release plan (``plan.zero_partition(params)``)."""
     import optax
 
     progs: dict = {}
@@ -750,6 +1320,19 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
         return _prog(("gather", mesh, spec), build)
 
     def init(params):
+        if isinstance(params, ShardedParams):
+            # stage 3: params already live as shards — the fp32 masters
+            # are a cast of the local slices, no pack/scatter needed
+            spec = params.spec
+            master = tuple(jnp.asarray(s).astype(jnp.float32)
+                           for s in params.shards)
+            zeros = tuple(jnp.zeros_like(w) for w in master)
+            state = FlatAdamState(
+                spec=spec, count=jnp.zeros([], jnp.int32), master=master,
+                mu=zeros, nu=tuple(jnp.zeros_like(w) for w in master))
+            _set_state_bytes((state.master, state.mu, state.nu),
+                             spec.world)
+            return state
         leaves, _ = jax.tree_util.tree_flatten(params)
         _check_dense(leaves)
         if any(isinstance(x, jax.core.Tracer) for x in leaves):
@@ -761,7 +1344,8 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
                     "multi-process mode")
             world = int(np.prod([compat.axis_size(a) for a in axes]))
             spec = build_spec(leaves, world, -1,
-                              _quantum_bytes(basics._ensure_init()))
+                              _quantum_bytes(basics._ensure_init()),
+                              partition=partition)
             idx = lax.axis_index(tuple(axes))
             master = tuple(
                 lax.dynamic_slice(_pack_group(leaves, g),
@@ -772,7 +1356,7 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
             st = basics._ensure_init()
             mp = collectives._multiprocess_world(st)
             spec = build_spec(leaves, st.size, st.rank if mp else 0,
-                              _quantum_bytes(st))
+                              _quantum_bytes(st), partition=partition)
             if mp:
                 master = tuple(
                     jnp.asarray(_np_pack_group(leaves, g)[
@@ -821,29 +1405,60 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
 
     def apply(params, state, grads):
         spec = state.spec
-        gleaves, treedef = jax.tree_util.tree_flatten(grads)
-        _check_dense(gleaves)
-        if len(gleaves) != spec.num_leaves:
-            raise ValueError(
-                f"gradient tree has {len(gleaves)} leaves but the "
-                f"sharded state was built for {spec.num_leaves}")
+        sharded_out = isinstance(params, ShardedParams)
+        if sharded_out:
+            # stage 3: the updated params stay sharded — no trailing
+            # allgather; the forward re-gathers on demand
+            _check_shard_spec(params.spec, spec,
+                              "ShardedParams (stage-3 params)")
+        pre = None
+        if isinstance(grads, ShardedGrads):
+            _check_shard_spec(grads.spec, spec,
+                              "pre-scattered gradient (ShardedGrads)")
+            pre = tuple(grads.shards)
+            gleaves = None
+            probe = pre
+        else:
+            gleaves, _gt = jax.tree_util.tree_flatten(grads)
+            _check_dense(gleaves)
+            if len(gleaves) != spec.num_leaves:
+                raise ValueError(
+                    f"gradient tree has {len(gleaves)} leaves but the "
+                    f"sharded state was built for {spec.num_leaves}")
+            probe = gleaves
         count = optax.safe_int32_increment(state.count)
         scalars = _scalars(count)
-        if any(isinstance(x, jax.core.Tracer) for x in gleaves):
+
+        def _pack_params(ps, ws, ms, vs):
+            new_state = FlatAdamState(
+                spec, count, tuple(ws), tuple(ms), tuple(vs))
+            if sharded_out:
+                new_params = ShardedParams(params.spec, params.treedef,
+                                           tuple(ps))
+                if not any(isinstance(x, jax.core.Tracer) for x in ps):
+                    _set_shard_bytes("param_shards", new_params.shards,
+                                     spec.world)
+                return new_params, new_state
+            return None, new_state  # caller gathers + unflattens
+
+        if any(isinstance(x, jax.core.Tracer) for x in probe):
             axes = _bound_axes(axis_name)
             if not axes:
                 raise ValueError("sharded_adamw traced without a bound "
                                  "mesh axis — use shard_map")
             ps, ws, ms, vs = [], [], [], []
-            for g, w, m, v in zip(spec.groups, state.master, state.mu,
-                                  state.nu):
-                flat = _pack_group(gleaves, g)
-                wire, ctx = compression.compress(flat)
-                s = lax.psum_scatter(wire, tuple(axes),
-                                     scatter_dimension=0, tiled=True)
-                if average:
-                    s = s / spec.world
-                gr = compression.decompress(s, ctx)
+            for gi, (g, w, m, v) in enumerate(zip(
+                    spec.groups, state.master, state.mu, state.nu)):
+                if pre is not None:
+                    gr = pre[gi]
+                else:
+                    flat = _pack_group(gleaves, g)
+                    wire, ctx = compression.compress(flat)
+                    s = lax.psum_scatter(wire, tuple(axes),
+                                         scatter_dimension=0, tiled=True)
+                    if average:
+                        s = s / spec.world
+                    gr = compression.decompress(s, ctx)
                 p2, w2, m2, v2 = fused_mod.flat_adamw_shard(
                     w, m, v, gr, scalars, eps=eps,
                     out_dtype=np.dtype(g.dtype))
@@ -851,20 +1466,26 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
                 ws.append(w2)
                 ms.append(m2)
                 vs.append(v2)
+            new_params, new_state = _pack_params(ps, ws, ms, vs)
+            if new_params is not None:
+                return new_params, new_state
             out = [None] * spec.num_leaves
             for g, p in zip(spec.groups, ps):
                 full = lax.all_gather(p, tuple(axes), axis=0, tiled=True)
                 _unpack_group(full, g, out)
             pt = jax.tree_util.tree_flatten(params)[1]
-            return pt.unflatten(out), FlatAdamState(
-                spec, count, tuple(ws), tuple(ms), tuple(vs))
+            return pt.unflatten(out), new_state
         st = basics._ensure_init()
         if spec.world != st.size:
             raise ValueError(
                 f"sharded state was built for world {spec.world} but the "
                 f"current world is {st.size}")
         t0 = time.monotonic()
-        mode = _mode(gleaves, st)
+        if pre is not None:
+            mode = ("local" if collectives._multiprocess_world(st)
+                    else "stacked")
+        else:
+            mode = _mode(gleaves, st)
         rs_bytes = sum(g.padded * np.dtype(g.dtype).itemsize
                        for g in spec.groups)
         if mode == "local":
@@ -874,31 +1495,36 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
                 raise NotImplementedError(
                     "sharded_adamw in a multi-process world needs the "
                     "enqueue runtime (tpurun / HOROVOD_RANK)")
-            op_name = collectives._OP_NAMES[
-                collectives.Average if average else collectives.Sum]
-            handles = []
-            for gi, g in enumerate(spec.groups):
-                flat = _np_pack_group(gleaves, g)
-                wire, ctx = compression.compress(jnp.asarray(flat))
-                _RS_BYTES.inc(int(wire.size
+            if pre is not None:
+                gshards = list(pre)
+            else:
+                op_name = collectives._OP_NAMES[
+                    collectives.Average if average else collectives.Sum]
+                handles = []
+                for gi, g in enumerate(spec.groups):
+                    flat = _np_pack_group(gleaves, g)
+                    wire, ctx = compression.compress(jnp.asarray(flat))
+                    _RS_BYTES.inc(int(wire.size
+                                      * np.dtype(wire.dtype).itemsize))
+                    flight_recorder.emit(
+                        "op_dispatch", op="reducescatter",
+                        phase="sharded_grads", shard=spec.rank, group=gi,
+                        bytes=int(wire.size
                                   * np.dtype(wire.dtype).itemsize))
-                flight_recorder.emit(
-                    "op_dispatch", op="reducescatter",
-                    phase="sharded_grads", shard=spec.rank, group=gi,
-                    bytes=int(wire.size * np.dtype(wire.dtype).itemsize))
-                handles.append((gi, g, ctx, time.monotonic(),
-                                get_runtime().enqueue_reducescatter(
-                                    f"sharded.adamw.grads.g{gi}", wire,
-                                    reduce_op=op_name)))
-            gshards = [None] * len(spec.groups)
-            for gi, g, ctx, ht0, h in handles:
-                gr = compression.decompress(collectives.synchronize(h),
-                                            ctx)
-                flight_recorder.emit(
-                    "op_complete", op="reducescatter",
-                    phase="sharded_grads", shard=spec.rank, group=gi,
-                    seconds=round(time.monotonic() - ht0, 6))
-                gshards[gi] = jnp.asarray(gr).astype(np.dtype(g.dtype))
+                    handles.append((gi, g, ctx, time.monotonic(),
+                                    get_runtime().enqueue_reducescatter(
+                                        f"sharded.adamw.grads.g{gi}",
+                                        wire, reduce_op=op_name)))
+                gshards = [None] * len(spec.groups)
+                for gi, g, ctx, ht0, h in handles:
+                    gr = compression.decompress(
+                        collectives.synchronize(h), ctx)
+                    flight_recorder.emit(
+                        "op_complete", op="reducescatter",
+                        phase="sharded_grads", shard=spec.rank, group=gi,
+                        seconds=round(time.monotonic() - ht0, 6))
+                    gshards[gi] = jnp.asarray(gr).astype(
+                        np.dtype(g.dtype))
             ps, ws, ms, vs = [], [], [], []
             for g, w, m, v, gr in zip(spec.groups, state.master,
                                       state.mu, state.nu, gshards):
@@ -909,45 +1535,55 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
                 ws.append(w2)
                 ms.append(m2)
                 vs.append(v2)
-            out = [None] * spec.num_leaves
-            ag_handles = []
-            for gi, (g, p) in enumerate(zip(spec.groups, ps)):
-                nbytes = g.padded * np.dtype(g.dtype).itemsize
-                _AG_BYTES.inc(int(nbytes))
-                flight_recorder.emit(
-                    "op_dispatch", op="allgather",
-                    phase="sharded_params", shard=spec.rank, group=gi,
-                    bytes=int(nbytes))
-                ag_handles.append((gi, g, time.monotonic(),
-                                   get_runtime().enqueue_allgather(
-                                       f"sharded.adamw.params.g{gi}",
-                                       jnp.asarray(p))))
-            for gi, g, ht0, h in ag_handles:
-                full = jnp.asarray(collectives.synchronize(h))
-                flight_recorder.emit(
-                    "op_complete", op="allgather",
-                    phase="sharded_params", shard=spec.rank, group=gi,
-                    seconds=round(time.monotonic() - ht0, 6))
-                _unpack_group(full, g, out)
+            if not sharded_out:
+                out = [None] * spec.num_leaves
+                ag_handles = []
+                for gi, (g, p) in enumerate(zip(spec.groups, ps)):
+                    nbytes = g.padded * np.dtype(g.dtype).itemsize
+                    _AG_BYTES.inc(int(nbytes))
+                    flight_recorder.emit(
+                        "op_dispatch", op="allgather",
+                        phase="sharded_params", shard=spec.rank,
+                        group=gi, bytes=int(nbytes))
+                    ag_handles.append((gi, g, time.monotonic(),
+                                       get_runtime().enqueue_allgather(
+                                           f"sharded.adamw.params.g{gi}",
+                                           jnp.asarray(p))))
+                for gi, g, ht0, h in ag_handles:
+                    full = jnp.asarray(collectives.synchronize(h))
+                    flight_recorder.emit(
+                        "op_complete", op="allgather",
+                        phase="sharded_params", shard=spec.rank,
+                        group=gi,
+                        seconds=round(time.monotonic() - ht0, 6))
+                    _unpack_group(full, g, out)
         else:
-            stacked = mode == "stacked"
-            _RS_BYTES.inc(rs_bytes)
-            gshards = _emit_phase(
-                "reducescatter", "sharded_grads", spec.rank, rs_bytes,
-                lambda: _grad_shards_eager(gleaves, spec, st, stacked))
+            if pre is not None:
+                gshards = pre
+            else:
+                stacked = mode == "stacked"
+                _RS_BYTES.inc(rs_bytes)
+                gshards = _emit_phase(
+                    "reducescatter", "sharded_grads", spec.rank,
+                    rs_bytes,
+                    lambda: _grad_shards_eager(gleaves, spec, st,
+                                               stacked))
             ps, ws, ms, vs = _apply_prog(st.mesh, spec)(
                 scalars, state.master, state.mu, state.nu, gshards)
-            ag_bytes = sum(g.padded * np.dtype(g.dtype).itemsize
-                           for g in spec.groups)
-            _AG_BYTES.inc(ag_bytes)
-            out = _emit_phase(
-                "allgather", "sharded_params", spec.rank, ag_bytes,
-                lambda: _gather_prog(st.mesh, spec)(ps))
+            if not sharded_out:
+                ag_bytes = sum(g.padded * np.dtype(g.dtype).itemsize
+                               for g in spec.groups)
+                _AG_BYTES.inc(ag_bytes)
+                out = _emit_phase(
+                    "allgather", "sharded_params", spec.rank, ag_bytes,
+                    lambda: _gather_prog(st.mesh, spec)(ps))
         _UPDATES.inc()
         _UPDATE_SECONDS.observe(time.monotonic() - t0)
+        new_params, new_state = _pack_params(ps, ws, ms, vs)
+        if new_params is not None:
+            return new_params, new_state
         pt = jax.tree_util.tree_flatten(params)[1]
-        return pt.unflatten(list(out)), FlatAdamState(
-            spec, count, tuple(ws), tuple(ms), tuple(vs))
+        return pt.unflatten(list(out)), new_state
 
     return ShardedAdamW(init=init, apply=apply)
 
@@ -957,10 +1593,22 @@ def sharded_adamw(learning_rate: float, b1: float = 0.9,
 # ---------------------------------------------------------------------------
 
 def is_sharded_state(x) -> bool:
-    """True for optimizer-state leaves that hold per-rank shards —
+    """True for leaves that hold per-rank shards — optimizer states,
+    stage-3 parameter shards and stage-2 gradient shards.
     ``elastic.ArrayState.sync`` must NOT broadcast these (rank 0's shard
     would clobber every other rank's); it calls :func:`resync`."""
-    return isinstance(x, (ShardedOptState, FlatAdamState))
+    return isinstance(x, (ShardedOptState, FlatAdamState, ShardedParams,
+                          ShardedGrads))
+
+
+def _kind_of(state) -> str:
+    if isinstance(state, FlatAdamState):
+        return "flat_adamw"
+    if isinstance(state, ShardedParams):
+        return "sharded_params"
+    if isinstance(state, ShardedGrads):
+        return "sharded_grads"
+    return "generic"
 
 
 def layout_of(state) -> dict:
@@ -969,8 +1617,7 @@ def layout_of(state) -> dict:
     different world size (``from_full_buffers``)."""
     spec = state.spec
     return {
-        "kind": ("flat_adamw" if isinstance(state, FlatAdamState)
-                 else "generic"),
+        "kind": _kind_of(state),
         "world": int(spec.world),
         "groups": [[g.dtype, int(g.n), int(g.shard_elems), int(g.padded)]
                    for g in spec.groups],
@@ -988,6 +1635,11 @@ def export_shard_arrays(state) -> dict:
                 "master": [np.asarray(m) for m in state.master],
                 "mu": [np.asarray(m) for m in state.mu],
                 "nu": [np.asarray(m) for m in state.nu]}
+    if isinstance(state, (ShardedParams, ShardedGrads)):
+        # one local flat slice per dtype group: the writer's generic
+        # "leaves" path serializes them as {key}#leaf/{gi}
+        return {"kind": _kind_of(state),
+                "leaves": [np.asarray(s) for s in state.shards]}
     leaves, _ = jax.tree_util.tree_flatten(state.inner)
     return {"kind": "generic",
             "leaves": [np.asarray(x) for x in leaves]}
@@ -1034,6 +1686,23 @@ def from_full_buffers(target, full: dict, old_groups):
                                   nu=tuple(nu))
         _set_state_bytes((new_state.master, new_state.mu, new_state.nu),
                          spec.world)
+        return new_state
+    if isinstance(target, (ShardedParams, ShardedGrads)):
+        shards = []
+        for gi, g_new in enumerate(spec.groups):
+            _dt, old_n, _s, _p = old_groups[gi]
+            shards.append(_slice_new_shard(
+                np.asarray(full["leaves"][gi]).reshape(-1), old_n,
+                g_new, spec.rank, np.dtype(g_new.dtype)))
+        if isinstance(target, ShardedParams):
+            new_state = ShardedParams(spec, target.treedef,
+                                      tuple(shards))
+            _set_shard_bytes("param_shards", new_state.shards,
+                             spec.world)
+        else:
+            new_state = ShardedGrads(spec, tuple(shards))
+            _set_shard_bytes("grad_shards", new_state.shards,
+                             spec.world)
         return new_state
     leaves, treedef = jax.tree_util.tree_flatten(target.inner)
     by_shard: dict = {}
@@ -1131,6 +1800,18 @@ def _reshard(full_old: np.ndarray, g_old: GroupSpec, g_new: GroupSpec,
              (new_rank + 1) * g_new.shard_elems])
 
 
+def _meta_leaves_from_spec(spec: ZeroSpec):
+    """Shape/dtype stand-ins for every leaf covered by ``spec`` — lets
+    resync re-lay-out grad/param shards whose full tree no longer
+    exists anywhere (that is the point of stages 2/3)."""
+    metas = [None] * spec.num_leaves
+    for g in spec.groups:
+        for i, shape in zip(g.indices, g.shapes):
+            metas[i] = LeafMeta(shape=tuple(shape),
+                                dtype=np.dtype(g.dtype))
+    return metas
+
+
 def _resync_needed(spec: ZeroSpec, st) -> bool:
     """Collective-uniform decision: a rank-local layout mismatch on ANY
     rank re-shards on ALL ranks (a survivor keeping its old rank must
@@ -1143,7 +1824,7 @@ def _resync_needed(spec: ZeroSpec, st) -> bool:
     return int(total.reshape(-1)[0]) > 0
 
 
-def resync(state, params, root_rank: int = 0, replica=None):
+def resync(state, params=None, root_rank: int = 0, replica=None):
     """Re-shard a sharded optimizer state after an elastic membership
     reform: allgather the surviving old shards, rebuild the full flat
     buffers (dead ranks' segments fall back to the neutral value —
@@ -1159,7 +1840,9 @@ def resync(state, params, root_rank: int = 0, replica=None):
     round (collective uniformity).
 
     ``params`` must already be synced (ArrayState.sync broadcasts
-    params before the optimizer tree). No-op when the layout still
+    params before the optimizer tree). It may be ``None`` when
+    ``state`` is a :class:`ShardedParams` / :class:`ShardedGrads` —
+    those carry their own leaf metadata. No-op when the layout still
     matches on every rank."""
     from horovod_tpu.elastic.state import broadcast_object_wire
 
@@ -1173,8 +1856,22 @@ def resync(state, params, root_rank: int = 0, replica=None):
             "single-controller mesh cannot change size under elastic); "
             f"state layout was world={spec.world} rank={spec.rank}, "
             f"current world={st.size} rank={st.rank}")
-    pleaves, _ = jax.tree_util.tree_flatten(params)
-    new_spec = build_spec(pleaves, st.size, st.rank, _quantum_bytes(st))
+    # preserve the old grouping (default dtype cells or a release
+    # plan's bucket partition) so bucket-aligned layouts survive the
+    # reform with the same group structure
+    part = [list(g.indices) for g in spec.groups]
+    if isinstance(state, (ShardedParams, ShardedGrads)):
+        # grad/param shards describe their own leaves: rebuild layout
+        # metadata from the spec (the full tree exists nowhere)
+        pleaves = _meta_leaves_from_spec(spec)
+    elif isinstance(params, ShardedParams):
+        # stage-3: the (already-resynced) param shards are the only
+        # full copy — gather them to seed the master fills below
+        pleaves = jax.tree_util.tree_flatten(gather_params(params))[0]
+    else:
+        pleaves, _ = jax.tree_util.tree_flatten(params)
+    new_spec = build_spec(pleaves, st.size, st.rank, _quantum_bytes(st),
+                          partition=part)
     # survivors (incl. the root) share the authoritative old layout;
     # fresh joiners adopt it so everyone parses the gathers identically
     old_world, old_groups = broadcast_object_wire(
@@ -1190,8 +1887,7 @@ def resync(state, params, root_rank: int = 0, replica=None):
                          new_world=int(st.size), rank=int(st.rank))
     rep_rank = -1
     rep_entries = None
-    want_kind = ("flat_adamw" if isinstance(state, FlatAdamState)
-                 else "generic")
+    want_kind = _kind_of(state)
     if replica is not None:
         rep_rank, rep_entries = replica
         if (not isinstance(rep_entries, dict)
@@ -1234,6 +1930,30 @@ def resync(state, params, root_rank: int = 0, replica=None):
                 restored_old_ranks=sorted(
                     {r for _t, r in replica_restored}),
                 segments=len(replica_restored), rank=int(st.rank))
+
+    if isinstance(state, (ShardedParams, ShardedGrads)):
+        # dead ranks' segments fall back to zeros unless a neighbor
+        # replica offers the true bytes — for params prefer a
+        # checkpoint restore when no replica covered the dead rank
+        tag0 = "param" if isinstance(state, ShardedParams) else "grad"
+        new_shards = []
+        for gi, g_new in enumerate(new_spec.groups):
+            _dt, _n, _s, old_padded = old_groups[gi]
+            zfill = np.zeros((old_padded,), np.dtype(g_new.dtype))
+            new_shards.append(regroup(state.shards[gi], gi, zfill,
+                                      _rep("leaves", gi),
+                                      tag=f"{tag0}/{gi}"))
+        if isinstance(state, ShardedParams):
+            new_state = ShardedParams(new_spec, state.treedef,
+                                      tuple(new_shards))
+            _set_shard_bytes("param_shards", new_state.shards,
+                             new_spec.world)
+        else:
+            new_state = ShardedGrads(new_spec, tuple(new_shards))
+            _set_shard_bytes("grad_shards", new_state.shards,
+                             new_spec.world)
+        _finish_replica_accounting()
+        return new_state
 
     if isinstance(state, FlatAdamState):
         new_master, new_mu, new_nu = [], [], []

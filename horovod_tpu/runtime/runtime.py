@@ -266,6 +266,7 @@ class Runtime:
                 ParameterManager, Params, normalize_codec,
                 search_box_from_roofline)
             from horovod_tpu.parallel import buckets as buckets_mod
+            from horovod_tpu.parallel import zero as zero_mod
 
             initial = Params(
                 fusion_threshold_bytes=st.config.fusion_threshold_bytes,
@@ -277,7 +278,8 @@ class Runtime:
                 hierarchy_compression=normalize_codec(
                     st.config.hierarchy_compression),
                 grad_bucket_bytes=buckets_mod.bucket_bytes_from_env(),
-                cycle_pipeline_depth=st.config.cycle_pipeline_depth)
+                cycle_pipeline_depth=st.config.cycle_pipeline_depth,
+                zero_prefetch_buckets=zero_mod.prefetch_buckets_from_env())
             # hierarchical knobs join the sweep only where the data plane
             # consults them; the cache knob only when a cache exists to
             # toggle. hierarchical_available() is a static predicate on
@@ -836,6 +838,11 @@ class Runtime:
             from horovod_tpu.parallel import buckets as buckets_mod
 
             buckets_mod.set_autotuned_bucket_bytes(params.grad_bucket_bytes)
+        if params.zero_prefetch_buckets > 0:
+            from horovod_tpu.parallel import zero as zero_mod
+
+            zero_mod.set_autotuned_prefetch_buckets(
+                params.zero_prefetch_buckets)
         self._cycle_time_s = params.cycle_time_ms / 1000.0
         self.controller.cache_enabled = params.cache_enabled
         if blob != self._applied_params_blob:
@@ -858,6 +865,7 @@ class Runtime:
                     ("hierarchy_compression_codec", codec_idx),
                     ("grad_bucket_bytes", params.grad_bucket_bytes),
                     ("cycle_pipeline_depth", params.cycle_pipeline_depth),
+                    ("zero_prefetch_buckets", params.zero_prefetch_buckets),
                     ("active", int(params.active))):
                 _AUTOTUNE_PARAM.labels(knob=knob).set(float(val))
             _AUTOTUNE_COMMITS.inc()
@@ -872,6 +880,7 @@ class Runtime:
                 hierarchy_compression=params.hierarchy_compression,
                 grad_bucket_bytes=params.grad_bucket_bytes,
                 cycle_pipeline_depth=params.cycle_pipeline_depth,
+                zero_prefetch_buckets=params.zero_prefetch_buckets,
                 active=params.active)
         if not params.active:
             self._autotune_active = False

@@ -164,12 +164,18 @@ def _shardings():
 
 def _resolve_grad_release(grad_release):
     """``None`` → honour ``HOROVOD_GRAD_BUCKET_RELEASE``; ``False`` →
-    explicitly off; a plan instance → use it."""
+    explicitly off; a plan instance → use it.
+
+    When auto-creating a plan, ``HOROVOD_ZERO_STAGE >= 2`` flips it to
+    reduce-scatter release so each bucket lands as the local 1/N gradient
+    shard (see :mod:`horovod_tpu.parallel.zero`)."""
     from horovod_tpu.parallel import buckets as buckets_mod
+    from horovod_tpu.parallel import zero as zero_mod
 
     if grad_release is None:
         if buckets_mod.release_enabled():
-            return buckets_mod.GradReleasePlan()
+            return buckets_mod.GradReleasePlan(
+                reduce_scatter=zero_mod.stage_from_env() >= 2)
         return None
     if grad_release is False:
         return None

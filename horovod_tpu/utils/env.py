@@ -144,6 +144,9 @@ ENV_DIRECT_KNOBS = (
     # goodput ledger (goodput.py; docs/goodput.md)
     "HOROVOD_GOODPUT", "HOROVOD_GOODPUT_INCIDENTS",
     "HOROVOD_GOODPUT_REPORT_SECONDS",
+    # ZeRO stage selection + stage-3 prefetch window (parallel/zero.py;
+    # docs/performance.md "sharded training")
+    "HOROVOD_ZERO_STAGE", "HOROVOD_ZERO_PREFETCH_BUCKETS",
 )
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # reference: operations.cc:379

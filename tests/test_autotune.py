@@ -135,14 +135,28 @@ class TestParameterManager:
         pm = _mk_manager(log_path=str(log), sweep=("cache_enabled",))
         header = log.read_text().splitlines()[0]
         assert header == ("# swept: fusion_threshold_mb,cycle_time_ms,"
-                          "grad_bucket_mb,pipeline_depth,cache_enabled")
+                          "grad_bucket_mb,pipeline_depth,"
+                          "zero_prefetch_buckets,cache_enabled")
         assert pm.swept_knobs == ("fusion_threshold_mb", "cycle_time_ms",
                                   "grad_bucket_mb", "pipeline_depth",
-                                  "cache_enabled")
+                                  "zero_prefetch_buckets", "cache_enabled")
 
     def test_params_blob_roundtrip(self):
         p = Params(12345678, 7.25, False, True, False, active=True)
         assert Params.unpack(p.pack()) == p
+
+    def test_params_blob_roundtrip_zero_prefetch(self):
+        p = Params(12345678, 7.25, False, True, False, active=True,
+                   zero_prefetch_buckets=4)
+        assert Params.unpack(p.pack()) == p
+
+    def test_search_box_has_prefetch_dim(self):
+        from horovod_tpu.autotune.parameter_manager import (
+            PREFETCH_BOUNDS, search_box_from_roofline)
+
+        assert search_box_from_roofline(None)[4] == PREFETCH_BOUNDS
+        assert search_box_from_roofline(
+            {"allreduce_busbw_gbps": 2.0})[4] == PREFETCH_BOUNDS
 
 
 class TestRuntimeIntegration:

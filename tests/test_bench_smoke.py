@@ -72,6 +72,22 @@ def test_sharded_optimizer_tiny(bench, capsys):
     # toy shapes, so just require a real reduction)
     assert result["state_bytes_reduction_x"] > 1.5
     assert result["steady_state_program_builds"] == 0
+    # per-stage rows (ZeRO 1/2/3): stage 2 halves the gradient wire
+    # bytes (RS only, no grad AG); stage 3 additionally shards params at
+    # rest; every stage keeps the zero-steady-state-compile invariant
+    stages = result["stages"]
+    assert set(stages) == {"stage1", "stage2", "stage3"}
+    s1, s2, s3 = stages["stage1"], stages["stage2"], stages["stage3"]
+    for row in (s1, s2, s3):
+        assert row["steady_state_builds"] == 0
+        assert set(row["bytes_per_chip"]) == {
+            "params", "grads", "optimizer_state"}
+    assert s2["grad_wire_bytes_per_step"] * 2 == s1[
+        "grad_wire_bytes_per_step"]
+    assert s3["grad_wire_bytes_per_step"] == s2["grad_wire_bytes_per_step"]
+    assert s2["bytes_per_chip"]["grads"] < s1["bytes_per_chip"]["grads"]
+    assert s3["bytes_per_chip"]["params"] < s1["bytes_per_chip"]["params"]
+    assert 0.0 <= s3["gather_hidden_fraction"] <= 1.0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["value"] == result["value"]
 
@@ -406,3 +422,65 @@ def test_comms_suite_tiny(bench, capsys):
     assert result["steady_state_compiles"] == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["value"] == result["value"]
+
+
+_STAGE_ROW = {
+    "update_p50_ms": 3.0,
+    "bytes_per_chip": {"params": 4096, "grads": 4096,
+                       "optimizer_state": 12288},
+    "grad_wire_bytes_per_step": 8192,
+    "wire_bytes_per_step": 8192,
+    "steady_state_builds": 0,
+}
+
+
+def test_bench_compare_stage_wire_regression_fails(bench_compare,
+                                                   tmp_path, capsys):
+    """ISSUE 20 satellite: per-stage ZeRO rows gate direction-aware. The
+    headline holds but stage 2's gradient wire bytes double back to the
+    allreduce cost (the reduce-scatter release silently fell back) — the
+    bytes row fails the gate on its own."""
+    base_row = dict(_BASE_ROW, stages={
+        "stage1": dict(_STAGE_ROW),
+        "stage2": dict(_STAGE_ROW, grad_wire_bytes_per_step=4096,
+                       bytes_per_chip={"params": 4096, "grads": 512,
+                                       "optimizer_state": 12288}),
+    })
+    base = _artifact(tmp_path / "base.json", [base_row])
+    cand_row = dict(_BASE_ROW, stages={
+        "stage1": dict(_STAGE_ROW),
+        "stage2": dict(_STAGE_ROW, grad_wire_bytes_per_step=8192,
+                       bytes_per_chip={"params": 4096, "grads": 512,
+                                       "optimizer_state": 12288}),
+    })
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    assert bench_compare.main([base, cand]) == 1
+    out = capsys.readouterr().out
+    assert "[stage2 grad_wire_bytes_per_step]" in out
+    assert "lower is better" in out
+
+
+def test_bench_compare_stage_rows_gate_builds_and_hidden(bench_compare,
+                                                         tmp_path,
+                                                         capsys):
+    """Steady-state builds regressing 0 -> N and a collapsed stage-3
+    comm-hidden fraction both fail; identical artifacts pass with the
+    stage rows compared."""
+    base_row = dict(_BASE_ROW, stages={
+        "stage3": dict(_STAGE_ROW, steady_state_builds=2,
+                       gather_hidden_fraction=0.6)})
+    base = _artifact(tmp_path / "base.json", [base_row])
+    cand_row = dict(_BASE_ROW, stages={
+        "stage3": dict(_STAGE_ROW, steady_state_builds=4,
+                       gather_hidden_fraction=0.1)})
+    cand = _artifact(tmp_path / "cand.json", [cand_row])
+    assert bench_compare.main([base, cand]) == 1
+    out = capsys.readouterr().out
+    assert "[stage3 steady_state_builds]" in out
+    assert "[stage3 gather_hidden_fraction]" in out
+
+    same = _artifact(tmp_path / "same.json", [base_row])
+    assert bench_compare.main([base, same]) == 0
+    out = capsys.readouterr().out
+    assert "[stage3 update_p50_ms]" in out
+    assert "[stage3 params bytes]" in out

@@ -392,3 +392,132 @@ class TestWorldChange:
         for comp, scale in (("master", 1), ("mu", 2), ("nu", 3)):
             full_new = np.concatenate(seen[comp])[:self.N]
             np.testing.assert_array_equal(full_new, fill * scale)
+
+
+# ---------------------------------------------------------------------------
+# World-size-change restore for ZeRO-2 gradient shards and ZeRO-3
+# parameter shards, including the neighbor-replica fallback
+# ---------------------------------------------------------------------------
+
+class TestWorldChangeZeRO23:
+    N = 10  # pads to 12 under both world 2 (shard 6) and world 3 (shard 4)
+
+    def _spec(self, world, rank, shard_elems):
+        from horovod_tpu.parallel import zero
+
+        g = zero.GroupSpec(dtype=np.dtype(np.float32).str, indices=(0,),
+                           shapes=((self.N,),), sizes=(self.N,),
+                           n=self.N, shard_elems=shard_elems,
+                           padded=shard_elems * world)
+        return zero.ZeroSpec(groups=(g,), world=world, rank=rank,
+                             num_leaves=1)
+
+    def _seg(self, world, rank, shard_elems, fill):
+        full = np.zeros((shard_elems * world,), np.float32)
+        if fill is not None:
+            full[:self.N] = fill
+        lo = rank * shard_elems
+        return full[lo:lo + shard_elems].copy()
+
+    def _params(self, world, rank, shard_elems, fill=None):
+        import jax
+
+        from horovod_tpu.parallel import zero
+
+        treedef = jax.tree_util.tree_structure({"w": 0})
+        return zero.ShardedParams(
+            self._spec(world, rank, shard_elems), treedef,
+            (self._seg(world, rank, shard_elems, fill),))
+
+    def _grads(self, world, rank, shard_elems, fill=None):
+        from horovod_tpu.parallel import zero
+
+        return zero.ShardedGrads(
+            self._spec(world, rank, shard_elems),
+            (self._seg(world, rank, shard_elems, fill),))
+
+    def test_restore_world2_into_world3(self, tmp_path):
+        d = str(tmp_path)
+        p_fill = np.arange(self.N, dtype=np.float32) + 1
+        g_fill = -(np.arange(self.N, dtype=np.float32) + 1) / 4
+        for rank in (1, 0):
+            mgr = wr.CheckpointManager(d, async_write=False, keep=2,
+                                       barrier_timeout=5.0)
+            mgr.commit({"grads": self._grads(2, rank, 6, fill=g_fill),
+                        "params": self._params(2, rank, 6, fill=p_fill)},
+                       step=1, generation=0, rank=rank, world=2)
+            mgr.close()
+        manifest = mf.load_manifest(d, 1)
+        assert manifest["world"] == 2
+        assert manifest["sharded"]["grads/0"]["kind"] == "sharded_grads"
+        assert manifest["sharded"]["params/1"]["kind"] == "sharded_params"
+        seen = {"params": [], "grads": []}
+        for new_rank in range(3):
+            target = {"grads": self._grads(3, new_rank, 4),
+                      "params": self._params(3, new_rank, 4)}
+            trees, step = rst.restore_step(d, 1, target)
+            assert step == 1
+            for name in seen:
+                got = trees[name]
+                assert got.spec.world == 3
+                assert got.spec.rank == new_rank
+                arr = np.asarray(got.shards[0])
+                assert arr.shape == (4,)
+                seen[name].append(arr)
+        np.testing.assert_array_equal(
+            np.concatenate(seen["params"])[:self.N], p_fill)
+        np.testing.assert_array_equal(
+            np.concatenate(seen["grads"])[:self.N], g_fill)
+
+    def _publish_world2_with_replica(self, d, fill):
+        """Hand-build a 2-rank stage-3 checkpoint where rank 0's file
+        also carries rank 1's parameter-shard segment as a replica
+        entry (what the neighbor ring produces for sharded leaves)."""
+        segs = [self._seg(2, r, 6, fill) for r in range(2)]
+        shards = []
+        for rank, entries in (
+            (0, [mf.array_entry("params/0#leaf/0", segs[0],
+                                role=mf.ROLE_OWN),
+                 mf.array_entry("params/0#leaf/0", segs[1],
+                                role=mf.ROLE_REPLICA, replica_of=1)]),
+            (1, [mf.array_entry("params/0#leaf/0", segs[1],
+                                role=mf.ROLE_OWN)]),
+        ):
+            blob = mf.pack_shard(entries, meta={"step": 5, "rank": rank})
+            name = mf.shard_name(5, rank, 2)
+            _write(os.path.join(d, name), blob)
+            shards.append({"rank": rank, "file": name,
+                           "bytes": len(blob),
+                           "crc": ckpt_io.checksum(blob)})
+        layout = {"params/0": {
+            "kind": "sharded_params", "world": 2,
+            "groups": [[np.dtype(np.float32).str, self.N, 6, 12]]}}
+        mf.write_manifest(d, mf.build_manifest(5, 0, 2, shards, layout))
+
+    def test_param_shard_recovered_from_replica(self, tmp_path):
+        from horovod_tpu.ckpt import stats
+
+        d = str(tmp_path)
+        fill = np.arange(self.N, dtype=np.float32) * 3 + 1
+        self._publish_world2_with_replica(d, fill)
+        os.unlink(os.path.join(d, mf.shard_name(5, 1, 2)))
+        before = stats.REPLICA_RESTORES.value
+        seen = []
+        for new_rank in range(3):
+            target = {"params": self._params(3, new_rank, 4)}
+            trees, step = rst.restore_step(d, 5, target)
+            assert step == 5
+            seen.append(np.asarray(trees["params"].shards[0]))
+        np.testing.assert_array_equal(
+            np.concatenate(seen)[:self.N], fill)
+        assert stats.REPLICA_RESTORES.value == before + 3
+
+    def test_param_shard_unrecoverable_without_replica(self, tmp_path):
+        d = str(tmp_path)
+        fill = np.arange(self.N, dtype=np.float32)
+        self._publish_world2_with_replica(d, fill)
+        # rank 0's file carries both its own segment and the replica:
+        # losing IT leaves rank 0's segment with no copy anywhere
+        os.unlink(os.path.join(d, mf.shard_name(5, 0, 2)))
+        with pytest.raises(CheckpointCorruptError):
+            rst.restore_step(d, 5, {"params": self._params(2, 0, 6)})

@@ -1,0 +1,145 @@
+"""Worker for the ZeRO-2 chaos cell ``zero2_kill_mid_reducescatter``
+(ISSUE 20 satellite).
+
+World=3 over the real socket/native transport. Every step runs a
+bucketed eager backward through a ``GradReleasePlan(reduce_scatter=
+True)`` — one leaf per bucket, so three reduce-scatters hit the wire
+per step and the optimizer consumes the resulting ``zero.ShardedGrads``
+directly (the full-gradient buffer is never reassembled). At
+ZERO2_KILL_STEP the kill rank dies *mid-backward*: inside its second
+bucket's reduce-scatter release, with bucket 0's reduce-scatter already
+negotiated/in flight. The survivors' ``gather`` fails with
+WorkersDownError on the orphaned tokens; ``@elastic.run`` re-forms them
+into a 2-worker generation, ``zero.resync`` rebuilds the sharded AdamW
+master/moment shards under the new world, and the SAME plan object
+(zspec rebuilt for the new world) finishes the run. The final line
+reports outstanding fusion-buffer leases — a failed token must return
+its slab, so ``leases_leaked`` has to be 0.
+
+Invariant: the loss is a plain sum so every averaged gradient element
+is exactly 1; sharded AdamW with b1=b2=eps=weight_decay=0 and lr=-1
+then adds exactly ``-lr * sign(g) == 1`` per element per step
+regardless of world size — ``w == step`` at every commit, across the
+re-form.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.parallel import buckets as buckets_mod
+
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "6"))
+KILL_STEP = int(os.environ.get("ZERO2_KILL_STEP", "3"))
+KILL_RANK = int(os.environ.get("ZERO2_KILL_RANK", "1"))
+ORIG_RANK = int(os.environ.get("HOROVOD_RANK", "0"))
+
+PLAN = buckets_mod.GradReleasePlan(bucket_bytes=256,
+                                   reduce_scatter=True)
+
+_die_mid_rs = False
+_real_release = buckets_mod.GradReleasePlan._release_reduce_scatter
+
+
+def _release_and_maybe_die(self, bucket, values):
+    _real_release(self, bucket, values)
+    if _die_mid_rs and bucket.index >= 1:
+        # bucket 0's reduce-scatter is already on the wire and later
+        # buckets are still differentiating: abrupt death with stage-2
+        # tokens genuinely in flight
+        os._exit(17)
+
+
+buckets_mod.GradReleasePlan._release_reduce_scatter = _release_and_maybe_die
+
+OPT = None
+
+
+def _params():
+    # 384 B per leaf > bucket_bytes: one leaf per bucket, so three
+    # reduce-scatters hit the wire per step and the kill lands with
+    # bucket 0 genuinely in flight
+    return {"a": jnp.zeros((96,), jnp.float32),
+            "b": jnp.zeros((96,), jnp.float32),
+            "c": jnp.zeros((96,), jnp.float32)}
+
+
+def sharded_grads(params):
+    def loss(p):
+        return sum(x.sum() for x in
+                   jax.tree_util.tree_leaves(PLAN.tag(p)))
+
+    return PLAN.gather(jax.grad(loss)(params))
+
+
+@elastic.run
+def train(state):
+    global _die_mid_rs
+    while state.step < TOTAL_STEPS:
+        _die_mid_rs = (ORIG_RANK == KILL_RANK
+                       and state.step == KILL_STEP
+                       and elastic.restarts() == 0)
+        params = {k: jnp.asarray(v) for k, v in state.params.items()}
+        sg = sharded_grads(params)
+        _die_mid_rs = False
+        params, state.optimizer = OPT.apply(params, state.optimizer, sg)
+        state.params = {k: np.asarray(v) for k, v in params.items()}
+        state.step += 1
+        state.commit()
+    return state
+
+
+def main() -> int:
+    global OPT
+    from horovod_tpu.parallel import zero
+
+    hvd.init()
+    params = _params()
+    # b1=b2=eps=weight_decay=0, lr=-1: the AdamW inner reduces to
+    # -lr * sign(g) — grads of ones add exactly 1.0 per element per step
+    OPT = hvd.sharded_adamw(-1.0, 0.0, 0.0, 0.0, 0.0,
+                            partition=PLAN.zero_partition(params))
+    # the sharded master is the source of truth: init it from the same
+    # zeros the tracked params start at
+    state = elastic.ArrayState(
+        params={k: np.asarray(v) for k, v in params.items()},
+        optimizer=OPT.init(params), step=0)
+    train(state)
+
+    from horovod_tpu.runtime.runtime import get_runtime
+
+    mgr = get_runtime().executor.fusion_buffers
+    with mgr._lock:
+        free = sum(a.nbytes for lst in mgr._free.values() for a in lst)
+    leaked = mgr.allocated_bytes() - free
+    spec = state.optimizer.spec
+    w_arr = np.concatenate([np.asarray(state.params[k]).reshape(-1)
+                            for k in sorted(state.params)])
+    w = float(w_arr[0])
+    print(f"DONE rank={hvd.rank()} size={hvd.size()} step={state.step} "
+          f"w={w:g} generation={elastic.restarts()} "
+          f"wire_released={PLAN.wire_stats()['released']} "
+          f"shard_world={spec.world} shard_rank={spec.rank} "
+          f"leases_leaked={leaked}", flush=True)
+    if state.step != TOTAL_STEPS:
+        return 3
+    # every element moved in lockstep across the re-form
+    if not np.all(np.abs(w_arr - TOTAL_STEPS) < 1e-5):
+        return 3
+    # resync must have rebuilt the shards for the CURRENT world
+    if spec.world != hvd.size() or spec.rank != hvd.rank():
+        return 4
+    if leaked != 0:
+        return 5
+    assert isinstance(state.optimizer, zero.FlatAdamState)
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,7 +38,7 @@ from typing import Dict, List, Optional, Tuple
 # derived step-phase rows below this baseline value are noise, not signal
 MIN_PHASE_SECONDS = 1e-3
 
-LOWER_IS_BETTER_UNITS = ("ms", "us", "seconds", "s", "bytes")
+LOWER_IS_BETTER_UNITS = ("ms", "us", "seconds", "s", "bytes", "builds")
 
 
 def parse_artifact(path: str) -> Dict[str, dict]:
@@ -120,6 +120,38 @@ def derived_rows(rows: Dict[str, dict]) -> Dict[str, Tuple[float, str]]:
                 if isinstance(nbytes, (int, float)):
                     flat[f"{metric} [{subsystem} bytes]"] = (
                         float(nbytes), "bytes")
+        # ZeRO per-stage rows (bench.py --sharded-optimizer): update
+        # latency and every bytes-dimensioned row gate lower-is-better;
+        # steady-state builds get the "builds" unit so a compile-cache
+        # miss after warmup gates too; the stage-3 comm-hidden fraction
+        # is a rate (higher-is-better)
+        stages = obj.get("stages")
+        if isinstance(stages, dict):
+            for sname, row in stages.items():
+                if not isinstance(row, dict):
+                    continue
+                if isinstance(row.get("update_p50_ms"), (int, float)):
+                    flat[f"{metric} [{sname} update_p50_ms]"] = (
+                        float(row["update_p50_ms"]), "ms")
+                for key in ("grad_wire_bytes_per_step",
+                            "wire_bytes_per_step"):
+                    if isinstance(row.get(key), (int, float)):
+                        flat[f"{metric} [{sname} {key}]"] = (
+                            float(row[key]), "bytes")
+                if isinstance(row.get("steady_state_builds"),
+                              (int, float)):
+                    flat[f"{metric} [{sname} steady_state_builds]"] = (
+                        float(row["steady_state_builds"]), "builds")
+                if isinstance(row.get("gather_hidden_fraction"),
+                              (int, float)):
+                    flat[f"{metric} [{sname} gather_hidden_fraction]"] = (
+                        float(row["gather_hidden_fraction"]), "fraction")
+                sub = row.get("bytes_per_chip")
+                if isinstance(sub, dict):
+                    for subsystem, nbytes in sub.items():
+                        if isinstance(nbytes, (int, float)):
+                            flat[f"{metric} [{sname} {subsystem} "
+                                 f"bytes]"] = (float(nbytes), "bytes")
         if isinstance(obj.get("peak_hbm_bytes"), (int, float)):
             flat[f"{metric} [peak_hbm bytes]"] = (
                 float(obj["peak_hbm_bytes"]), "bytes")

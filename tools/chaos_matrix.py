@@ -222,6 +222,25 @@ SCENARIOS = {
         "ckpt_verify": "manifest",
         "timeout": 240,
     },
+    # ISSUE 20: rank 1 killed INSIDE a stage-2 bucket reduce-scatter —
+    # bucket 0's reduce-scatter already in flight, later buckets never
+    # released. The survivors' gather fails the orphaned stage-2 tokens
+    # with WorkersDownError, the re-formed 2-worker generation resyncs
+    # the sharded AdamW shards to the new world, training reaches the
+    # expected weights, and no fusion-buffer lease leaks.
+    "zero2_kill_mid_reducescatter": {
+        "world": 3,
+        "worker": "zero2_chaos_worker.py",
+        "env": {
+            "ZERO2_KILL_STEP": "3",
+            "ZERO2_KILL_RANK": "1",
+            "HOROVOD_ELASTIC_MIN_WORKERS": "2",
+        },
+        "expected_exit": {1: 17},
+        "require_reform": True,
+        "require_true": ["resharded", "leases_ok"],
+        "timeout": 240,
+    },
     "serve_kill_replica": {
         "world": 4,   # rank 0 = frontend/loadgen, ranks 1-3 = replicas
         "worker": "serve_chaos_worker.py",

@@ -29,8 +29,9 @@ from typing import Dict, List, Optional
 POLL_TIMEOUT_SECONDS = 3.0
 
 # ledger columns, widest consumers first; anything else folds into "other"
-COLUMNS = ("params", "grads", "optimizer_shards", "serve_kv", "kv_pages",
-           "fusion", "ckpt_staging", "program_cache")
+COLUMNS = ("params", "grads", "param_shards", "grad_shards",
+           "optimizer_shards", "serve_kv", "kv_pages", "fusion",
+           "ckpt_staging", "program_cache")
 
 
 def fmt_bytes(n: Optional[float]) -> str:

@@ -1,0 +1,165 @@
+"""Worker for the ZeRO-2 chaos cell (``zero2_kill_mid_reducescatter``
+in tools/chaos_matrix.py, ISSUE 20).
+
+Stage-2 sharded training: a ``GradReleasePlan(reduce_scatter=True)``
+releases each backward bucket as a reduce-scatter (one leaf per
+bucket, three per step) and the partition-aligned ``hvd.sharded_adamw``
+consumes the resulting ``zero.ShardedGrads`` directly — the full
+gradient buffer is never reassembled. At ZERO2_KILL_STEP the kill rank
+dies *inside* its second bucket's reduce-scatter release, with bucket
+0's reduce-scatter already in flight. The survivors' gather fails the
+orphaned stage-2 tokens with WorkersDownError, ``@elastic.run``
+re-forms them, and ``zero.resync`` rebuilds the AdamW master/moment
+shards under the new world.
+
+Emits ``CHAOS_RESULT {json}`` with the boolean fields the matrix
+asserts via ``require_true``: ``resharded`` (the optimizer spec
+describes the post-reform world) and ``leases_ok`` (zero outstanding
+fusion-buffer leases — every failed token returned its slab).
+
+Invariant: the loss is a plain sum so every averaged gradient element
+is exactly 1; sharded AdamW with b1=b2=eps=weight_decay=0 and lr=-1
+adds exactly 1.0 per element per step regardless of world size, so
+``w == step`` at every commit, across the re-form.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic, flight_recorder
+from horovod_tpu.parallel import buckets as buckets_mod
+
+TOTAL_STEPS = int(os.environ.get("CHAOS_TOTAL_STEPS", "8"))
+STEP_SLEEP = float(os.environ.get("CHAOS_STEP_SLEEP", "0"))
+KILL_STEP = int(os.environ.get("ZERO2_KILL_STEP", "3"))
+KILL_RANK = int(os.environ.get("ZERO2_KILL_RANK", "1"))
+ORIG_RANK = int(os.environ.get("HOROVOD_RANK", "0"))
+
+PLAN = buckets_mod.GradReleasePlan(bucket_bytes=256,
+                                   reduce_scatter=True)
+
+_die_mid_rs = False
+_real_release = buckets_mod.GradReleasePlan._release_reduce_scatter
+
+
+def _release_and_maybe_die(self, bucket, values):
+    _real_release(self, bucket, values)
+    if _die_mid_rs and bucket.index >= 1:
+        # bucket 0's reduce-scatter is already on the wire and later
+        # buckets are still differentiating: abrupt death with stage-2
+        # tokens genuinely in flight
+        os._exit(17)
+
+
+buckets_mod.GradReleasePlan._release_reduce_scatter = _release_and_maybe_die
+
+OPT = None
+
+
+def _params():
+    # 384 B per leaf > bucket_bytes: one leaf per bucket, three
+    # reduce-scatters on the wire per step
+    return {"a": jnp.zeros((96,), jnp.float32),
+            "b": jnp.zeros((96,), jnp.float32),
+            "c": jnp.zeros((96,), jnp.float32)}
+
+
+def sharded_grads(params):
+    def loss(p):
+        return sum(x.sum() for x in
+                   jax.tree_util.tree_leaves(PLAN.tag(p)))
+
+    return PLAN.gather(jax.grad(loss)(params))
+
+
+@elastic.run
+def train(state):
+    global _die_mid_rs
+    while state.step < TOTAL_STEPS:
+        _die_mid_rs = (ORIG_RANK == KILL_RANK
+                       and state.step == KILL_STEP
+                       and elastic.restarts() == 0)
+        params = {k: jnp.asarray(v) for k, v in state.params.items()}
+        sg = sharded_grads(params)
+        _die_mid_rs = False
+        params, state.optimizer = OPT.apply(params, state.optimizer, sg)
+        state.params = {k: np.asarray(v) for k, v in params.items()}
+        state.step += 1
+        state.commit()
+        if STEP_SLEEP:
+            time.sleep(STEP_SLEEP)
+    return state
+
+
+def _metric_total(snap, name):
+    fam = snap.get(name, {})
+    return float(sum(row.get("value", 0.0)
+                     for row in fam.get("values", ())))
+
+
+def main() -> int:
+    global OPT
+
+    hvd.init()
+    params = _params()
+    # b1=b2=eps=weight_decay=0, lr=-1: the AdamW inner reduces to
+    # -lr * sign(g) — grads of ones add exactly 1.0 per element per step
+    OPT = hvd.sharded_adamw(-1.0, 0.0, 0.0, 0.0, 0.0,
+                            partition=PLAN.zero_partition(params))
+    state = elastic.ArrayState(
+        params={k: np.asarray(v) for k, v in params.items()},
+        optimizer=OPT.init(params), step=0)
+    train(state)
+
+    from horovod_tpu.runtime.runtime import get_runtime
+
+    mgr = get_runtime().executor.fusion_buffers
+    with mgr._lock:
+        free = sum(a.nbytes for lst in mgr._free.values() for a in lst)
+    leaked = mgr.allocated_bytes() - free
+    spec = state.optimizer.spec
+    w_arr = np.concatenate([np.asarray(state.params[k]).reshape(-1)
+                            for k in sorted(state.params)])
+    lockstep = bool(np.all(np.abs(w_arr - TOTAL_STEPS) < 1e-5))
+
+    snap = hvd.metrics()
+    result = {
+        "rank": hvd.rank(),
+        "size": hvd.size(),
+        "step": state.step,
+        "w": float(w_arr[0]),
+        "generation": elastic.restarts(),
+        "resharded": (spec.world == hvd.size()
+                      and spec.rank == hvd.rank()),
+        "leases_ok": leaked == 0,
+        "leases_leaked_bytes": int(leaked),
+        "wire_released": PLAN.wire_stats()["released"],
+        "net_retries_total": _metric_total(
+            snap, "horovod_net_retries_total"),
+        "net_gave_up_total": _metric_total(
+            snap, "horovod_net_gave_up_total"),
+        "chaos_injected_total": _metric_total(
+            snap, "horovod_net_chaos_injected_total"),
+    }
+    try:  # the postmortem needs post-reform events
+        flight_recorder.dump_debug_state(reason="chaos_run_complete")
+    except Exception:
+        pass
+    print("CHAOS_RESULT " + json.dumps(result), flush=True)
+    ok = (state.step == TOTAL_STEPS and lockstep
+          and result["resharded"] and result["leases_ok"])
+    hvd.shutdown()
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
